@@ -1,0 +1,19 @@
+"""kubernetes_tpu — a TPU-native scheduling framework.
+
+A ground-up re-design of the Kubernetes scheduler (reference:
+``plugin/pkg/scheduler`` in aa47206150/kubernetes, v1.4.0-alpha era) for TPU:
+instead of a serial per-pod fit-and-score loop, the cluster's node cache is a
+dense ``(nodes x features)`` tensor resident on device, hard predicates are
+boolean mask kernels ``[pods, nodes]``, priorities are score planes reduced by
+a single weighted contraction, and an entire pending queue is placed as one
+batched assignment problem under ``jax.jit`` / ``pjit`` over a device mesh.
+
+Wire compatibility is preserved at the framework boundary: the scheduler
+extender HTTP protocol (reference ``plugin/pkg/scheduler/api/types.go:133-163``)
+and scheduler policy JSON (``api/types.go:27-35``) are spoken unchanged, so a
+stock Go control plane can delegate Filter/Prioritize to this engine.
+"""
+
+__version__ = "0.1.0"
+
+from kubernetes_tpu.api import types as api_types  # noqa: F401

@@ -1,0 +1,157 @@
+"""Scheduler policy: which predicates/priorities run, with what weights.
+
+Mirrors the reference's policy API (``plugin/pkg/scheduler/api/types.go:27-131``,
+JSON-compatible), the plugin registries (``factory/plugins.go``), and the
+default algorithm providers (``algorithmprovider/defaults/defaults.go``).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+# defaults.go:42-54 — provider-configured volume caps (env-overridable).
+DEFAULT_MAX_EBS_VOLUMES = 39
+DEFAULT_MAX_GCE_PD_VOLUMES = 16
+
+# options/options.go:46, pkg/api/types.go:3053
+DEFAULT_HARD_POD_AFFINITY_SYMMETRIC_WEIGHT = 1
+
+
+@dataclass(frozen=True)
+class PredicateSpec:
+    name: str
+    # LabelsPresence argument (api/types.go:58-70)
+    labels: tuple[str, ...] = ()
+    presence: bool = False
+    # ServiceAffinity argument
+    affinity_labels: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class PrioritySpec:
+    name: str
+    weight: int = 1
+    # LabelPreference argument (api/types.go:95-110)
+    label: str = ""
+    presence: bool = False
+    # ServiceAntiAffinity argument
+    anti_affinity_label: str = ""
+
+
+@dataclass(frozen=True)
+class ExtenderConfig:
+    """api/types.go:114-131."""
+
+    url_prefix: str = ""
+    filter_verb: str = ""
+    prioritize_verb: str = ""
+    weight: int = 1
+    api_version: str = "v1"
+    enable_https: bool = False
+    http_timeout_s: float = 5.0  # extender.go:34-36
+
+
+@dataclass
+class Policy:
+    predicates: list[PredicateSpec] = field(default_factory=list)
+    priorities: list[PrioritySpec] = field(default_factory=list)
+    extenders: list[ExtenderConfig] = field(default_factory=list)
+    hard_pod_affinity_symmetric_weight: int = DEFAULT_HARD_POD_AFFINITY_SYMMETRIC_WEIGHT
+
+
+# GeneralPredicates composite (predicates.go:773-823) — also re-run by the
+# kubelet at admission (pkg/kubelet/lifecycle/predicate.go), which is why it
+# is factored as one named unit.
+GENERAL_PREDICATES = ("PodFitsResources", "PodFitsHost", "PodFitsHostPorts",
+                      "MatchNodeSelector")
+
+
+def default_provider() -> Policy:
+    """DefaultProvider (defaults.go:113-206)."""
+    return Policy(
+        predicates=[
+            PredicateSpec("NoVolumeZoneConflict"),
+            PredicateSpec("MaxEBSVolumeCount"),
+            PredicateSpec("MaxGCEPDVolumeCount"),
+            PredicateSpec("MatchInterPodAffinity"),
+            PredicateSpec("NoDiskConflict"),
+            PredicateSpec("GeneralPredicates"),
+            PredicateSpec("PodToleratesNodeTaints"),
+            PredicateSpec("CheckNodeMemoryPressure"),
+            PredicateSpec("CheckNodeDiskPressure"),
+        ],
+        priorities=[
+            PrioritySpec("SelectorSpreadPriority", 1),
+            PrioritySpec("InterPodAffinityPriority", 1),
+            PrioritySpec("LeastRequestedPriority", 1),
+            PrioritySpec("BalancedResourceAllocation", 1),
+            PrioritySpec("NodePreferAvoidPodsPriority", 10000),
+            PrioritySpec("NodeAffinityPriority", 1),
+            PrioritySpec("TaintTolerationPriority", 1),
+        ])
+
+
+def cluster_autoscaler_provider() -> Policy:
+    """ClusterAutoscalerProvider (defaults.go:58-60): MostRequested replaces
+    LeastRequested."""
+    p = default_provider()
+    p.priorities = [
+        PrioritySpec("MostRequestedPriority", s.weight)
+        if s.name == "LeastRequestedPriority" else s
+        for s in p.priorities]
+    return p
+
+
+PROVIDERS = {
+    "DefaultProvider": default_provider,
+    "ClusterAutoscalerProvider": cluster_autoscaler_provider,
+}
+
+
+def policy_from_json(text: str) -> Policy:
+    """Parse a scheduler policy config file (CreateFromConfig,
+    factory.go:267-300; wire schema api/v1/types.go)."""
+    d = json.loads(text)
+    preds = []
+    for p in d.get("predicates") or ():
+        arg = p.get("argument") or {}
+        lp = arg.get("labelsPresence") or {}
+        sa = arg.get("serviceAffinity") or {}
+        preds.append(PredicateSpec(
+            name=p.get("name", ""),
+            labels=tuple(lp.get("labels") or ()),
+            presence=bool(lp.get("presence", False)),
+            affinity_labels=tuple(sa.get("labels") or ())))
+    prios = []
+    for p in d.get("priorities") or ():
+        arg = p.get("argument") or {}
+        lp = arg.get("labelPreference") or {}
+        saa = arg.get("serviceAntiAffinity") or {}
+        prios.append(PrioritySpec(
+            name=p.get("name", ""), weight=int(p.get("weight", 1)),
+            label=lp.get("label", ""), presence=bool(lp.get("presence", False)),
+            anti_affinity_label=saa.get("label", "")))
+    exts = []
+    for e in d.get("extenders") or ():
+        exts.append(ExtenderConfig(
+            url_prefix=e.get("urlPrefix", ""),
+            filter_verb=e.get("filterVerb", ""),
+            prioritize_verb=e.get("prioritizeVerb", ""),
+            weight=int(e.get("weight", 1)),
+            api_version=e.get("apiVersion", "v1"),
+            enable_https=bool(e.get("enableHttps", False)),
+            http_timeout_s=float(e.get("httpTimeout", 5_000_000_000)) / 1e9))
+    return Policy(predicates=preds, priorities=prios, extenders=exts)
+
+
+def expand_predicates(policy: Policy) -> list[PredicateSpec]:
+    """Expand the GeneralPredicates composite into its members."""
+    out: list[PredicateSpec] = []
+    for p in policy.predicates:
+        if p.name == "GeneralPredicates":
+            out.extend(PredicateSpec(n) for n in GENERAL_PREDICATES)
+        else:
+            out.append(p)
+    return out

@@ -1,0 +1,52 @@
+"""Kubernetes resource.Quantity parsing.
+
+Behavioral reference: ``pkg/api/resource/quantity.go`` (suffixes at
+``pkg/api/resource/suffix.go``).  We only need the subset the scheduler
+touches: parse a quantity string to an exact integer value (``Value()``)
+or milli-value (``MilliValue()``).
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+# Binary SI (1024-based) and decimal SI (1000-based) suffix tables, per
+# pkg/api/resource/suffix.go.
+_BIN = {"Ki": 1024, "Mi": 1024**2, "Gi": 1024**3, "Ti": 1024**4,
+        "Pi": 1024**5, "Ei": 1024**6}
+_DEC = {"n": Fraction(1, 10**9), "u": Fraction(1, 10**6),
+        "m": Fraction(1, 1000), "": Fraction(1), "k": Fraction(10**3),
+        "M": Fraction(10**6), "G": Fraction(10**9), "T": Fraction(10**12),
+        "P": Fraction(10**15), "E": Fraction(10**18)}
+
+
+def parse_quantity(s: str | int | float) -> Fraction:
+    """Parse a Kubernetes quantity ("100m", "2Gi", "1500M", 2) to a Fraction."""
+    if isinstance(s, (int, float)):
+        return Fraction(s)
+    s = s.strip()
+    if not s:
+        raise ValueError("empty quantity")
+    for suf, mult in _BIN.items():
+        if s.endswith(suf):
+            return Fraction(s[: -len(suf)]) * mult
+    # decimal exponent form e.g. "12e6"
+    for suf, mult in _DEC.items():
+        if suf and s.endswith(suf):
+            return Fraction(s[: -len(suf)]) * mult
+    if "e" in s or "E" in s:
+        mantissa, _, exp = s.replace("E", "e").partition("e")
+        return Fraction(mantissa) * Fraction(10) ** int(exp)
+    return Fraction(s)
+
+
+def value(s: str | int | float) -> int:
+    """Quantity.Value(): ceil to integer (quantity.go rounds up)."""
+    f = parse_quantity(s)
+    return int(-((-f.numerator) // f.denominator))  # ceil
+
+
+def milli_value(s: str | int | float) -> int:
+    """Quantity.MilliValue(): value * 1000, ceil to integer."""
+    f = parse_quantity(s) * 1000
+    return int(-((-f.numerator) // f.denominator))  # ceil

@@ -1,0 +1,552 @@
+"""Scheduler-facing object model.
+
+A minimal typed mirror of the reference API objects, restricted to the fields
+the scheduling path reads (behavioral reference: ``pkg/api/types.go``,
+annotation helpers ``pkg/api/helpers.go:414-505``).  In the v1.4.0-alpha era,
+affinity, tolerations, and taints live in *annotations* as serialized JSON
+(``scheduler.alpha.kubernetes.io/{affinity,tolerations,taints}``); the model
+parses both those annotations and first-class fields so callers can use either.
+
+Everything here is pure host-side Python; the feature compiler
+(``kubernetes_tpu.features.compiler``) turns these into device tensors.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from kubernetes_tpu.api.quantity import milli_value, value
+
+# Annotation keys (pkg/api/helpers.go:414-424, pkg/api/types.go:3053).
+AFFINITY_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/affinity"
+TOLERATIONS_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/tolerations"
+TAINTS_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/taints"
+SCHEDULER_NAME_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/name"
+PREFER_AVOID_PODS_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/preferAvoidPods"
+CREATED_BY_ANNOTATION_KEY = "kubernetes.io/created-by"
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+# Taint effects (pkg/api/types.go TaintEffect consts).
+TAINT_EFFECT_NO_SCHEDULE = "NoSchedule"
+TAINT_EFFECT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+
+# Node selector operators (pkg/api/types.go NodeSelectorOperator).
+NS_OP_IN = "In"
+NS_OP_NOT_IN = "NotIn"
+NS_OP_EXISTS = "Exists"
+NS_OP_DOES_NOT_EXIST = "DoesNotExist"
+NS_OP_GT = "Gt"
+NS_OP_LT = "Lt"
+
+# Node condition types read by the scheduler.
+NODE_READY = "Ready"
+NODE_OUT_OF_DISK = "OutOfDisk"
+NODE_MEMORY_PRESSURE = "MemoryPressure"
+NODE_DISK_PRESSURE = "DiskPressure"
+NODE_NETWORK_UNAVAILABLE = "NetworkUnavailable"
+
+# Well-known topology label keys (pkg/api/types.go / unversioned labels).
+HOSTNAME_LABEL = "kubernetes.io/hostname"
+ZONE_LABEL = "failure-domain.beta.kubernetes.io/zone"
+REGION_LABEL = "failure-domain.beta.kubernetes.io/region"
+DEFAULT_FAILURE_DOMAINS = (HOSTNAME_LABEL, ZONE_LABEL, REGION_LABEL)
+
+
+@dataclass(frozen=True)
+class Resource:
+    """Aggregated compute resources (schedulercache/node_info.go:57-61)."""
+
+    milli_cpu: int = 0
+    memory: int = 0  # bytes
+    nvidia_gpu: int = 0
+
+    def add(self, other: "Resource") -> "Resource":
+        return Resource(self.milli_cpu + other.milli_cpu,
+                        self.memory + other.memory,
+                        self.nvidia_gpu + other.nvidia_gpu)
+
+
+@dataclass(frozen=True)
+class ContainerPort:
+    host_port: int = 0
+    container_port: int = 0
+    protocol: str = "TCP"
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    requests: dict[str, Any] = field(default_factory=dict)  # resource name -> quantity
+    limits: dict[str, Any] = field(default_factory=dict)
+    ports: list[ContainerPort] = field(default_factory=list)
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""
+    operator: str = ""  # "" / "Equal" / "Exists"
+    value: str = ""
+    effect: str = ""  # "" tolerates any effect
+
+    def tolerates(self, taint: "Taint") -> bool:
+        """TolerationToleratesTaint (pkg/api/helpers.go)."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key != taint.key:
+            return False
+        if (not self.operator or self.operator == "Equal") and self.value == taint.value:
+            return True
+        return self.operator == "Exists"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = ""
+
+    def tolerated_by(self, tolerations: list[Toleration]) -> bool:
+        return any(t.tolerates(self) for t in tolerations)
+
+
+@dataclass(frozen=True)
+class NodeSelectorRequirement:
+    key: str
+    operator: str
+    values: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class NodeSelectorTerm:
+    match_expressions: tuple[NodeSelectorRequirement, ...] = ()
+
+
+@dataclass(frozen=True)
+class NodeSelector:
+    node_selector_terms: tuple[NodeSelectorTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class PreferredSchedulingTerm:
+    weight: int
+    preference: NodeSelectorTerm
+
+
+@dataclass(frozen=True)
+class NodeAffinity:
+    required: Optional[NodeSelector] = None
+    preferred: tuple[PreferredSchedulingTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class LabelSelectorRequirement:
+    key: str
+    operator: str  # In/NotIn/Exists/DoesNotExist
+    values: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class LabelSelector:
+    """unversioned.LabelSelector. None selector matches NO objects; an empty
+    selector (no labels, no exprs) matches ALL objects."""
+
+    match_labels: tuple[tuple[str, str], ...] = ()
+    match_expressions: tuple[LabelSelectorRequirement, ...] = ()
+
+    def matches(self, labels: dict[str, str]) -> bool:
+        for k, v in self.match_labels:
+            if labels.get(k) != v:
+                return False
+        for req in self.match_expressions:
+            has = req.key in labels
+            if req.operator == "In":
+                if not has or labels[req.key] not in req.values:
+                    return False
+            elif req.operator == "NotIn":
+                if has and labels[req.key] in req.values:
+                    return False
+            elif req.operator == "Exists":
+                if not has:
+                    return False
+            elif req.operator == "DoesNotExist":
+                if has:
+                    return False
+            else:
+                return False
+        return True
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    label_selector: Optional[LabelSelector] = None
+    namespaces: tuple[str, ...] = ()  # empty => the pod's own namespace
+    topology_key: str = ""
+
+
+@dataclass(frozen=True)
+class WeightedPodAffinityTerm:
+    weight: int
+    pod_affinity_term: PodAffinityTerm
+
+
+@dataclass(frozen=True)
+class PodAffinity:
+    required: tuple[PodAffinityTerm, ...] = ()
+    preferred: tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class PodAntiAffinity:
+    required: tuple[PodAffinityTerm, ...] = ()
+    preferred: tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+@dataclass(frozen=True)
+class Volume:
+    """Only the conflict-relevant volume sources (predicates.go:63-144)."""
+
+    name: str = ""
+    gce_pd_name: str = ""
+    gce_read_only: bool = False
+    aws_ebs_id: str = ""
+    aws_read_only: bool = False
+    rbd_key: str = ""  # "monitors#pool#image" uniqueness key
+    rbd_read_only: bool = False
+    iscsi_key: str = ""  # "iqn#lun" uniqueness key (targetPortal ignored, predicates.go:77-87)
+    iscsi_read_only: bool = False
+    nfs_key: str = ""  # "server#path"
+    nfs_read_only: bool = False
+    pvc_claim_name: str = ""
+
+
+@dataclass
+class Pod:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    node_name: str = ""  # spec.nodeName; "" = unscheduled
+    node_selector: dict[str, str] = field(default_factory=dict)  # spec.nodeSelector
+    containers: list[Container] = field(default_factory=list)
+    volumes: list[Volume] = field(default_factory=list)
+    deletion_timestamp: Optional[float] = None
+    # Parsed-from-annotation caches (set lazily).
+    _affinity: Optional[Affinity] = field(default=None, repr=False)
+    _affinity_parsed: bool = field(default=False, repr=False)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @property
+    def scheduler_name(self) -> str:
+        return self.annotations.get(SCHEDULER_NAME_ANNOTATION_KEY,
+                                    DEFAULT_SCHEDULER_NAME)
+
+    def affinity(self) -> Optional[Affinity]:
+        """GetAffinityFromPodAnnotations (pkg/api/helpers.go:459-469)."""
+        if not self._affinity_parsed:
+            raw = self.annotations.get(AFFINITY_ANNOTATION_KEY, "")
+            self._affinity = _parse_affinity_json(json.loads(raw)) if raw else None
+            self._affinity_parsed = True
+        return self._affinity
+
+    def tolerations(self) -> list[Toleration]:
+        """GetTolerationsFromPodAnnotations (pkg/api/helpers.go:471-482)."""
+        raw = self.annotations.get(TOLERATIONS_ANNOTATION_KEY, "")
+        if not raw:
+            return []
+        return [Toleration(key=t.get("key", ""), operator=t.get("operator", ""),
+                           value=t.get("value", ""), effect=t.get("effect", ""))
+                for t in json.loads(raw)]
+
+    def resource_request(self) -> Resource:
+        """getResourceRequest (predicates.go:420-436): sum of container requests."""
+        cpu = mem = gpu = 0
+        for c in self.containers:
+            cpu += milli_value(c.requests.get("cpu", 0)) if "cpu" in c.requests else 0
+            mem += value(c.requests.get("memory", 0)) if "memory" in c.requests else 0
+            gpu += value(c.requests.get("alpha.kubernetes.io/nvidia-gpu", 0)) \
+                if "alpha.kubernetes.io/nvidia-gpu" in c.requests else 0
+        return Resource(cpu, mem, gpu)
+
+    def non_zero_request(self) -> tuple[int, int]:
+        """GetNonzeroRequests summed over containers (non_zero.go:39-55):
+        containers with unset cpu/memory contribute 100 mCPU / 200 MiB."""
+        cpu = mem = 0
+        for c in self.containers:
+            cpu += milli_value(c.requests["cpu"]) if "cpu" in c.requests \
+                else DEFAULT_MILLI_CPU_REQUEST
+            mem += value(c.requests["memory"]) if "memory" in c.requests \
+                else DEFAULT_MEMORY_REQUEST
+        return cpu, mem
+
+    def is_best_effort(self) -> bool:
+        """qos.GetPodQOS == BestEffort (pkg/kubelet/qos/util/qos.go): no
+        container has any cpu/memory request or limit set."""
+        for c in self.containers:
+            for d in (c.requests, c.limits):
+                for r in ("cpu", "memory"):
+                    if r in d:
+                        return False
+        return True
+
+    def used_host_ports(self) -> set[int]:
+        """getUsedPorts (predicates.go:746-761); 0 excluded at check site."""
+        return {p.host_port for c in self.containers for p in c.ports
+                if p.host_port != 0}
+
+
+# non_zero.go:46-47
+DEFAULT_MILLI_CPU_REQUEST = 100
+DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024
+
+
+@dataclass(frozen=True)
+class NodeCondition:
+    type: str
+    status: str  # "True"/"False"/"Unknown"
+
+
+@dataclass(frozen=True)
+class ContainerImage:
+    names: tuple[str, ...]
+    size_bytes: int
+
+
+@dataclass
+class Node:
+    name: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    unschedulable: bool = False
+    # status.allocatable — what the scheduler budgets against
+    # (NodeInfo.AllocatableResource, node_info.go:245-255).
+    allocatable_milli_cpu: int = 0
+    allocatable_memory: int = 0
+    allocatable_gpu: int = 0
+    allocatable_pods: int = 110
+    conditions: list[NodeCondition] = field(default_factory=list)
+    images: list[ContainerImage] = field(default_factory=list)
+
+    def taints(self) -> list[Taint]:
+        """GetTaintsFromNodeAnnotations (pkg/api/helpers.go:490-505)."""
+        raw = self.annotations.get(TAINTS_ANNOTATION_KEY, "")
+        if not raw:
+            return []
+        return [Taint(key=t.get("key", ""), value=t.get("value", ""),
+                      effect=t.get("effect", "")) for t in json.loads(raw)]
+
+    def condition(self, ctype: str) -> Optional[str]:
+        for c in self.conditions:
+            if c.type == ctype:
+                return c.status
+        return None
+
+    def is_ready(self) -> bool:
+        """getNodeConditionPredicate (factory.go:436-462): Ready must be True,
+        OutOfDisk and NetworkUnavailable must not be True, not unschedulable."""
+        if self.unschedulable:
+            return False
+        for c in self.conditions:
+            if c.type == NODE_READY and c.status != "True":
+                return False
+            if c.type == NODE_OUT_OF_DISK and c.status == "True":
+                return False
+            if c.type == NODE_NETWORK_UNAVAILABLE and c.status == "True":
+                return False
+        return True
+
+    def zone_key(self) -> str:
+        """utilnode.GetZoneKey: region + ":\\x00:" + zone, "" if neither."""
+        region = self.labels.get(REGION_LABEL, "")
+        zone = self.labels.get(ZONE_LABEL, "")
+        if not region and not zone:
+            return ""
+        return region + ":\x00:" + zone
+
+
+@dataclass
+class Service:
+    name: str = ""
+    namespace: str = "default"
+    selector: dict[str, str] = field(default_factory=dict)  # empty = selects nothing
+
+
+@dataclass
+class ReplicationController:
+    name: str = ""
+    namespace: str = "default"
+    selector: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ReplicaSet:
+    name: str = ""
+    namespace: str = "default"
+    selector: Optional[LabelSelector] = None
+
+
+# ---------------------------------------------------------------------------
+# JSON decoding (the wire format the extender endpoint receives: versioned v1
+# api.Pod / api.NodeList JSON).
+# ---------------------------------------------------------------------------
+
+def _parse_node_selector_term(d: dict) -> NodeSelectorTerm:
+    exprs = tuple(
+        NodeSelectorRequirement(key=e.get("key", ""), operator=e.get("operator", ""),
+                                values=tuple(e.get("values") or ()))
+        for e in d.get("matchExpressions") or ())
+    return NodeSelectorTerm(match_expressions=exprs)
+
+
+def _parse_label_selector(d: Optional[dict]) -> Optional[LabelSelector]:
+    if d is None:
+        return None
+    return LabelSelector(
+        match_labels=tuple(sorted((d.get("matchLabels") or {}).items())),
+        match_expressions=tuple(
+            LabelSelectorRequirement(key=e.get("key", ""),
+                                     operator=e.get("operator", ""),
+                                     values=tuple(e.get("values") or ()))
+            for e in d.get("matchExpressions") or ()))
+
+
+def _parse_pod_affinity_term(d: dict) -> PodAffinityTerm:
+    return PodAffinityTerm(
+        label_selector=_parse_label_selector(d.get("labelSelector")),
+        namespaces=tuple(d.get("namespaces") or ()),
+        topology_key=d.get("topologyKey", ""))
+
+
+def _parse_affinity_json(d: dict) -> Affinity:
+    na = pa = paa = None
+    if d.get("nodeAffinity"):
+        n = d["nodeAffinity"]
+        req = None
+        if n.get("requiredDuringSchedulingIgnoredDuringExecution"):
+            req = NodeSelector(node_selector_terms=tuple(
+                _parse_node_selector_term(t) for t in
+                n["requiredDuringSchedulingIgnoredDuringExecution"]
+                .get("nodeSelectorTerms") or ()))
+        pref = tuple(
+            PreferredSchedulingTerm(weight=int(t.get("weight", 0)),
+                                    preference=_parse_node_selector_term(
+                                        t.get("preference") or {}))
+            for t in n.get("preferredDuringSchedulingIgnoredDuringExecution") or ())
+        na = NodeAffinity(required=req, preferred=pref)
+    if d.get("podAffinity"):
+        p = d["podAffinity"]
+        pa = PodAffinity(
+            required=tuple(_parse_pod_affinity_term(t) for t in
+                           p.get("requiredDuringSchedulingIgnoredDuringExecution") or ()),
+            preferred=tuple(
+                WeightedPodAffinityTerm(weight=int(t.get("weight", 0)),
+                                        pod_affinity_term=_parse_pod_affinity_term(
+                                            t.get("podAffinityTerm") or {}))
+                for t in p.get("preferredDuringSchedulingIgnoredDuringExecution") or ()))
+    if d.get("podAntiAffinity"):
+        p = d["podAntiAffinity"]
+        paa = PodAntiAffinity(
+            required=tuple(_parse_pod_affinity_term(t) for t in
+                           p.get("requiredDuringSchedulingIgnoredDuringExecution") or ()),
+            preferred=tuple(
+                WeightedPodAffinityTerm(weight=int(t.get("weight", 0)),
+                                        pod_affinity_term=_parse_pod_affinity_term(
+                                            t.get("podAffinityTerm") or {}))
+                for t in p.get("preferredDuringSchedulingIgnoredDuringExecution") or ()))
+    return Affinity(node_affinity=na, pod_affinity=pa, pod_anti_affinity=paa)
+
+
+def _parse_volume(v: dict) -> Volume:
+    out = Volume(name=v.get("name", ""))
+    if v.get("gcePersistentDisk"):
+        g = v["gcePersistentDisk"]
+        out = Volume(name=out.name, gce_pd_name=g.get("pdName", ""),
+                     gce_read_only=bool(g.get("readOnly", False)))
+    elif v.get("awsElasticBlockStore"):
+        a = v["awsElasticBlockStore"]
+        out = Volume(name=out.name, aws_ebs_id=a.get("volumeID", ""),
+                     aws_read_only=bool(a.get("readOnly", False)))
+    elif v.get("rbd"):
+        r = v["rbd"]
+        mons = ",".join(sorted(r.get("monitors") or ()))
+        out = Volume(name=out.name,
+                     rbd_key=f"{mons}#{r.get('pool', 'rbd')}#{r.get('image', '')}",
+                     rbd_read_only=bool(r.get("readOnly", False)))
+    elif v.get("iscsi"):
+        i = v["iscsi"]
+        out = Volume(name=out.name,
+                     iscsi_key=f"{i.get('iqn', '')}#{i.get('lun', 0)}",
+                     iscsi_read_only=bool(i.get("readOnly", False)))
+    elif v.get("nfs"):
+        n = v["nfs"]
+        out = Volume(name=out.name, nfs_key=f"{n.get('server', '')}#{n.get('path', '')}",
+                     nfs_read_only=bool(n.get("readOnly", False)))
+    elif v.get("persistentVolumeClaim"):
+        out = Volume(name=out.name,
+                     pvc_claim_name=v["persistentVolumeClaim"].get("claimName", ""))
+    return out
+
+
+def pod_from_json(d: dict) -> Pod:
+    """Decode a v1 api.Pod JSON object (as sent in ExtenderArgs.Pod)."""
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    containers = []
+    for c in spec.get("containers") or ():
+        res = c.get("resources") or {}
+        containers.append(Container(
+            name=c.get("name", ""), image=c.get("image", ""),
+            requests=dict(res.get("requests") or {}),
+            limits=dict(res.get("limits") or {}),
+            ports=[ContainerPort(host_port=int(p.get("hostPort", 0)),
+                                 container_port=int(p.get("containerPort", 0)),
+                                 protocol=p.get("protocol", "TCP"))
+                   for p in c.get("ports") or ()]))
+    return Pod(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid", ""),
+        labels=dict(meta.get("labels") or {}),
+        annotations=dict(meta.get("annotations") or {}),
+        node_name=spec.get("nodeName", ""),
+        node_selector=dict(spec.get("nodeSelector") or {}),
+        containers=containers,
+        volumes=[_parse_volume(v) for v in spec.get("volumes") or ()],
+        deletion_timestamp=1.0 if meta.get("deletionTimestamp") else None)
+
+
+def node_from_json(d: dict) -> Node:
+    """Decode a v1 api.Node JSON object (as sent in ExtenderArgs.Nodes)."""
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    status = d.get("status") or {}
+    alloc = status.get("allocatable") or status.get("capacity") or {}
+    return Node(
+        name=meta.get("name", ""),
+        labels=dict(meta.get("labels") or {}),
+        annotations=dict(meta.get("annotations") or {}),
+        unschedulable=bool(spec.get("unschedulable", False)),
+        allocatable_milli_cpu=milli_value(alloc["cpu"]) if "cpu" in alloc else 0,
+        allocatable_memory=value(alloc["memory"]) if "memory" in alloc else 0,
+        allocatable_gpu=value(alloc["alpha.kubernetes.io/nvidia-gpu"])
+        if "alpha.kubernetes.io/nvidia-gpu" in alloc else 0,
+        allocatable_pods=value(alloc["pods"]) if "pods" in alloc else 110,
+        conditions=[NodeCondition(type=c.get("type", ""), status=c.get("status", ""))
+                    for c in status.get("conditions") or ()],
+        images=[ContainerImage(names=tuple(i.get("names") or ()),
+                               size_bytes=int(i.get("sizeBytes", 0)))
+                for i in status.get("images") or ()])

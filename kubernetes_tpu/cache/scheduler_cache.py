@@ -1,0 +1,207 @@
+"""Tensor-backed scheduler cache.
+
+The reference's ``schedulerCache`` (plugin/pkg/scheduler/schedulercache/
+cache.go) keeps authoritative in-memory cluster state including *assumed*
+(optimistically bound, not yet confirmed) pods, with a TTL state machine:
+
+    AssumePod (cache.go:107) -> [confirm] AddPod (:160) -> UpdatePod -> RemovePod
+            \\-> ForgetPod (:135)        \\-> expire after TTL (:309-330)
+
+This class keeps the same state machine host-side, but the per-node
+aggregates live as the dense arrays the device kernels consume
+(``NodeAggregates``/``ExistingPodTensors``) and are updated incrementally —
+the tensor analogue of NodeInfo.addPod/removePod plus the generation-counter
+snapshotting of UpdateNodeNameToInfoMap (cache.go:77-91).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.features import compiler as fc
+
+DEFAULT_ASSUMED_POD_TTL = 30.0  # factory.go:102
+CLEANUP_PERIOD = 1.0            # cache.go:31
+
+
+@dataclass
+class _PodState:
+    pod: api.Pod
+    assumed: bool
+    deadline: Optional[float]  # expiry for assumed pods
+
+
+class SchedulerCache:
+    """Cache interface parity (schedulercache/interface.go:38-93)."""
+
+    def __init__(self, space: Optional[fc.FeatureSpace] = None,
+                 ttl: float = DEFAULT_ASSUMED_POD_TTL,
+                 now: Callable[[], float] = time.monotonic):
+        self.space = space or fc.FeatureSpace()
+        self.ttl = ttl
+        self._now = now
+        self._nodes: dict[str, api.Node] = {}
+        self._node_order: list[str] = []
+        self._pod_states: dict[str, _PodState] = {}
+        self._node_pods: dict[str, dict[str, api.Pod]] = {}
+        self._nt: Optional[fc.NodeTensors] = None
+        self._agg: Optional[fc.NodeAggregates] = None
+        self._ep: Optional[fc.ExistingPodTensors] = None
+        self._dirty_nodes = True
+        self.generation = 0
+
+    # ---- node lifecycle (cache.go:263-307) ----------------------------
+
+    def add_node(self, node: api.Node) -> None:
+        self._nodes[node.name] = node
+        if node.name not in self._node_pods:
+            self._node_pods[node.name] = {}
+        self._mark_nodes_dirty()
+
+    def update_node(self, node: api.Node) -> None:
+        self._nodes[node.name] = node
+        if node.name not in self._node_pods:
+            self._node_pods[node.name] = {}
+        self._mark_nodes_dirty()
+
+    def remove_node(self, name: str) -> None:
+        self._nodes.pop(name, None)
+        # Pods on the node stay tracked (the reference keeps them until their
+        # own delete events arrive); their rows rebuild against the new order.
+        self._mark_nodes_dirty()
+
+    def _mark_nodes_dirty(self) -> None:
+        self._dirty_nodes = True
+        self.generation += 1
+
+    # ---- pod state machine --------------------------------------------
+
+    def assume_pod(self, pod: api.Pod, node_name: str) -> None:
+        """AssumePod (cache.go:107-133): optimistic placement with TTL."""
+        key = pod.key
+        if key in self._pod_states:
+            raise ValueError(f"pod {key} already in cache")
+        pod.node_name = node_name
+        self._pod_states[key] = _PodState(
+            pod=pod, assumed=True, deadline=self._now() + self.ttl)
+        self._attach(pod, node_name)
+
+    def forget_pod(self, pod: api.Pod) -> None:
+        """ForgetPod (cache.go:135-158): only assumed pods may be forgotten."""
+        key = pod.key
+        st = self._pod_states.get(key)
+        if st is None or not st.assumed:
+            raise ValueError(f"pod {key} not assumed")
+        self._detach(st.pod)
+        del self._pod_states[key]
+
+    def add_pod(self, pod: api.Pod) -> None:
+        """AddPod (cache.go:160-186): confirm an assumed pod (clearing its
+        TTL) or ingest an already-bound pod seen via watch."""
+        key = pod.key
+        st = self._pod_states.get(key)
+        if st is not None:
+            # Confirm an assumed pod (possibly bound to a different node than
+            # assumed) or refresh a duplicate add: replace the old attachment.
+            self._detach(st.pod)
+        self._attach(pod, pod.node_name)
+        self._pod_states[key] = _PodState(pod=pod, assumed=False, deadline=None)
+
+    def update_pod(self, old: api.Pod, new: api.Pod) -> None:
+        """UpdatePod (cache.go:188-206)."""
+        st = self._pod_states.get(old.key)
+        if st is not None:
+            self._detach(st.pod)
+        self._attach(new, new.node_name)
+        self._pod_states[new.key] = _PodState(pod=new, assumed=False, deadline=None)
+
+    def remove_pod(self, pod: api.Pod) -> None:
+        """RemovePod (cache.go:208-230)."""
+        st = self._pod_states.pop(pod.key, None)
+        if st is not None:
+            self._detach(st.pod)
+
+    def cleanup_expired(self, now: Optional[float] = None) -> list[str]:
+        """cleanupAssumedPods (cache.go:309-330): expire stale assumed pods."""
+        now = self._now() if now is None else now
+        expired = [k for k, st in self._pod_states.items()
+                   if st.assumed and st.deadline is not None and st.deadline <= now]
+        for k in expired:
+            self._detach(self._pod_states[k].pod)
+            del self._pod_states[k]
+        return expired
+
+    def is_assumed(self, key: str) -> bool:
+        st = self._pod_states.get(key)
+        return st is not None and st.assumed
+
+    def pod_count(self) -> int:
+        return len(self._pod_states)
+
+    def nodes(self) -> list[api.Node]:
+        self._ensure_tensors()
+        return [self._nodes[n] for n in self._node_order]
+
+    def node_pods(self, node_name: str) -> list[api.Pod]:
+        return list(self._node_pods.get(node_name, {}).values())
+
+    # ---- tensor maintenance -------------------------------------------
+
+    def _attach(self, pod: api.Pod, node_name: str) -> None:
+        if not node_name:
+            return
+        self._node_pods.setdefault(node_name, {})[pod.key] = pod
+        if not self._dirty_nodes and self._nt is not None:
+            idx = self._nt.name_to_idx.get(node_name)
+            if idx is None:
+                # Pod bound to a node we haven't seen; full rebuild on demand.
+                self._mark_nodes_dirty()
+                return
+            self._agg = fc.add_pod_to_aggregates(self._agg, idx, pod, self.space)
+            self._ep = fc.existing_pods_add(self._ep, pod, idx, self.space)
+        self.generation += 1
+
+    def _detach(self, pod: api.Pod) -> None:
+        node_name = pod.node_name
+        if not node_name:
+            return
+        pods = self._node_pods.get(node_name, {})
+        pods.pop(pod.key, None)
+        if not self._dirty_nodes and self._nt is not None:
+            idx = self._nt.name_to_idx.get(node_name)
+            if idx is not None:
+                self._agg = fc.remove_pod_from_aggregates(
+                    self._agg, idx, pod, self.space, list(pods.values()))
+                self._ep = fc.existing_pods_remove(self._ep, pod.key)
+        self.generation += 1
+
+    def _ensure_tensors(self) -> None:
+        if not self._dirty_nodes and self._nt is not None:
+            return
+        self._node_order = list(self._nodes.keys())
+        self._nt = fc.compile_nodes(
+            [self._nodes[n] for n in self._node_order], self.space)
+        self._agg = fc.empty_aggregates(len(self._node_order), self.space)
+        self._ep = fc.empty_existing_pods(self.space)
+        for name, pods in self._node_pods.items():
+            idx = self._nt.name_to_idx.get(name)
+            if idx is None:
+                continue
+            for pod in pods.values():
+                self._agg = fc.add_pod_to_aggregates(self._agg, idx, pod, self.space)
+                self._ep = fc.existing_pods_add(self._ep, pod, idx, self.space)
+        self._dirty_nodes = False
+
+    def snapshot(self) -> tuple[fc.NodeTensors, fc.NodeAggregates,
+                                fc.ExistingPodTensors, list[api.Node]]:
+        """Current tensor view (UpdateNodeNameToInfoMap analogue).  The
+        returned aggregates are referenced, not copied — callers must not
+        mutate them."""
+        self._ensure_tensors()
+        # Existing-pod label matrix may lag vocab growth from newly seen pods.
+        self._ep.labels = fc._grow_cols(self._ep.labels, self.space.labels.capacity)
+        return self._nt, self._agg, self._ep, \
+            [self._nodes[n] for n in self._node_order]

@@ -1,0 +1,145 @@
+"""Host orchestration: the batched counterpart of genericScheduler.Schedule
+(generic_scheduler.go:78-122).
+
+``GenericScheduler`` owns a Solver (compiled policy), the tensor cache, and
+the cluster-object listers (services/RCs/RSs for spreading, per
+selector_spreading.go:70-86).  ``schedule()`` places one pod (decision
+parity path); ``schedule_batch()`` places a whole pending queue in one
+device solve (the TPU win).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.policy import Policy, default_provider
+from kubernetes_tpu.cache.scheduler_cache import SchedulerCache
+from kubernetes_tpu.engine import solver as sv
+from kubernetes_tpu.features import batch as fb
+from kubernetes_tpu.utils.trace import Trace
+
+
+class FitError(Exception):
+    """No node fits (generic_scheduler.go:39-61). failed_predicates maps
+    node name -> list of failing predicate names."""
+
+    def __init__(self, pod: api.Pod, failed_predicates: dict[str, list[str]]):
+        self.pod = pod
+        self.failed_predicates = failed_predicates
+        super().__init__(f"pod ({pod.name}) failed to fit in any node")
+
+
+@dataclass
+class Listers:
+    """In-memory cluster-object stores standing in for the reference's
+    reflector-backed caches (factory.go:387-416)."""
+
+    services: list[api.Service] = field(default_factory=list)
+    controllers: list[api.ReplicationController] = field(default_factory=list)
+    replica_sets: list[api.ReplicaSet] = field(default_factory=list)
+
+    def spread_selectors(self, pod: api.Pod) -> list:
+        """GetPodServices/GetPodControllers/GetPodReplicaSets
+        (pkg/client/cache/listers.go): same namespace, empty selectors match
+        nothing, unlabeled pods match no RC/RS."""
+        out: list = []
+        for s in self.services:
+            if s.namespace == pod.namespace and s.selector and \
+                    all(pod.labels.get(k) == v for k, v in s.selector.items()):
+                out.append(s.selector)
+        if pod.labels:
+            for rc in self.controllers:
+                if rc.namespace == pod.namespace and rc.selector and \
+                        all(pod.labels.get(k) == v for k, v in rc.selector.items()):
+                    out.append(rc.selector)
+            for rs in self.replica_sets:
+                if rs.namespace == pod.namespace and rs.selector is not None:
+                    if (rs.selector.match_labels or rs.selector.match_expressions) \
+                            and rs.selector.matches(pod.labels):
+                        out.append(rs.selector)
+        return out
+
+    def controller_refs(self, pod: api.Pod) -> list:
+        """Controller signatures for NodePreferAvoidPods (priorities.go:340-342).
+        UIDs are modeled as 'namespace/name'."""
+        out = []
+        if pod.labels:
+            for rc in self.controllers:
+                if rc.namespace == pod.namespace and rc.selector and \
+                        all(pod.labels.get(k) == v for k, v in rc.selector.items()):
+                    out.append(("ReplicationController", f"{rc.namespace}/{rc.name}"))
+            for rs in self.replica_sets:
+                if rs.namespace == pod.namespace and rs.selector is not None:
+                    if (rs.selector.match_labels or rs.selector.match_expressions) \
+                            and rs.selector.matches(pod.labels):
+                        out.append(("ReplicaSet", f"{rs.namespace}/{rs.name}"))
+        return out
+
+
+class GenericScheduler:
+    def __init__(self, policy: Policy | None = None,
+                 cache: SchedulerCache | None = None,
+                 listers: Listers | None = None):
+        self.policy = policy or default_provider()
+        self.cache = cache or SchedulerCache()
+        self.listers = listers or Listers()
+        self.solver = sv.Solver(self.policy)
+        self.last_node_index = np.uint32(0)
+
+    # -- compilation helpers --------------------------------------------
+
+    def _compile(self, pods: list[api.Pod]) -> tuple[fb.PodBatch, sv.DeviceBatch,
+                                                     sv.DeviceCluster, list[str]]:
+        nt, agg, ep, nodes = self.cache.snapshot()
+        batch = fb.compile_batch(
+            pods, nt, self.cache.space, ep=ep, nodes=nodes,
+            spread_selectors=self.listers.spread_selectors,
+            controller_refs=self.listers.controller_refs)
+        db = sv.device_batch(batch)
+        dc = sv.device_cluster(nt, agg, self.cache.space)
+        return batch, db, dc, nt
+
+    # -- single-pod path (Schedule, generic_scheduler.go:78) -------------
+
+    def schedule(self, pod: api.Pod) -> str:
+        trace = Trace(f"Scheduling {pod.namespace}/{pod.name}")
+        batch, db, dc, nt = self._compile([pod])
+        trace.step("Computing predicates & priorities")
+        feasible, scores = self.solver.evaluate(db, dc)
+        trace.step("Selecting host")
+        feasible_np = np.asarray(feasible[0])
+        if not feasible_np.any():
+            masks = {k: np.asarray(v[0]) for k, v in
+                     self.solver.masks(db, dc).items()}
+            failed: dict[str, list[str]] = {}
+            for i, name in enumerate(nt.names):
+                if nt.schedulable[i]:
+                    failed[name] = [p for p, m in masks.items() if not m[i]]
+            trace.log_if_long()
+            raise FitError(pod, failed)
+        choice, new_last = sv.combine.select_hosts(
+            scores, feasible, jnp.uint32(self.last_node_index))
+        self.last_node_index = np.uint32(new_last)
+        trace.log_if_long()
+        return nt.names[int(choice[0])]
+
+    # -- batched path ----------------------------------------------------
+
+    def schedule_batch(self, pods: list[api.Pod]) -> list[str | None]:
+        """Place a pending queue in order with full sequential visibility
+        (each placement is seen by all later pods).  Returns node names,
+        None where unschedulable."""
+        if not pods:
+            return []
+        batch, db, dc, nt = self._compile(pods)
+        choices, new_last, _ = self.solver.solve_sequential(
+            db, dc, jnp.uint32(self.last_node_index))
+        self.last_node_index = np.uint32(new_last)
+        out: list[str | None] = []
+        for c in np.asarray(choices):
+            out.append(nt.names[int(c)] if c >= 0 else None)
+        return out

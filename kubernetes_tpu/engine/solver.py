@@ -1,0 +1,360 @@
+"""The device solver: policy -> jitted mask/score/assign computation.
+
+Two entry points:
+
+``evaluate``
+    One-shot batched evaluation of every (pod, node) pair against the
+    *current* cluster state — the tensor equivalent of running the
+    reference's findNodesThatFit + PrioritizeNodes once per pod
+    (generic_scheduler.go:145-314), for the whole batch at once.  Used by the
+    extender Filter/Prioritize verbs and as the building block of the solvers.
+
+``solve_sequential``
+    Greedy sequential assignment under ``lax.scan``: pods are placed in queue
+    order and every placement updates device-resident aggregates (requested
+    resources, host ports, volume mounts, spreading counts) before the next
+    pod is scored — bit-for-bit the visibility the reference's scheduler gets
+    through its assumed-pod cache (scheduler.go:116-120, cache.go:107).  The
+    expensive O(P*N*V) contractions are hoisted out of the scan (they are
+    placement-invariant); only O(N) resource math recomputes per step.
+
+Both are pure jit-compatible functions of arrays; the node axis may be
+sharded across a mesh (see kubernetes_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.api.policy import Policy, expand_predicates
+from kubernetes_tpu.features.batch import PodBatch
+from kubernetes_tpu.features.compiler import (FeatureSpace, NodeAggregates,
+                                              NodeTensors, RES_CPU, RES_MEM,
+                                              RES_PODS)
+from kubernetes_tpu.ops import combine, predicates as pr, priorities as prio
+
+# Predicates whose masks do not depend on in-batch placements.
+STATIC_PREDICATES = ("PodFitsHost", "MatchNodeSelector", "HostName",
+                     "PodToleratesNodeTaints", "CheckNodeMemoryPressure",
+                     "CheckNodeDiskPressure", "NewNodeLabelPredicate")
+# Implemented dynamic predicates.
+DYNAMIC_PREDICATES = ("PodFitsResources", "PodFitsHostPorts", "PodFitsPorts",
+                      "NoDiskConflict")
+# Recognized but not yet tensorized: evaluated as pass-through (tracked so
+# callers can surface the gap).  NoVolumeZoneConflict / MaxPD need PV/PVC
+# listers; MatchInterPodAffinity lands with the affinity kernels.
+PASSTHROUGH_PREDICATES = ("NoVolumeZoneConflict", "MaxEBSVolumeCount",
+                          "MaxGCEPDVolumeCount", "MatchInterPodAffinity",
+                          "ServiceAffinity")
+
+STATIC_PRIORITIES = ("NodeAffinityPriority", "TaintTolerationPriority",
+                     "ImageLocalityPriority", "NodePreferAvoidPodsPriority",
+                     "EqualPriority", "NodeLabelPriority")
+DYNAMIC_PRIORITIES = ("LeastRequestedPriority", "MostRequestedPriority",
+                      "BalancedResourceAllocation", "SelectorSpreadPriority",
+                      "ServiceSpreadingPriority")
+PASSTHROUGH_PRIORITIES = ("InterPodAffinityPriority", "ServiceAntiAffinityPriority")
+
+
+class DeviceBatch(NamedTuple):
+    """PodBatch as device arrays (order mirrors features.batch.PodBatch)."""
+
+    request: jnp.ndarray
+    zero_request: jnp.ndarray
+    nonzero: jnp.ndarray
+    best_effort: jnp.ndarray
+    host_idx: jnp.ndarray
+    ports: jnp.ndarray
+    vol_ro: jnp.ndarray
+    vol_rw: jnp.ndarray
+    tol_nosched: jnp.ndarray
+    tol_prefer: jnp.ndarray
+    has_tolerations: jnp.ndarray
+    images: jnp.ndarray
+    sel_group: jnp.ndarray
+    sel_required: jnp.ndarray
+    sel_pref_counts: jnp.ndarray
+    spread_group: jnp.ndarray
+    spread_node_counts: jnp.ndarray
+    spread_zone_counts: jnp.ndarray
+    spread_has_zones: jnp.ndarray
+    spread_incr: jnp.ndarray
+    node_zone_id: jnp.ndarray
+    avoid_mask: jnp.ndarray
+
+
+class DeviceCluster(NamedTuple):
+    schedulable: jnp.ndarray    # [N] bool — getNodeConditionPredicate
+    alloc: jnp.ndarray          # [N,4] int32
+    requested: jnp.ndarray      # [N,4] int32
+    nonzero: jnp.ndarray        # [N,2] int32
+    ports_used: jnp.ndarray     # [N,C] bool
+    vol_any: jnp.ndarray        # [N,W] bool
+    vol_rw: jnp.ndarray         # [N,W] bool
+    taints_nosched: jnp.ndarray  # [N,T] bool
+    taints_prefer: jnp.ndarray  # [N,T] bool
+    has_taints: jnp.ndarray     # [N] bool — any taint incl. PreferNoSchedule
+    mem_pressure: jnp.ndarray   # [N] bool
+    disk_pressure: jnp.ndarray  # [N] bool
+    image_kib: jnp.ndarray      # [N,I] int32
+
+
+def _pad_cols(a: np.ndarray, width: int) -> np.ndarray:
+    if a.shape[1] == width:
+        return a
+    out = np.zeros((a.shape[0], width), a.dtype)
+    out[:, : a.shape[1]] = a
+    return out
+
+
+def device_batch(b: PodBatch) -> DeviceBatch:
+    return DeviceBatch(*[jnp.asarray(getattr(b, f)) for f in DeviceBatch._fields])
+
+
+def device_cluster(nt: NodeTensors, agg: NodeAggregates,
+                   space: FeatureSpace) -> DeviceCluster:
+    """Assemble device cluster state, padding aggregate columns to current
+    vocabulary capacities (pods may have interned new ports/volumes)."""
+    return DeviceCluster(
+        schedulable=jnp.asarray(nt.schedulable),
+        alloc=jnp.asarray(nt.alloc),
+        requested=jnp.asarray(agg.requested),
+        nonzero=jnp.asarray(agg.nonzero),
+        ports_used=jnp.asarray(_pad_cols(agg.ports_used, space.ports.capacity)),
+        vol_any=jnp.asarray(_pad_cols(agg.vol_any, space.volumes.capacity)),
+        vol_rw=jnp.asarray(_pad_cols(agg.vol_rw, space.volumes.capacity)),
+        taints_nosched=jnp.asarray(nt.taints_nosched),
+        taints_prefer=jnp.asarray(nt.taints_prefer),
+        has_taints=jnp.asarray(nt.taints_nosched.any(1) | nt.taints_prefer.any(1)),
+        mem_pressure=jnp.asarray(nt.mem_pressure),
+        disk_pressure=jnp.asarray(nt.disk_pressure),
+        image_kib=jnp.asarray(_pad_cols(nt.image_kib, space.images.capacity)))
+
+
+def _predicate_mask(name: str, b: DeviceBatch, c: DeviceCluster,
+                    n_nodes: int, extra: dict) -> jnp.ndarray:
+    p = b.request.shape[0]
+    if name in ("PodFitsHost", "HostName"):
+        return pr.pod_fits_host(b.host_idx, n_nodes)
+    if name == "MatchNodeSelector":
+        return pr.pod_selector_matches(b.sel_group, b.sel_required)
+    if name == "PodToleratesNodeTaints":
+        return pr.pod_tolerates_node_taints(b.tol_nosched, b.has_tolerations,
+                                            c.taints_nosched, c.has_taints)
+    if name == "CheckNodeMemoryPressure":
+        return pr.check_node_memory_pressure(b.best_effort, c.mem_pressure)
+    if name == "CheckNodeDiskPressure":
+        return pr.check_node_disk_pressure(p, c.disk_pressure)
+    if name == "NewNodeLabelPredicate":
+        return pr.node_label_presence(p, extra["node_label_row"])
+    if name == "PodFitsResources":
+        return pr.pod_fits_resources(b.request, b.zero_request, c.alloc,
+                                     c.requested)
+    if name in ("PodFitsHostPorts", "PodFitsPorts"):
+        return pr.pod_fits_host_ports(b.ports, c.ports_used)
+    if name == "NoDiskConflict":
+        return pr.no_disk_conflict(b.vol_rw, b.vol_ro, c.vol_any, c.vol_rw)
+    if name in PASSTHROUGH_PREDICATES:
+        return jnp.ones((p, n_nodes), bool)
+    raise KeyError(f"unknown predicate {name!r}")
+
+
+def _priority_plane(name: str, b: DeviceBatch, c: DeviceCluster,
+                    n_nodes: int, extra: dict) -> jnp.ndarray:
+    p = b.request.shape[0]
+    if name == "LeastRequestedPriority":
+        return prio.least_requested(b.nonzero, c.nonzero, c.alloc)
+    if name == "MostRequestedPriority":
+        return prio.most_requested(b.nonzero, c.nonzero, c.alloc)
+    if name == "BalancedResourceAllocation":
+        return prio.balanced_resource_allocation(b.nonzero, c.nonzero, c.alloc)
+    if name == "NodeAffinityPriority":
+        return prio.node_affinity(b.sel_group, b.sel_pref_counts)
+    if name == "TaintTolerationPriority":
+        return prio.taint_toleration(b.tol_prefer, c.taints_prefer)
+    if name == "ImageLocalityPriority":
+        return prio.image_locality(b.images, c.image_kib)
+    if name == "NodePreferAvoidPodsPriority":
+        return prio.node_prefer_avoid(b.avoid_mask)
+    if name in ("SelectorSpreadPriority", "ServiceSpreadingPriority"):
+        return prio.selector_spread(b.spread_group, b.spread_node_counts,
+                                    b.spread_zone_counts, b.spread_has_zones,
+                                    b.node_zone_id)
+    if name == "NodeLabelPriority":
+        return prio.node_label(p, extra["node_label_prio_row"])
+    if name == "EqualPriority":
+        return prio.equal_priority(p, n_nodes)
+    if name in PASSTHROUGH_PRIORITIES:
+        return jnp.zeros((p, n_nodes), jnp.float32)
+    raise KeyError(f"unknown priority {name!r}")
+
+
+class Solver:
+    """Compiles a Policy into jitted evaluate / sequential-solve callables."""
+
+    def __init__(self, policy: Policy):
+        self.policy = policy
+        self.predicate_names = tuple(p.name for p in expand_predicates(policy))
+        self.priority_specs = tuple((s.name, s.weight) for s in policy.priorities
+                                    if s.weight != 0)
+        self.passthrough = tuple(n for n in self.predicate_names
+                                 if n in PASSTHROUGH_PREDICATES)
+
+    # -- one-shot batched evaluation ------------------------------------
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def masks(self, b: DeviceBatch, c: DeviceCluster) -> dict[str, jnp.ndarray]:
+        """Per-predicate [P,N] masks (for Filter verbs / failure reporting)."""
+        n = c.alloc.shape[0]
+        return {name: _predicate_mask(name, b, c, n, {})
+                for name in self.predicate_names}
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def evaluate(self, b: DeviceBatch, c: DeviceCluster
+                 ) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """(feasible [P,N] bool, scores [P,N] f32) against current state."""
+        n = c.alloc.shape[0]
+        # Unready nodes are filtered before scheduling (factory.go:436-462).
+        feasible = jnp.broadcast_to(c.schedulable[None, :],
+                                    (b.request.shape[0], n))
+        for name in self.predicate_names:
+            feasible &= _predicate_mask(name, b, c, n, {})
+        scores = jnp.zeros((b.request.shape[0], n), jnp.float32)
+        for name, weight in self.priority_specs:
+            scores += jnp.float32(weight) * _priority_plane(name, b, c, n, {})
+        return feasible, scores
+
+    # -- sequential greedy solve ----------------------------------------
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def solve_sequential(self, b: DeviceBatch, c: DeviceCluster,
+                         last_node_index: jnp.ndarray
+                         ) -> tuple[jnp.ndarray, jnp.ndarray, DeviceCluster]:
+        """Greedy in-order placement with on-device state updates.
+
+        Returns (choices [P] int32 node index or -1, new last_node_index,
+        updated cluster aggregates).
+        """
+        n = c.alloc.shape[0]
+        p = b.request.shape[0]
+
+        # Hoist placement-invariant work: static predicate masks and static
+        # priority planes are the big vocab contractions.
+        static_mask = jnp.broadcast_to(c.schedulable[None, :], (p, n))
+        for name in self.predicate_names:
+            if name not in DYNAMIC_PREDICATES:
+                static_mask &= _predicate_mask(name, b, c, n, {})
+        # Dynamic predicates run inside the scan, but only those the policy
+        # actually configures (evaluate() and the reference honor the policy).
+        use_resources = "PodFitsResources" in self.predicate_names
+        use_ports = any(nm in self.predicate_names
+                        for nm in ("PodFitsHostPorts", "PodFitsPorts"))
+        use_volumes = "NoDiskConflict" in self.predicate_names
+        static_score = jnp.zeros((p, n), jnp.float32)
+        dynamic_prios = []
+        for name, weight in self.priority_specs:
+            if name in DYNAMIC_PRIORITIES:
+                dynamic_prios.append((name, weight))
+            else:
+                static_score += jnp.float32(weight) * \
+                    _priority_plane(name, b, c, n, {})
+        dynamic_prios = tuple(dynamic_prios)
+
+        fits_pods_alloc = c.alloc[:, RES_PODS]
+        zone_ids = b.node_zone_id  # [N]
+
+        def step(state, xs):
+            (requested, nonzero, ports_used, vol_any, vol_rw,
+             sp_node, sp_zone, counter) = state
+            (req_i, zero_i, nz_i, ports_i, vro_i, vrw_i, smask_i, sscore_i,
+             sgroup_i, incr_i) = xs
+
+            # Dynamic predicates on current aggregates (predicates.go:444-485,
+            # :721-741, :100-153) — O(N) per step.
+            feasible = smask_i
+            if use_resources:
+                fits_pods = (requested[:, RES_PODS] + 1) <= fits_pods_alloc
+                free = c.alloc[:, :3] - requested[:, :3]
+                fits_res = jnp.all(req_i[None, :3] <= free, axis=-1)
+                feasible &= fits_pods & (zero_i | fits_res)
+            if use_ports:
+                port_conflict = jnp.einsum(
+                    "c,nc->n", ports_i.astype(jnp.float32),
+                    ports_used.astype(jnp.float32)) > 0
+                feasible &= ~port_conflict
+            if use_volumes:
+                vol_conflict = (
+                    jnp.einsum("w,nw->n", vrw_i.astype(jnp.float32),
+                               vol_any.astype(jnp.float32)) +
+                    jnp.einsum("w,nw->n", vro_i.astype(jnp.float32),
+                               vol_rw.astype(jnp.float32))) > 0
+                feasible &= ~vol_conflict
+
+            # Dynamic priorities against current aggregates.
+            score = sscore_i
+            for name, weight in dynamic_prios:
+                w = jnp.float32(weight)
+                if name == "LeastRequestedPriority":
+                    score = score + w * prio.least_requested(
+                        nz_i[None], nonzero, c.alloc)[0]
+                elif name == "MostRequestedPriority":
+                    score = score + w * prio.most_requested(
+                        nz_i[None], nonzero, c.alloc)[0]
+                elif name == "BalancedResourceAllocation":
+                    score = score + w * prio.balanced_resource_allocation(
+                        nz_i[None], nonzero, c.alloc)[0]
+                elif name in ("SelectorSpreadPriority", "ServiceSpreadingPriority"):
+                    score = score + w * prio.selector_spread(
+                        sgroup_i[None], sp_node, sp_zone,
+                        jnp.asarray(b.spread_has_zones), zone_ids)[0]
+
+            # selectHost (generic_scheduler.go:124-141): round-robin among
+            # max-score feasible nodes; counter bumps only on success.
+            neg = jnp.float32(-jnp.inf)
+            masked = jnp.where(feasible, score, neg)
+            max_score = jnp.max(masked)
+            any_feasible = jnp.any(feasible)
+            ties = feasible & (masked == max_score)
+            n_ties = jnp.maximum(jnp.sum(ties), 1)
+            ix = (counter % n_ties.astype(jnp.uint32)).astype(jnp.int32)
+            rank = jnp.cumsum(ties.astype(jnp.int32)) - 1
+            choice = jnp.argmax(ties & (rank == ix)).astype(jnp.int32)
+            choice = jnp.where(any_feasible, choice, -1)
+
+            # Commit: the batched AssumePod (cache.go:107).
+            placed = choice >= 0
+            onehot = (jnp.arange(n, dtype=jnp.int32) == choice) & placed
+            oh_i = onehot.astype(jnp.int32)
+            oh_f = onehot.astype(jnp.float32)
+            requested = requested + oh_i[:, None] * req_i[None, :]
+            nonzero = nonzero + oh_i[:, None] * nz_i[None, :]
+            ports_used = ports_used | (onehot[:, None] & ports_i[None, :])
+            vol_any = vol_any | (onehot[:, None] & (vrw_i | vro_i)[None, :])
+            vol_rw = vol_rw | (onehot[:, None] & vrw_i[None, :])
+            sp_node = sp_node + incr_i.astype(jnp.float32)[:, None] * oh_f[None, :]
+            zid = jnp.where(placed, zone_ids[jnp.clip(choice, 0)], -1)
+            zoh = (jnp.arange(sp_zone.shape[1], dtype=jnp.int32) == zid)
+            sp_zone = sp_zone + incr_i.astype(jnp.float32)[:, None] * \
+                zoh.astype(jnp.float32)[None, :]
+            counter = counter + jnp.where(any_feasible, jnp.uint32(1),
+                                          jnp.uint32(0))
+            return (requested, nonzero, ports_used, vol_any, vol_rw,
+                    sp_node, sp_zone, counter), choice
+
+        init = (c.requested, c.nonzero, c.ports_used, c.vol_any, c.vol_rw,
+                jnp.asarray(b.spread_node_counts),
+                jnp.asarray(b.spread_zone_counts), last_node_index)
+        xs = (b.request, b.zero_request, b.nonzero, b.ports, b.vol_ro,
+              b.vol_rw, static_mask, static_score, b.spread_group,
+              b.spread_incr)
+        (requested, nonzero, ports_used, vol_any, vol_rw, _, _, counter), \
+            choices = jax.lax.scan(step, init, xs)
+        new_c = c._replace(requested=requested, nonzero=nonzero,
+                           ports_used=ports_used, vol_any=vol_any,
+                           vol_rw=vol_rw)
+        return choices, counter, new_c

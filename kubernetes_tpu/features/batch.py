@@ -1,0 +1,420 @@
+"""Pod batch compilation: a pending queue -> dense [P, ...] tensors plus
+deduplicated selector-group tables.
+
+Pods from the same controller share identical node selectors / affinity /
+service membership, so per-pod selector evaluation is deduplicated into G
+small "groups"; the per-group [G, N] tables are computed once per batch and
+gathered per pod on device.  This is the batched analogue of the reference's
+per-pod ``predicateMetadata`` precompute (predicates.go:70-98).
+
+Group tables are built host-side in vectorized numpy over the node label
+multi-hot matrix; the [P, N] hot path stays on TPU.  For the sequential
+device solver, spreading state is carried as (per-node counts [S,N],
+per-zone counts [S,Z]) together with an in-batch increment matrix [P,S]
+saying which groups' counts grow when pod ``i`` lands.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.features import compiler as fc
+
+
+@dataclass
+class PodBatch:
+    """Dense per-pod features for one scheduling batch."""
+
+    pods: list[api.Pod]
+    request: np.ndarray        # [P, 4] int32
+    zero_request: np.ndarray   # [P] bool — cpu==mem==gpu==0 (predicates.go:463)
+    nonzero: np.ndarray        # [P, 2] int32
+    best_effort: np.ndarray    # [P] bool
+    host_idx: np.ndarray       # [P] int32: -1 no constraint, -2 unknown node name
+    ports: np.ndarray          # [P, PortCap] bool
+    vol_ro: np.ndarray         # [P, VolCap] bool — read-only conflict tokens
+    vol_rw: np.ndarray         # [P, VolCap] bool — writable conflict tokens
+    tol_nosched: np.ndarray    # [P, TaintCap] bool — vocab taints tolerated
+    tol_prefer: np.ndarray     # [P, TaintCap] bool — PreferNoSchedule tolerated
+    has_tolerations: np.ndarray  # [P] bool — pod declares any toleration
+    images: np.ndarray         # [P, ImgCap] int32 — per-container multiplicity
+    sel_group: np.ndarray      # [P] int32 into selector group tables
+    sel_required: np.ndarray   # [G, N] bool — nodeSelector+required affinity
+    sel_pref_counts: np.ndarray  # [G, N] int32 — preferred-term weight sums
+    spread_group: np.ndarray   # [P] int32 into spread tables
+    spread_node_counts: np.ndarray  # [S, N] f32 — matching pods per node
+    spread_zone_counts: np.ndarray  # [S, Z] f32 — matching pods per zone
+    spread_has_zones: np.ndarray    # [S] bool — haveZones for the group
+    spread_incr: np.ndarray    # [P, S] bool — placing pod i increments group s
+    node_zone_id: np.ndarray   # [N] int32 — compact zone id, -1 = no zone
+    avoid_mask: np.ndarray     # [P, N] bool — NodePreferAvoidPods hit
+
+    @property
+    def p(self) -> int:
+        return len(self.pods)
+
+
+def _term_mask(term: api.NodeSelectorTerm, nt: fc.NodeTensors,
+               space: fc.FeatureSpace,
+               nodes: Optional[Sequence[api.Node]]) -> np.ndarray:
+    """[N] bool — one NodeSelectorTerm (AND of exprs), per labels.Requirement
+    semantics (pkg/labels/selector.go).  Empty/invalid exprs match nothing
+    (predicates.go:520-525, :495)."""
+    n = nt.labels.shape[0]
+    if not term.match_expressions:
+        return np.zeros(n, bool)
+    mask = np.ones(n, bool)
+    for e in term.match_expressions:
+        if e.operator == api.NS_OP_IN:
+            ids = [space.labels.kv_get(e.key, v) for v in e.values]
+            ids = [i for i in ids if i >= 0]
+            sat = nt.labels[:, ids].any(1) if ids else np.zeros(n, bool)
+        elif e.operator == api.NS_OP_NOT_IN:
+            ids = [space.labels.kv_get(e.key, v) for v in e.values]
+            ids = [i for i in ids if i >= 0]
+            sat = ~nt.labels[:, ids].any(1) if ids else np.ones(n, bool)
+        elif e.operator == api.NS_OP_EXISTS:
+            kid = space.labels.key_get(e.key)
+            sat = nt.labels[:, kid] if kid >= 0 else np.zeros(n, bool)
+        elif e.operator == api.NS_OP_DOES_NOT_EXIST:
+            kid = space.labels.key_get(e.key)
+            sat = ~nt.labels[:, kid] if kid >= 0 else np.ones(n, bool)
+        elif e.operator in (api.NS_OP_GT, api.NS_OP_LT) and nodes is not None:
+            # Numeric compare on the raw label value (rare; host loop).
+            sat = np.zeros(n, bool)
+            if len(e.values) != 1:
+                return np.zeros(n, bool)
+            try:
+                rhs = int(e.values[0])
+            except ValueError:
+                return np.zeros(n, bool)  # invalid selector matches nothing
+            for i, node in enumerate(nodes):
+                val = node.labels.get(e.key)
+                if val is not None:
+                    try:
+                        sat[i] = (int(val) > rhs) if e.operator == api.NS_OP_GT \
+                            else (int(val) < rhs)
+                    except ValueError:
+                        pass
+        else:
+            return np.zeros(n, bool)  # unknown operator: selector parse error
+        mask &= sat
+    return mask
+
+
+def _selector_set_mask(sel: dict[str, str], nt: fc.NodeTensors,
+                       space: fc.FeatureSpace) -> np.ndarray:
+    """[N] bool — labels.SelectorFromSet(map): AND over key=value pairs."""
+    n = nt.labels.shape[0]
+    mask = np.ones(n, bool)
+    for k, v in sel.items():
+        kv = space.labels.kv_get(k, v)
+        mask &= nt.labels[:, kv] if kv >= 0 else np.zeros(n, bool)
+    return mask
+
+
+def required_node_mask(pod: api.Pod, nt: fc.NodeTensors, space: fc.FeatureSpace,
+                       nodes: Optional[Sequence[api.Node]] = None) -> np.ndarray:
+    """[N] bool — podMatchesNodeLabels (predicates.go:504-554):
+    spec.nodeSelector AND required node affinity."""
+    mask = _selector_set_mask(pod.node_selector, nt, space)
+    aff = pod.affinity()
+    if aff is not None and aff.node_affinity is not None \
+            and aff.node_affinity.required is not None:
+        terms = aff.node_affinity.required.node_selector_terms
+        tmask = np.zeros(nt.labels.shape[0], bool)  # empty terms match nothing
+        for t in terms:
+            tmask |= _term_mask(t, nt, space, nodes)
+        mask &= tmask
+    return mask
+
+
+def preferred_count_row(pod: api.Pod, nt: fc.NodeTensors, space: fc.FeatureSpace,
+                        nodes: Optional[Sequence[api.Node]] = None) -> np.ndarray:
+    """[N] int32 — sum of preferred-term weights matching each node
+    (node_affinity.go:32-65).  Zero-weight terms skipped."""
+    n = nt.labels.shape[0]
+    counts = np.zeros(n, np.int32)
+    aff = pod.affinity()
+    if aff is not None and aff.node_affinity is not None:
+        for term in aff.node_affinity.preferred:
+            if term.weight == 0:
+                continue
+            counts += term.weight * _term_mask(term.preference, nt, space, nodes)
+    return counts
+
+
+def _label_selector_match_mask(sel: api.LabelSelector, labels_mh: np.ndarray,
+                               space: fc.FeatureSpace) -> np.ndarray:
+    """[M] bool — LabelSelector vs each existing pod's label multi-hot."""
+    m = labels_mh.shape[0]
+    mask = np.ones(m, bool)
+    for k, v in sel.match_labels:
+        kv = space.labels.kv_get(k, v)
+        mask &= labels_mh[:, kv] if kv >= 0 else np.zeros(m, bool)
+    for e in sel.match_expressions:
+        if e.operator == "In":
+            ids = [space.labels.kv_get(e.key, v) for v in e.values]
+            ids = [i for i in ids if i >= 0]
+            mask &= labels_mh[:, ids].any(1) if ids else np.zeros(m, bool)
+        elif e.operator == "NotIn":
+            ids = [space.labels.kv_get(e.key, v) for v in e.values]
+            ids = [i for i in ids if i >= 0]
+            if ids:
+                mask &= ~labels_mh[:, ids].any(1)
+        elif e.operator == "Exists":
+            kid = space.labels.key_get(e.key)
+            mask &= labels_mh[:, kid] if kid >= 0 else np.zeros(m, bool)
+        elif e.operator == "DoesNotExist":
+            kid = space.labels.key_get(e.key)
+            if kid >= 0:
+                mask &= ~labels_mh[:, kid]
+        else:
+            return np.zeros(m, bool)
+    return mask
+
+
+def _selector_matches_pod_labels(sel, labels: dict[str, str]) -> bool:
+    if isinstance(sel, dict):
+        return bool(sel) and all(labels.get(k) == v for k, v in sel.items())
+    if isinstance(sel, api.LabelSelector):
+        return sel.matches(labels)
+    return False
+
+
+# Lister signature: pod -> list of selector objects (dict for services/RCs,
+# LabelSelector for ReplicaSets) matching it.
+SpreadSelectors = Callable[[api.Pod], list]
+# Lister: pod -> list of controller UIDs as ("ReplicationController"|"ReplicaSet", uid).
+ControllerRefs = Callable[[api.Pod], list]
+
+
+def _node_zone_ids(nt: fc.NodeTensors, space: fc.FeatureSpace) -> np.ndarray:
+    """Compact per-batch zone ids from GetZoneKey (region+zone labels)."""
+    n = nt.n
+    zone_col = space.topo_keys.get(api.ZONE_LABEL)
+    region_col = space.topo_keys.get(api.REGION_LABEL)
+    zv = nt.topo_val[:, zone_col] if zone_col >= 0 else np.full(n, -1)
+    rv = nt.topo_val[:, region_col] if region_col >= 0 else np.full(n, -1)
+    has = (zv >= 0) | (rv >= 0)
+    packed = (rv.astype(np.int64) + 1) * (len(space.topo_vals) + 2) + zv + 1
+    packed = np.where(has, packed, -1)
+    ids = np.full(n, -1, np.int32)
+    if has.any():
+        _, inv = np.unique(packed[has], return_inverse=True)
+        ids[has] = inv.astype(np.int32)
+    return ids
+
+
+def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
+                  space: fc.FeatureSpace,
+                  ep: Optional[fc.ExistingPodTensors] = None,
+                  nodes: Optional[Sequence[api.Node]] = None,
+                  spread_selectors: Optional[SpreadSelectors] = None,
+                  controller_refs: Optional[ControllerRefs] = None) -> PodBatch:
+    """Compile a pending-pod batch against the current node tensors."""
+    p = len(pods)
+    n = nt.n
+
+    # Intern everything first so capacities are final.
+    for pod in pods:
+        for port in pod.used_host_ports():
+            space.ports.id(str(port))
+        for v in pod.volumes:
+            for token, _ in fc.FeatureSpace.volume_tokens(v):
+                space.volumes.id(token)
+        for c in pod.containers:
+            if c.image:
+                space.images.id(c.image)
+
+    request = np.zeros((p, 4), np.int32)
+    nonzero = np.zeros((p, 2), np.int32)
+    zero_req = np.zeros(p, bool)
+    best_effort = np.zeros(p, bool)
+    host_idx = np.full(p, -1, np.int32)
+    ports = np.zeros((p, space.ports.capacity), bool)
+    vol_ro = np.zeros((p, space.volumes.capacity), bool)
+    vol_rw = np.zeros((p, space.volumes.capacity), bool)
+    tol_ns = np.zeros((p, space.taints.capacity), bool)
+    tol_pref = np.zeros((p, space.taints.capacity), bool)
+    has_tols = np.zeros(p, bool)
+    images = np.zeros((p, space.images.capacity), np.int32)
+    avoid_mask = np.zeros((p, n), bool)
+
+    # Parse the taint vocabulary once; every pod's tolerations are matched
+    # against it host-side, turning device-side toleration checks into a
+    # single untolerated-taints contraction.
+    vocab_taints = []
+    for tok in space.taints.tokens():
+        kv, _, effect = tok.rpartition(":")
+        key, _, value = kv.partition("=")
+        vocab_taints.append(api.Taint(key=key, value=value, effect=effect))
+
+    # Node avoid-annotation entries, parsed once: node -> set of
+    # (kind, uid) controller signatures (GetAvoidPodsFromNodeAnnotations).
+    node_avoids: list[set] = []
+    if controller_refs is not None and nodes is not None:
+        import json as _json
+        for node in nodes:
+            entries = set()
+            raw = node.annotations.get(api.PREFER_AVOID_PODS_ANNOTATION_KEY, "")
+            if raw:
+                try:
+                    d = _json.loads(raw)
+                    for e in d.get("preferAvoidPods") or ():
+                        pc = (e.get("podSignature") or {}).get("podController") or {}
+                        entries.add((pc.get("kind", ""), pc.get("uid", "")))
+                except (ValueError, AttributeError):
+                    pass
+            node_avoids.append(entries)
+
+    sel_sig_to_group: dict = {}
+    sel_rows: list[np.ndarray] = []
+    pref_rows: list[np.ndarray] = []
+    sel_group = np.zeros(p, np.int32)
+
+    node_zone_id = _node_zone_ids(nt, space)
+    num_zones = int(node_zone_id.max()) + 1 if (node_zone_id >= 0).any() else 0
+    any_zones = num_zones > 0
+
+    spread_sig_to_group: dict = {}
+    spread_groups_meta: list[tuple[str, list]] = []  # (namespace, selectors)
+    spread_node_rows: list[np.ndarray] = []
+    spread_zone_rows: list[np.ndarray] = []
+    spread_has_zone: list[bool] = []
+    spread_group = np.zeros(p, np.int32)
+
+    for i, pod in enumerate(pods):
+        request[i] = fc.pod_resource_row(pod)
+        nonzero[i] = fc.pod_nonzero_row(pod)
+        zero_req[i] = not (request[i, 0] or request[i, 1] or request[i, 2])
+        best_effort[i] = pod.is_best_effort()
+        if pod.node_name:
+            host_idx[i] = nt.name_to_idx.get(pod.node_name, -2)
+        for port in pod.used_host_ports():
+            ports[i, space.ports.id(str(port))] = True
+        for v in pod.volumes:
+            for token, ro in fc.FeatureSpace.volume_tokens(v):
+                (vol_ro if ro else vol_rw)[i, space.volumes.id(token)] = True
+        tols = pod.tolerations()
+        has_tols[i] = len(tols) > 0
+        pref_tols = [t for t in tols if not t.effect
+                     or t.effect == api.TAINT_EFFECT_PREFER_NO_SCHEDULE]
+        for ti, taint in enumerate(vocab_taints):
+            tol_ns[i, ti] = taint.tolerated_by(tols)
+            tol_pref[i, ti] = taint.tolerated_by(pref_tols)
+        for c in pod.containers:
+            if c.image:
+                images[i, space.images.id(c.image)] += 1
+
+        # NodePreferAvoidPods: mark nodes whose annotation lists one of the
+        # pod's controllers (priorities.go:326-398).
+        if controller_refs is not None and nodes is not None:
+            refs = controller_refs(pod)
+            if refs:
+                for ni, avoids in enumerate(node_avoids):
+                    if any(r in avoids for r in refs):
+                        avoid_mask[i, ni] = True
+
+        # Selector group (nodeSelector + node affinity).
+        aff = pod.affinity()
+        na = aff.node_affinity if aff else None
+        sig = (tuple(sorted(pod.node_selector.items())), na)
+        g = sel_sig_to_group.get(sig)
+        if g is None:
+            g = len(sel_rows)
+            sel_sig_to_group[sig] = g
+            sel_rows.append(required_node_mask(pod, nt, space, nodes))
+            pref_rows.append(preferred_count_row(pod, nt, space, nodes))
+        sel_group[i] = g
+
+        # Spread group (services/RCs/RSs selecting this pod), if listers given.
+        if spread_selectors is not None and ep is not None:
+            sels = spread_selectors(pod)
+            ssig = (pod.namespace, tuple(sorted(repr(s) for s in sels)))
+            sg = spread_sig_to_group.get(ssig)
+            if sg is None:
+                sg = len(spread_node_rows)
+                spread_sig_to_group[ssig] = sg
+                spread_groups_meta.append((pod.namespace, sels))
+                ncounts, zcounts = _spread_counts(
+                    pod.namespace, sels, ep, space, n, node_zone_id, num_zones)
+                spread_node_rows.append(ncounts)
+                spread_zone_rows.append(zcounts)
+                spread_has_zone.append(any_zones and len(sels) > 0)
+            spread_group[i] = sg
+
+    G = max(len(sel_rows), 1)
+    sel_required = np.stack(sel_rows) if sel_rows else np.ones((G, n), bool)
+    sel_pref = np.stack(pref_rows) if pref_rows else np.zeros((G, n), np.int32)
+    S = max(len(spread_node_rows), 1)
+    Z = max(num_zones, 1)
+    sp_n = np.stack(spread_node_rows) if spread_node_rows \
+        else np.zeros((S, n), np.float32)
+    sp_z = np.stack(spread_zone_rows) if spread_zone_rows \
+        else np.zeros((S, Z), np.float32)
+    sp_hz = np.array(spread_has_zone or [False], bool)
+
+    # In-batch increments: once pod i is placed it becomes an "existing pod"
+    # for every later pod in the batch (the reference sees it via the assumed-
+    # pod cache, cache.go:107).
+    spread_incr = np.zeros((p, S), bool)
+    if spread_groups_meta:
+        for i, pod in enumerate(pods):
+            if pod.deletion_timestamp is not None:
+                continue
+            for s, (ns, sels) in enumerate(spread_groups_meta):
+                if ns == pod.namespace and any(
+                        _selector_matches_pod_labels(sel, pod.labels)
+                        for sel in sels):
+                    spread_incr[i, s] = True
+
+    return PodBatch(
+        pods=list(pods), request=request, zero_request=zero_req, nonzero=nonzero,
+        best_effort=best_effort, host_idx=host_idx, ports=ports,
+        vol_ro=vol_ro, vol_rw=vol_rw, tol_nosched=tol_ns, tol_prefer=tol_pref,
+        has_tolerations=has_tols,
+        images=images, sel_group=sel_group, sel_required=sel_required,
+        sel_pref_counts=sel_pref, spread_group=spread_group,
+        spread_node_counts=sp_n, spread_zone_counts=sp_z,
+        spread_has_zones=sp_hz, spread_incr=spread_incr,
+        node_zone_id=node_zone_id, avoid_mask=avoid_mask)
+
+
+def _spread_counts(namespace: str, selectors: list,
+                   ep: fc.ExistingPodTensors, space: fc.FeatureSpace,
+                   n: int, node_zone_id: np.ndarray,
+                   num_zones: int) -> tuple[np.ndarray, np.ndarray]:
+    """SelectorSpread count phase (selector_spreading.go:89-135): count
+    existing same-namespace, non-deleted pods matching ANY selector, per node
+    and per zone."""
+    Z = max(num_zones, 1)
+    if not selectors:
+        return np.zeros(n, np.float32), np.zeros(Z, np.float32)
+    ns = space.namespaces.get(namespace)
+    cand = ep.alive & ~ep.deleted & (ep.ns_id == ns) & (ep.node_idx >= 0)
+    match = np.zeros(len(cand), bool)
+    for sel in selectors:
+        if isinstance(sel, dict):
+            if not sel:
+                continue  # empty map selector selects nothing
+            m = np.ones(len(cand), bool)
+            for k, v in sel.items():
+                kv = space.labels.kv_get(k, v)
+                m &= ep.labels[:, kv] if kv >= 0 else False
+            match |= m
+        elif isinstance(sel, api.LabelSelector):
+            match |= _label_selector_match_mask(sel, ep.labels, space)
+    match &= cand
+    node_counts = np.bincount(ep.node_idx[match], minlength=n).astype(np.float32)[:n]
+    zone_counts = np.zeros(Z, np.float32)
+    if num_zones > 0:
+        zmask = node_zone_id >= 0
+        zone_counts[:num_zones] = np.bincount(
+            node_zone_id[zmask], weights=node_counts[zmask],
+            minlength=num_zones)[:num_zones]
+    return node_counts, zone_counts

@@ -1,0 +1,346 @@
+"""Feature compiler: Pod/Node objects -> dense device tensors.
+
+This is the tensor-native replacement for the reference's ``schedulercache``
+(``plugin/pkg/scheduler/schedulercache/node_info.go``): where ``NodeInfo``
+pre-aggregates requested/allocatable resources and per-node pod lists for one
+node, we build the whole cluster as stacked arrays so every predicate and
+priority evaluates for all (pod, node) pairs at once on the MXU/VPU.
+
+Unit conventions (chosen so exact Go int64 arithmetic fits in int32 on TPU):
+  cpu     : millicores            (reference: int64 millicores)
+  memory  : MiB — requests ceil'd, allocatable floor'd (reference: bytes).
+            Real-world requests are MiB-aligned (incl. the 200*1024*1024-byte
+            non-zero default, non_zero.go:47), so quantization is exact in
+            practice; the parity harness measures any residual divergence.
+  gpu     : count
+  pods    : count
+  image   : KiB (floor)
+
+Resource vectors are [*, 4] int32 in order (milli_cpu, memory_mib, gpu, pods).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.features.vocab import LabelVocab, Vocab
+
+RES_CPU, RES_MEM, RES_GPU, RES_PODS = 0, 1, 2, 3
+
+_MIB = 1024 * 1024
+
+
+def _mib_ceil(b: int) -> int:
+    return -((-b) // _MIB)
+
+
+def _mib_floor(b: int) -> int:
+    return b // _MIB
+
+
+@dataclass
+class FeatureSpace:
+    """All interning vocabularies; the single source of id assignment."""
+
+    labels: LabelVocab = field(default_factory=LabelVocab)
+    taints: Vocab = field(default_factory=Vocab)       # "key=value:effect"
+    ports: Vocab = field(default_factory=Vocab)        # "tcp:port" etc
+    volumes: Vocab = field(default_factory=Vocab)      # conflict keys
+    images: Vocab = field(default_factory=Vocab)       # image name
+    namespaces: Vocab = field(default_factory=Vocab)
+    topo_keys: Vocab = field(default_factory=Vocab)    # topology label keys
+    topo_vals: Vocab = field(default_factory=Vocab)    # "key=value" domains
+
+    def __post_init__(self) -> None:
+        # Default failure domains are always interned so topology columns
+        # exist from the start (pkg/api/types.go:3053-3063).
+        for k in api.DEFAULT_FAILURE_DOMAINS:
+            self.topo_keys.id(k)
+
+    # -- volume conflict tokens (predicates.go:100-144) --------------------
+    @staticmethod
+    def volume_tokens(v: api.Volume) -> list[tuple[str, bool]]:
+        """Conflict tokens for a volume as (token, read_only) pairs.
+
+        EBS conflicts regardless of read-only (predicates.go:116-120), so its
+        token is always read_only=False.  RBD "shares at least one monitor"
+        (haveSame, predicates.go:126-133) is made exact by emitting one token
+        per monitor.
+        """
+        out: list[tuple[str, bool]] = []
+        if v.gce_pd_name:
+            out.append((f"gce:{v.gce_pd_name}", v.gce_read_only))
+        if v.aws_ebs_id:
+            out.append((f"ebs:{v.aws_ebs_id}", False))
+        if v.rbd_key:
+            mons, pool, image = (v.rbd_key.split("#") + ["", ""])[:3]
+            for mon in mons.split(","):
+                if mon:
+                    out.append((f"rbd:{mon}#{pool}#{image}", v.rbd_read_only))
+        return out
+
+
+@dataclass
+class NodeTensors:
+    """Static per-node features [N, ...] (rebuilt when nodes change)."""
+
+    names: list[str]
+    name_to_idx: dict[str, int]
+    alloc: np.ndarray          # [N, 4] int32
+    labels: np.ndarray         # [N, V] bool — kv + key-presence membership
+    taints_nosched: np.ndarray  # [N, T] bool  (effect != PreferNoSchedule)
+    taints_prefer: np.ndarray   # [N, T] bool  (effect == PreferNoSchedule)
+    mem_pressure: np.ndarray   # [N] bool
+    disk_pressure: np.ndarray  # [N] bool
+    schedulable: np.ndarray    # [N] bool — getNodeConditionPredicate
+    image_kib: np.ndarray      # [N, I] int32
+    topo_val: np.ndarray       # [N, K] int32 — domain id per topo key, -1 absent
+
+    @property
+    def n(self) -> int:
+        return len(self.names)
+
+
+@dataclass
+class NodeAggregates:
+    """Per-node aggregates over the pods assigned to each node — the tensor
+    analogue of NodeInfo.{requestedResource, nonzeroRequest, pods}
+    (node_info.go:32-61).  Maintained incrementally by the scheduler cache."""
+
+    requested: np.ndarray      # [N, 4] int32 (cpu, mem_mib, gpu, pod count)
+    nonzero: np.ndarray        # [N, 2] int32 (cpu, mem_mib)
+    ports_used: np.ndarray     # [N, P] bool
+    vol_any: np.ndarray        # [N, W] bool — volume token mounted by any pod
+    vol_rw: np.ndarray         # [N, W] bool — mounted by a non-read-only pod...
+    vol_rw_count: np.ndarray   # [N, W] int16 rw mount counts (for removal)
+    vol_any_count: np.ndarray  # [N, W] int16
+
+
+@dataclass
+class ExistingPodTensors:
+    """Existing (assigned, non-terminated) pods as tensors — for selector
+    spreading and inter-pod affinity, which must match *other pods'* labels.
+    [M, ...] with a capacity that grows geometrically."""
+
+    labels: np.ndarray         # [M, V] bool
+    ns_id: np.ndarray          # [M] int32
+    node_idx: np.ndarray       # [M] int32 (-1 = slot free)
+    alive: np.ndarray          # [M] bool
+    deleted: np.ndarray        # [M] bool (DeletionTimestamp set)
+    keys: list[Optional[str]]  # slot -> pod key
+    key_to_slot: dict[str, int]
+
+
+def compile_nodes(nodes: Sequence[api.Node], space: FeatureSpace) -> NodeTensors:
+    """Build static node tensors, interning all label/taint/image tokens."""
+    n = len(nodes)
+    # Intern first so capacities are final before allocation.
+    for node in nodes:
+        for k, v in node.labels.items():
+            space.labels.kv_id(k, v)
+            space.labels.key_id(k)
+        for t in node.taints():
+            space.taints.id(f"{t.key}={t.value}:{t.effect}")
+        for img in node.images:
+            for name in img.names:
+                space.images.id(name)
+        for ki, key in enumerate(space.topo_keys.tokens()):
+            if key in node.labels:
+                space.topo_vals.id(f"{key}={node.labels[key]}")
+
+    V, T, I, K = (space.labels.capacity, space.taints.capacity,
+                  space.images.capacity, space.topo_keys.capacity)
+    alloc = np.zeros((n, 4), np.int32)
+    labels = np.zeros((n, V), bool)
+    t_ns = np.zeros((n, T), bool)
+    t_pref = np.zeros((n, T), bool)
+    memp = np.zeros(n, bool)
+    diskp = np.zeros(n, bool)
+    sched = np.zeros(n, bool)
+    image_kib = np.zeros((n, I), np.int32)
+    topo_val = np.full((n, K), -1, np.int32)
+
+    for i, node in enumerate(nodes):
+        alloc[i] = (node.allocatable_milli_cpu, _mib_floor(node.allocatable_memory),
+                    node.allocatable_gpu, node.allocatable_pods)
+        for k, v in node.labels.items():
+            labels[i, space.labels.kv_id(k, v)] = True
+            labels[i, space.labels.key_id(k)] = True
+        for t in node.taints():
+            tid = space.taints.id(f"{t.key}={t.value}:{t.effect}")
+            if t.effect == api.TAINT_EFFECT_PREFER_NO_SCHEDULE:
+                t_pref[i, tid] = True
+            else:
+                t_ns[i, tid] = True
+        memp[i] = node.condition(api.NODE_MEMORY_PRESSURE) == "True"
+        diskp[i] = node.condition(api.NODE_DISK_PRESSURE) == "True"
+        sched[i] = node.is_ready()
+        for img in node.images:
+            kib = img.size_bytes // 1024
+            for name in img.names:
+                image_kib[i, space.images.id(name)] = kib
+        for ki, key in enumerate(space.topo_keys.tokens()):
+            if key in node.labels:
+                topo_val[i, ki] = space.topo_vals.id(f"{key}={node.labels[key]}")
+
+    return NodeTensors(
+        names=[nd.name for nd in nodes],
+        name_to_idx={nd.name: i for i, nd in enumerate(nodes)},
+        alloc=alloc, labels=labels, taints_nosched=t_ns, taints_prefer=t_pref,
+        mem_pressure=memp, disk_pressure=diskp, schedulable=sched,
+        image_kib=image_kib, topo_val=topo_val)
+
+
+def pod_resource_row(pod: api.Pod) -> np.ndarray:
+    """[4] int32 (cpu, mem_mib ceil, gpu, 1) — getResourceRequest."""
+    r = pod.resource_request()
+    return np.array([r.milli_cpu, _mib_ceil(r.memory), r.nvidia_gpu, 1], np.int32)
+
+
+def pod_nonzero_row(pod: api.Pod) -> np.ndarray:
+    cpu, mem = pod.non_zero_request()
+    return np.array([cpu, _mib_ceil(mem)], np.int32)
+
+
+def empty_aggregates(n: int, space: FeatureSpace) -> NodeAggregates:
+    P, W = space.ports.capacity, space.volumes.capacity
+    return NodeAggregates(
+        requested=np.zeros((n, 4), np.int32),
+        nonzero=np.zeros((n, 2), np.int32),
+        ports_used=np.zeros((n, P), bool),
+        vol_any=np.zeros((n, W), bool),
+        vol_rw=np.zeros((n, W), bool),
+        vol_rw_count=np.zeros((n, W), np.int16),
+        vol_any_count=np.zeros((n, W), np.int16))
+
+
+def _pod_port_ids(pod: api.Pod, space: FeatureSpace) -> list[int]:
+    return [space.ports.id(str(p)) for p in pod.used_host_ports()]
+
+
+def _pod_volume_ids(pod: api.Pod, space: FeatureSpace) -> list[tuple[int, bool]]:
+    out = []
+    for v in pod.volumes:
+        for token, ro in FeatureSpace.volume_tokens(v):
+            out.append((space.volumes.id(token), ro))
+    return out
+
+
+def add_pod_to_aggregates(agg: NodeAggregates, node_idx: int, pod: api.Pod,
+                          space: FeatureSpace) -> NodeAggregates:
+    """NodeInfo.addPod (node_info.go:171-196), tensorized. May grow the port
+    and volume columns if the pod interned new tokens."""
+    agg = _grow_aggregate_columns(agg, space)
+    agg.requested[node_idx] += pod_resource_row(pod)
+    agg.nonzero[node_idx] += pod_nonzero_row(pod)
+    for pid in _pod_port_ids(pod, space):
+        agg = _grow_aggregate_columns(agg, space)
+        agg.ports_used[node_idx, pid] = True
+    for vid, ro in _pod_volume_ids(pod, space):
+        agg = _grow_aggregate_columns(agg, space)
+        agg.vol_any_count[node_idx, vid] += 1
+        if not ro:
+            agg.vol_rw_count[node_idx, vid] += 1
+        agg.vol_any[node_idx, vid] = agg.vol_any_count[node_idx, vid] > 0
+        agg.vol_rw[node_idx, vid] = agg.vol_rw_count[node_idx, vid] > 0
+    return agg
+
+
+def remove_pod_from_aggregates(agg: NodeAggregates, node_idx: int, pod: api.Pod,
+                               space: FeatureSpace,
+                               node_pods: Sequence[api.Pod]) -> NodeAggregates:
+    """NodeInfo.removePod (node_info.go:199-227).  ``node_pods`` is the node's
+    remaining pod set, needed to recompute the port bitmap exactly (ports are
+    a set union, not a counter, in the reference)."""
+    agg.requested[node_idx] -= pod_resource_row(pod)
+    agg.nonzero[node_idx] -= pod_nonzero_row(pod)
+    for vid, ro in _pod_volume_ids(pod, space):
+        agg.vol_any_count[node_idx, vid] -= 1
+        if not ro:
+            agg.vol_rw_count[node_idx, vid] -= 1
+        agg.vol_any[node_idx, vid] = agg.vol_any_count[node_idx, vid] > 0
+        agg.vol_rw[node_idx, vid] = agg.vol_rw_count[node_idx, vid] > 0
+    agg.ports_used[node_idx] = False
+    for p in node_pods:
+        if p.key != pod.key:
+            for pid in _pod_port_ids(p, space):
+                agg = _grow_aggregate_columns(agg, space)
+                agg.ports_used[node_idx, pid] = True
+    return agg
+
+
+def _grow_cols(a: np.ndarray, width: int) -> np.ndarray:
+    if a.shape[1] >= width:
+        return a
+    out = np.zeros((a.shape[0], width), a.dtype)
+    out[:, : a.shape[1]] = a
+    return out
+
+
+def _grow_aggregate_columns(agg: NodeAggregates, space: FeatureSpace) -> NodeAggregates:
+    agg.ports_used = _grow_cols(agg.ports_used, space.ports.capacity)
+    for f in ("vol_any", "vol_rw", "vol_rw_count", "vol_any_count"):
+        setattr(agg, f, _grow_cols(getattr(agg, f), space.volumes.capacity))
+    return agg
+
+
+# ---------------------------------------------------------------------------
+# Existing-pod tensors (spreading / inter-pod affinity inputs)
+# ---------------------------------------------------------------------------
+
+def empty_existing_pods(space: FeatureSpace, cap: int = 256) -> ExistingPodTensors:
+    V = space.labels.capacity
+    return ExistingPodTensors(
+        labels=np.zeros((cap, V), bool),
+        ns_id=np.zeros(cap, np.int32),
+        node_idx=np.full(cap, -1, np.int32),
+        alive=np.zeros(cap, bool),
+        deleted=np.zeros(cap, bool),
+        keys=[None] * cap,
+        key_to_slot={})
+
+
+def existing_pods_add(ep: ExistingPodTensors, pod: api.Pod, node_idx: int,
+                      space: FeatureSpace) -> ExistingPodTensors:
+    for k, v in pod.labels.items():
+        space.labels.kv_id(k, v)
+        space.labels.key_id(k)
+    ep.labels = _grow_cols(ep.labels, space.labels.capacity)
+    slot = ep.key_to_slot.get(pod.key)
+    if slot is None:
+        free = np.nonzero(~ep.alive)[0]
+        if len(free) == 0:
+            m = len(ep.keys)
+            ep.labels = np.concatenate([ep.labels, np.zeros_like(ep.labels)], 0)
+            ep.ns_id = np.concatenate([ep.ns_id, np.zeros(m, np.int32)])
+            ep.node_idx = np.concatenate([ep.node_idx, np.full(m, -1, np.int32)])
+            ep.alive = np.concatenate([ep.alive, np.zeros(m, bool)])
+            ep.deleted = np.concatenate([ep.deleted, np.zeros(m, bool)])
+            ep.keys += [None] * m
+            slot = m
+        else:
+            slot = int(free[0])
+        ep.key_to_slot[pod.key] = slot
+        ep.keys[slot] = pod.key
+    ep.labels[slot] = False
+    for k, v in pod.labels.items():
+        ep.labels[slot, space.labels.kv_id(k, v)] = True
+        ep.labels[slot, space.labels.key_id(k)] = True
+    ep.ns_id[slot] = space.namespaces.id(pod.namespace)
+    ep.node_idx[slot] = node_idx
+    ep.alive[slot] = True
+    ep.deleted[slot] = pod.deletion_timestamp is not None
+    return ep
+
+
+def existing_pods_remove(ep: ExistingPodTensors, pod_key: str) -> ExistingPodTensors:
+    slot = ep.key_to_slot.pop(pod_key, None)
+    if slot is not None:
+        ep.alive[slot] = False
+        ep.node_idx[slot] = -1
+        ep.keys[slot] = None
+    return ep

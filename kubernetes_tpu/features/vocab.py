@@ -1,0 +1,84 @@
+"""Exact interning vocabularies.
+
+The reference does string matching everywhere (label selectors
+``pkg/labels``, taints/tolerations ``pkg/api/helpers.go``, host ports,
+volume conflict keys).  On TPU those become set-membership tensor ops, which
+requires mapping strings to dense integer ids.  We use *exact* incremental
+interning (not hashing) so collisions can never break decision parity —
+vocabularies live host-side, are append-only, and device tensors are sized to
+a padded capacity that grows geometrically (a capacity change recompiles the
+kernels, which XLA caches per shape).
+
+Token kinds share one id space per vocabulary:
+  label vocab:   "kv:<key>=<value>" and "key:<key>"
+  taint vocab:   "<key>=<value>:<effect>"
+  port vocab:    decimal port number
+  volume vocab:  conflict key e.g. "gce:<pdName>"
+  image vocab:   image name
+  topo-key vocab / topo-value vocab: topology domains
+"""
+
+from __future__ import annotations
+
+
+def _next_pow2(n: int) -> int:
+    p = 8
+    while p < n:
+        p *= 2
+    return p
+
+
+class Vocab:
+    """Append-only exact string->id interning table."""
+
+    __slots__ = ("_ids", "_tokens", "generation")
+
+    def __init__(self) -> None:
+        self._ids: dict[str, int] = {}
+        self._tokens: list[str] = []
+        self.generation = 0  # bumped on growth; lets tensor caches invalidate
+
+    def __len__(self) -> int:
+        return len(self._tokens)
+
+    def id(self, token: str) -> int:
+        """Intern (assigning a fresh id if unseen)."""
+        i = self._ids.get(token)
+        if i is None:
+            i = len(self._tokens)
+            self._ids[token] = i
+            self._tokens.append(token)
+            self.generation += 1
+        return i
+
+    def get(self, token: str) -> int:
+        """Lookup without interning; -1 if absent."""
+        return self._ids.get(token, -1)
+
+    def token(self, i: int) -> str:
+        return self._tokens[i]
+
+    def tokens(self) -> list[str]:
+        return list(self._tokens)
+
+    @property
+    def capacity(self) -> int:
+        """Padded device-tensor width for this vocabulary."""
+        return _next_pow2(max(len(self._tokens), 1))
+
+
+class LabelVocab(Vocab):
+    """Label vocabulary with kv-pair and key-presence entries sharing one id
+    space, mirroring the two things ``labels.Requirement.Matches`` can test."""
+
+    def kv_id(self, key: str, value: str) -> int:
+        return self.id(f"kv:{key}={value}")
+
+    def key_id(self, key: str) -> int:
+        return self.id(f"key:{key}")
+
+    def kv_get(self, key: str, value: str) -> int:
+        return self.get(f"kv:{key}={value}")
+
+    def key_get(self, key: str) -> int:
+        return self.get(f"key:{key}")

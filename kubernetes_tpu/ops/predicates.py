@@ -1,0 +1,119 @@
+"""Hard-constraint mask kernels.
+
+Each predicate from the reference's ``algorithm/predicates/predicates.go``
+becomes a pure function producing a boolean feasibility mask ``[P, N]`` for a
+whole batch of pods against all nodes at once.  Set-membership checks (ports,
+volume conflicts, taints) are contractions over small vocabularies — matmul
+shaped, so XLA maps them onto the MXU; resource comparisons are exact int32
+arithmetic on the VPU.
+
+All kernels are shape-polymorphic jit-compatible pure functions; they take
+raw arrays (not host objects), so they can run under ``pjit`` with the node
+axis sharded across a mesh.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.features.compiler import RES_CPU, RES_GPU, RES_MEM, RES_PODS
+
+
+def _any_overlap(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """[P,C] bool x [N,C] bool -> [P,N] bool: any shared member.
+
+    Cast to f32 and contract — this is the MXU-friendly formulation of set
+    intersection over an interned vocabulary.
+    """
+    prod = jnp.einsum("pc,nc->pn", a.astype(jnp.float32), b.astype(jnp.float32))
+    return prod > 0.0
+
+
+def pod_fits_resources(pod_request: jnp.ndarray, zero_request: jnp.ndarray,
+                       node_alloc: jnp.ndarray,
+                       node_requested: jnp.ndarray) -> jnp.ndarray:
+    """PodFitsResources (predicates.go:444-485).
+
+    The pod-count check applies even to zero-request pods (the early return
+    at :463 happens after the pod-count append at :451-453).
+    """
+    fits_pods = (node_requested[:, RES_PODS] + 1) <= node_alloc[:, RES_PODS]  # [N]
+    free = node_alloc[None, :, :3] - node_requested[None, :, :3]  # [1,N,3]
+    need = pod_request[:, None, :3]  # [P,1,3]
+    fits_res = jnp.all(need <= free, axis=-1)  # [P,N]
+    return fits_pods[None, :] & (zero_request[:, None] | fits_res)
+
+
+def pod_fits_host(host_idx: jnp.ndarray, n_nodes: int) -> jnp.ndarray:
+    """PodFitsHost (predicates.go:567-581): spec.nodeName pinning.
+    host_idx: -1 unconstrained, -2 names an unknown node (fits nowhere)."""
+    node_ids = jnp.arange(n_nodes, dtype=jnp.int32)[None, :]
+    return (host_idx[:, None] == -1) | (host_idx[:, None] == node_ids)
+
+
+def pod_fits_host_ports(pod_ports: jnp.ndarray,
+                        node_ports_used: jnp.ndarray) -> jnp.ndarray:
+    """PodFitsHostPorts (predicates.go:721-741): no requested hostPort may
+    already be in use on the node (port 0 never interned)."""
+    return ~_any_overlap(pod_ports, node_ports_used)
+
+
+def pod_selector_matches(sel_group: jnp.ndarray,
+                         sel_required: jnp.ndarray) -> jnp.ndarray:
+    """PodSelectorMatches = MatchNodeSelector (predicates.go:556-565):
+    gather of per-group precompiled spec.nodeSelector + required-node-affinity
+    masks (the batched analogue of podMatchesNodeLabels)."""
+    return sel_required[sel_group]  # [P,N]
+
+
+def no_disk_conflict(pod_vol_rw: jnp.ndarray, pod_vol_ro: jnp.ndarray,
+                     node_vol_any: jnp.ndarray,
+                     node_vol_rw: jnp.ndarray) -> jnp.ndarray:
+    """NoDiskConflict (predicates.go:100-153) over interned conflict tokens:
+    a writable mount conflicts with any existing mount of the same token; a
+    read-only mount conflicts only with an existing writable mount.  (EBS
+    tokens are always emitted writable, making its unconditional-conflict
+    rule fall out of the same algebra.)"""
+    conflict = _any_overlap(pod_vol_rw, node_vol_any) | \
+        _any_overlap(pod_vol_ro, node_vol_rw)
+    return ~conflict
+
+
+def pod_tolerates_node_taints(pod_tol_nosched: jnp.ndarray,
+                              pod_has_tolerations: jnp.ndarray,
+                              node_taints_nosched: jnp.ndarray,
+                              node_has_taints: jnp.ndarray) -> jnp.ndarray:
+    """PodToleratesNodeTaints (predicates.go:1070-1117).
+
+    tolerationsToleratesTaints (:1093-1117) short-circuits: an empty taint
+    list is tolerated by anything (:1095-1097), but a non-empty taint list —
+    even all-PreferNoSchedule — is NOT tolerated by an empty toleration list
+    (:1099-1101).  Only then are non-PreferNoSchedule taints matched.
+    Toleration-vs-taint matching was resolved host-side against the taint
+    vocabulary, so the match step is a single untolerated-overlap
+    contraction."""
+    matched = ~_any_overlap(~pod_tol_nosched, node_taints_nosched)
+    ok = pod_has_tolerations[:, None] & matched
+    return ~node_has_taints[None, :] | ok
+
+
+def check_node_memory_pressure(best_effort: jnp.ndarray,
+                               node_mem_pressure: jnp.ndarray) -> jnp.ndarray:
+    """CheckNodeMemoryPressurePredicate (predicates.go:1125-1153): only
+    best-effort pods are repelled by memory pressure."""
+    return ~(best_effort[:, None] & node_mem_pressure[None, :])
+
+
+def check_node_disk_pressure(n_pods: int,
+                             node_disk_pressure: jnp.ndarray) -> jnp.ndarray:
+    """CheckNodeDiskPressurePredicate (predicates.go:1156-1172): all pods are
+    repelled by disk pressure."""
+    return jnp.broadcast_to(~node_disk_pressure[None, :],
+                            (n_pods, node_disk_pressure.shape[0]))
+
+
+def node_label_presence(n_pods: int, node_row: jnp.ndarray) -> jnp.ndarray:
+    """CheckNodeLabelPresence (predicates.go:586-621): policy-configured,
+    pod-independent — ``node_row`` [N] is precomputed host-side from the
+    policy's labels/presence arguments."""
+    return jnp.broadcast_to(node_row[None, :], (n_pods, node_row.shape[0]))

@@ -1,0 +1,185 @@
+"""Soft-scoring kernels.
+
+Each priority from the reference's ``algorithm/priorities/`` becomes a score
+plane ``[P, N]`` (float32 holding exact small integers 0-10).  Integer
+formulas are reproduced with exact int32 arithmetic (Go's int64 division
+truncates toward zero; all operands here are non-negative so floor division
+is identical); float formulas use f32 where the reference uses f32/f64 — for
+0-10 scores the truncation boundaries coincide except at adversarial
+rationals, which the parity harness quantifies.
+
+Per-pod max-normalizations (node affinity, taint toleration) reduce over the
+node axis; under a sharded mesh these become ``psum``-style cross-shard
+reductions inserted by XLA.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.features.compiler import RES_CPU, RES_MEM
+
+# priorities.go:45 — scores live on a 0-10 scale.
+MAX_PRIORITY = 10
+
+
+def _trunc(x: jnp.ndarray) -> jnp.ndarray:
+    """Go's int(float) truncation with an epsilon guard.
+
+    XLA lowers f32 division to multiply-by-reciprocal (relative error ~1e-7),
+    so a mathematically-exact boundary like 3000/4000*10 == 7.5e0 can land an
+    ulp above/below and flip the truncation vs the reference's correctly-
+    rounded f64.  All reference score formulas divide by small integers
+    (counts <= ~1e4), whose non-integer quotients sit >= 1e-4 from any
+    integer, so +1e-5 absorbs the division error without crossing a true
+    boundary."""
+    return jnp.trunc(x + 1e-5)
+
+
+def _unused_score(requested: jnp.ndarray, capacity: jnp.ndarray) -> jnp.ndarray:
+    """calculateUnusedScore (priorities.go:45-55): ((cap-req)*10)/cap, 0 when
+    cap==0 or req>cap. Exact int32."""
+    safe_cap = jnp.maximum(capacity, 1)
+    score = ((capacity - requested) * 10) // safe_cap
+    return jnp.where((capacity == 0) | (requested > capacity), 0, score)
+
+
+def _used_score(requested: jnp.ndarray, capacity: jnp.ndarray) -> jnp.ndarray:
+    """calculateUsedScore (priorities.go:64-74): (req*10)/cap."""
+    safe_cap = jnp.maximum(capacity, 1)
+    score = (requested * 10) // safe_cap
+    return jnp.where((capacity == 0) | (requested > capacity), 0, score)
+
+
+def _total_nonzero(pod_nonzero: jnp.ndarray,
+                   node_nonzero: jnp.ndarray) -> jnp.ndarray:
+    """[P,N,2] — pod's non-zero request + node's accumulated non-zero requests
+    (calculateUnusedPriority, priorities.go:81-86)."""
+    return pod_nonzero[:, None, :] + node_nonzero[None, :, :]
+
+
+def least_requested(pod_nonzero: jnp.ndarray, node_nonzero: jnp.ndarray,
+                    node_alloc: jnp.ndarray) -> jnp.ndarray:
+    """LeastRequestedPriority (priorities.go:139-149): int((cpu+mem)/2) over
+    unused scores against allocatable."""
+    total = _total_nonzero(pod_nonzero, node_nonzero)
+    cpu = _unused_score(total[..., 0], node_alloc[None, :, RES_CPU])
+    mem = _unused_score(total[..., 1], node_alloc[None, :, RES_MEM])
+    return ((cpu + mem) // 2).astype(jnp.float32)
+
+
+def most_requested(pod_nonzero: jnp.ndarray, node_nonzero: jnp.ndarray,
+                   node_alloc: jnp.ndarray) -> jnp.ndarray:
+    """MostRequestedPriority (priorities.go:152-161)."""
+    total = _total_nonzero(pod_nonzero, node_nonzero)
+    cpu = _used_score(total[..., 0], node_alloc[None, :, RES_CPU])
+    mem = _used_score(total[..., 1], node_alloc[None, :, RES_MEM])
+    return ((cpu + mem) // 2).astype(jnp.float32)
+
+
+def balanced_resource_allocation(pod_nonzero: jnp.ndarray,
+                                 node_nonzero: jnp.ndarray,
+                                 node_alloc: jnp.ndarray) -> jnp.ndarray:
+    """BalancedResourceAllocation (priorities.go:271-317):
+    int(10 - |cpuFrac - memFrac| * 10), 0 if either fraction >= 1
+    (fractionOfCapacity: cap==0 -> fraction 1)."""
+    total = _total_nonzero(pod_nonzero, node_nonzero).astype(jnp.float32)
+    cap_cpu = node_alloc[None, :, RES_CPU].astype(jnp.float32)
+    cap_mem = node_alloc[None, :, RES_MEM].astype(jnp.float32)
+    cpu_frac = jnp.where(cap_cpu == 0, 1.0, total[..., 0] / jnp.maximum(cap_cpu, 1))
+    mem_frac = jnp.where(cap_mem == 0, 1.0, total[..., 1] / jnp.maximum(cap_mem, 1))
+    diff = jnp.abs(cpu_frac - mem_frac)
+    score = _trunc(10.0 - diff * 10.0)
+    return jnp.where((cpu_frac >= 1.0) | (mem_frac >= 1.0), 0.0, score)
+
+
+def node_affinity(sel_group: jnp.ndarray,
+                  sel_pref_counts: jnp.ndarray) -> jnp.ndarray:
+    """CalculateNodeAffinityPriority (node_affinity.go:32-86): weighted
+    preferred-term match counts, normalized per pod to int(10 * count/max);
+    all-zero when no term matches anywhere."""
+    counts = sel_pref_counts[sel_group].astype(jnp.float32)  # [P,N]
+    max_count = jnp.max(counts, axis=1, keepdims=True)
+    score = _trunc(10.0 * counts / jnp.maximum(max_count, 1e-9))
+    return jnp.where(max_count > 0, score, 0.0)
+
+
+def taint_toleration(pod_tol_prefer: jnp.ndarray,
+                     node_taints_prefer: jnp.ndarray) -> jnp.ndarray:
+    """ComputeTaintTolerationPriority (taint_toleration.go:54-105): count
+    intolerable PreferNoSchedule taints per node; score
+    int((1 - count/max)*10), or 10 for every node when max==0."""
+    counts = jnp.einsum("pt,nt->pn", (~pod_tol_prefer).astype(jnp.float32),
+                        node_taints_prefer.astype(jnp.float32))
+    max_count = jnp.max(counts, axis=1, keepdims=True)
+    score = _trunc((1.0 - counts / jnp.maximum(max_count, 1e-9)) * 10.0)
+    return jnp.where(max_count > 0, score, 10.0)
+
+
+def selector_spread(spread_group: jnp.ndarray, spread_node_counts: jnp.ndarray,
+                    spread_zone_counts: jnp.ndarray,
+                    spread_has_zones: jnp.ndarray,
+                    node_zone_id: jnp.ndarray) -> jnp.ndarray:
+    """SelectorSpreadPriority (selector_spreading.go:63-175): fewer same-
+    selector pods is better; with zones, blend node score 1/3 with zone score
+    2/3 (zoneWeighting, selector_spreading.go:39).
+
+    spread_zone_counts is [S, Z] (counts per compact zone id); per-node zone
+    counts are gathered through ``node_zone_id`` [N] (-1 = node has no zone).
+    Reference arithmetic is float32 throughout (maxPriority float32 = 10)."""
+    counts = spread_node_counts[spread_group]  # [P,N] f32
+    zc = spread_zone_counts[spread_group]  # [P,Z]
+    node_has_zone = node_zone_id >= 0  # [N]
+    zcounts = jnp.take_along_axis(
+        zc, jnp.clip(node_zone_id, 0)[None, :].repeat(zc.shape[0], 0), axis=1)
+    zcounts = jnp.where(node_has_zone[None, :], zcounts, 0.0)  # [P,N]
+    has_zones = spread_has_zones[spread_group][:, None]  # [P,1]
+    max_count = jnp.max(counts, axis=1, keepdims=True)
+    f = jnp.where(max_count > 0,
+                  10.0 * ((max_count - counts) / jnp.maximum(max_count, 1e-9)),
+                  10.0)
+    max_zone = jnp.max(zc, axis=1, keepdims=True)  # max over zones
+    zscore = 10.0 * ((max_zone - zcounts) / jnp.maximum(max_zone, 1e-9))
+    blended = f * (1.0 - 2.0 / 3.0) + (2.0 / 3.0) * zscore
+    # Only nodes with zone info get blended (zoneId != "" check at :158).
+    f = jnp.where(has_zones & node_has_zone[None, :] & (max_zone > 0), blended, f)
+    return _trunc(f)
+
+
+# image_locality.go constants in KiB (priorities.go:199-203: 23 MB / 1000 MB
+# with mb = 1024*1024 bytes).
+_MIN_IMG_KIB = 23 * 1024
+_MAX_IMG_KIB = 1000 * 1024
+
+
+def image_locality(pod_images: jnp.ndarray,
+                   node_image_kib: jnp.ndarray) -> jnp.ndarray:
+    """ImageLocalityPriority (priorities.go:205-263): sum the sizes of the
+    pod's container images already present on the node (per-container
+    multiplicity), bucket into 0-10."""
+    sums = jnp.einsum("pi,ni->pn", pod_images.astype(jnp.float32),
+                      node_image_kib.astype(jnp.float32)).astype(jnp.int32)
+    clamped = jnp.minimum(sums, _MAX_IMG_KIB)
+    mid = (10 * (clamped - _MIN_IMG_KIB)) // (_MAX_IMG_KIB - _MIN_IMG_KIB) + 1
+    score = jnp.where(sums < _MIN_IMG_KIB, 0,
+                      jnp.where(sums >= _MAX_IMG_KIB, 10, mid))
+    return score.astype(jnp.float32)
+
+
+def node_label(n_pods: int, node_row: jnp.ndarray) -> jnp.ndarray:
+    """CalculateNodeLabelPriority (priorities.go:160-197): policy-configured
+    label presence/absence — 10 or 0 per node, pod-independent."""
+    return jnp.broadcast_to(jnp.where(node_row, 10.0, 0.0)[None, :],
+                            (n_pods, node_row.shape[0]))
+
+
+def node_prefer_avoid(avoid_mask: jnp.ndarray) -> jnp.ndarray:
+    """CalculateNodePreferAvoidPodsPriority (priorities.go:326-398): 0 where
+    the node's preferAvoidPods annotation names the pod's controller, else 10.
+    ``avoid_mask`` [P,N] is compiled host-side from annotations + listers."""
+    return jnp.where(avoid_mask, 0.0, 10.0)
+
+
+def equal_priority(n_pods: int, n_nodes: int) -> jnp.ndarray:
+    """EqualPriority (generic_scheduler.go:317-326): constant 1."""
+    return jnp.ones((n_pods, n_nodes), jnp.float32)

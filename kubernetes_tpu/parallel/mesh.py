@@ -1,0 +1,89 @@
+"""Device-mesh sharding for the scheduling tensors.
+
+The reference parallelizes one decision across 16 goroutines over the node
+list (workqueue.Parallelize, generic_scheduler.go:182) and across priority
+functions (goroutine per priority, :255-285).  The TPU-native scaling axis is
+the same one — nodes — but expressed as a sharded mesh dimension: every
+``[*, N]`` tensor (node features, aggregates, masks, score planes, group
+tables) is sharded over the ``nodes`` mesh axis, the ``[P, *]`` pod tensors
+are sharded over the ``batch`` axis (data-parallel over pods), and XLA
+inserts the ICI collectives (all-reduce for per-pod max normalizations,
+all-gather for argmax host selection) that the goroutine fan-in/fan-out
+performed on CPU.
+
+A cluster of 5k nodes x few hundred feature columns fits easily in one
+chip's HBM; the mesh pays off on the [P,N,*] intermediates (30k x 5k masks
+and score planes), which shard cleanly over both axes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubernetes_tpu.engine.solver import DeviceBatch, DeviceCluster
+
+BATCH_AXIS = "batch"
+NODE_AXIS = "nodes"
+
+
+def make_mesh(n_devices: Optional[int] = None, batch: int = 1) -> Mesh:
+    """1D node-sharded mesh by default; pass batch>1 for a 2D (batch, nodes)
+    mesh for the one-shot evaluate path."""
+    devs = jax.devices()[: (n_devices or len(jax.devices()))]
+    n = len(devs)
+    assert n % batch == 0, f"{n} devices not divisible by batch={batch}"
+    arr = np.array(devs).reshape(batch, n // batch)
+    return Mesh(arr, (BATCH_AXIS, NODE_AXIS))
+
+
+# Which DeviceCluster fields carry the node axis as dim 0 (all of them).
+_CLUSTER_NODE_FIELDS = set(DeviceCluster._fields)
+# DeviceBatch fields whose dim 0 is the pod axis.
+_BATCH_POD_FIELDS = {"request", "zero_request", "nonzero", "best_effort",
+                     "host_idx", "ports", "vol_ro", "vol_rw", "tol_nosched",
+                     "tol_prefer", "has_tolerations", "images", "sel_group",
+                     "spread_group", "spread_incr", "avoid_mask"}
+# Group tables etc. whose last/only meaningful axis is nodes.
+_BATCH_NODE_LAST_FIELDS = {"sel_required", "sel_pref_counts",
+                           "spread_node_counts"}
+_BATCH_REPLICATED_FIELDS = {"spread_zone_counts", "spread_has_zones"}
+_BATCH_NODE_VEC_FIELDS = {"node_zone_id"}
+
+
+def shard_cluster(c: DeviceCluster, mesh: Mesh) -> DeviceCluster:
+    """Place every cluster tensor with its node axis sharded over the mesh."""
+    out = {}
+    for name, arr in zip(DeviceCluster._fields, c):
+        spec = P(NODE_AXIS) if arr.ndim == 1 else P(NODE_AXIS, None)
+        out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return DeviceCluster(**out)
+
+
+def shard_batch(b: DeviceBatch, mesh: Mesh,
+                shard_pods: bool = False) -> DeviceBatch:
+    """Shard group tables over nodes; optionally shard pod-axis tensors over
+    the batch axis (for the one-shot evaluate; the sequential scan needs
+    per-step dynamic slices of the pod axis, which stay replicated)."""
+    out = {}
+    for name, arr in zip(DeviceBatch._fields, b):
+        if name == "pods":
+            out[name] = arr
+            continue
+        if name in _BATCH_NODE_LAST_FIELDS:
+            spec = P(None, NODE_AXIS)
+        elif name == "avoid_mask":
+            spec = P(BATCH_AXIS if shard_pods else None, NODE_AXIS)
+        elif name in _BATCH_NODE_VEC_FIELDS:
+            spec = P(NODE_AXIS)
+        elif name in _BATCH_REPLICATED_FIELDS:
+            spec = P(*([None] * arr.ndim))
+        elif name in _BATCH_POD_FIELDS and shard_pods:
+            spec = P(BATCH_AXIS) if arr.ndim == 1 else P(BATCH_AXIS, None)
+        else:
+            spec = P(*([None] * arr.ndim))
+        out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return DeviceBatch(**out)

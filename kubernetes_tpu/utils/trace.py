@@ -1,0 +1,36 @@
+"""Step tracer (pkg/util/trace.go:38-71): the scheduler wraps every Schedule
+call and logs step timings when the total exceeds a threshold
+(generic_scheduler.go:79-85 uses 20 ms)."""
+
+from __future__ import annotations
+
+import logging
+import time
+
+logger = logging.getLogger("kubernetes_tpu.trace")
+
+TRACE_THRESHOLD_S = 0.020
+
+
+class Trace:
+    def __init__(self, name: str):
+        self.name = name
+        self.start = time.monotonic()
+        self.steps: list[tuple[float, str]] = []
+
+    def step(self, msg: str) -> None:
+        self.steps.append((time.monotonic(), msg))
+
+    def total_s(self) -> float:
+        return time.monotonic() - self.start
+
+    def log_if_long(self, threshold_s: float = TRACE_THRESHOLD_S) -> None:
+        total = self.total_s()
+        if total >= threshold_s:
+            lines = [f'Trace "{self.name}" (total {total * 1e3:.1f}ms):']
+            last = self.start
+            for t, msg in self.steps:
+                lines.append(f'  [{(t - self.start) * 1e3:.1f}ms] '
+                             f'(+{(t - last) * 1e3:.1f}ms) {msg}')
+                last = t
+            logger.info("\n".join(lines))
