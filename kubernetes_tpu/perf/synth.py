@@ -1,0 +1,123 @@
+"""Synthetic cluster generator — the kubemark analogue.
+
+The reference scales itself with two rigs this module stands in for:
+
+* the scheduler perf rig (``test/component/scheduler/perf/util.go:85-130``):
+  N identical ready nodes (110 pods / 4 CPU / 32 Gi) plus pause pods
+  requesting 100m / 500Mi, no kubelets — pods only ever *bind*;
+* kubemark (``docs/proposals/kubemark.md``): ~1000 hollow nodes with
+  realistic label/zone topology against a real master.
+
+``make_nodes``/``make_pods`` produce those populations as host API objects;
+a ``profile`` knob moves from the uniform perf-rig shape to a mixed kubemark
+shape (zones/regions, heterogeneous capacities, label-selected services,
+spreading controllers, tolerations, node selectors).
+
+Deterministic for a given seed: the driver and tests rely on reproducibility.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+
+_READY = [api.NodeCondition(api.NODE_READY, "True")]
+
+
+def make_nodes(n: int, seed: int = 0, profile: str = "uniform",
+               n_zones: int = 0, milli_cpu: int = 4000,
+               memory: int = 32 * 1024 ** 3, pods: int = 110) -> list[api.Node]:
+    """N ready nodes.  ``uniform`` mirrors the perf rig's identical nodes;
+    ``mixed`` adds zone/region labels (3 regions x n_zones) and capacity
+    jitter like a kubemark fleet."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        labels = {api.HOSTNAME_LABEL: f"node-{i}"}
+        cpu, mem, npods = milli_cpu, memory, pods
+        if profile == "mixed":
+            if n_zones > 0:
+                z = int(rng.randint(n_zones))
+                labels[api.ZONE_LABEL] = f"zone-{z}"
+                labels[api.REGION_LABEL] = f"region-{z % 3}"
+            labels["kt/pool"] = f"pool-{int(rng.randint(4))}"
+            scale = float(rng.choice([0.5, 1.0, 1.0, 2.0]))
+            cpu, mem = int(milli_cpu * scale), int(memory * scale)
+        out.append(api.Node(
+            name=f"node-{i}", labels=labels,
+            allocatable_milli_cpu=cpu, allocatable_memory=mem,
+            allocatable_pods=npods, conditions=list(_READY)))
+    return out
+
+
+def _pause_pod(i: int, namespace: str = "default",
+               labels: dict | None = None,
+               milli_cpu: int = 100, memory: int = 500 * 1024 ** 2,
+               **kw) -> api.Pod:
+    """The perf rig's pause pod (util.go:113-130): 100m / 500Mi requests."""
+    return api.Pod(
+        name=f"pod-{i}", namespace=namespace, labels=labels or {},
+        containers=[api.Container(
+            name="pause", image="kubernetes/pause:go",
+            requests={"cpu": f"{milli_cpu}m", "memory": str(memory)},
+            ports=[api.ContainerPort(container_port=80)])],
+        **kw)
+
+
+def make_pods(n: int, seed: int = 1, profile: str = "uniform",
+              n_services: int = 0, namespace: str = "default") -> list[api.Pod]:
+    """N pending pods.  ``uniform`` = identical pause pods; ``mixed`` adds
+    service-labeled spreading groups, node selectors, and affinity
+    annotations in kubemark-like proportions."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        if profile == "uniform":
+            out.append(_pause_pod(i, namespace))
+            continue
+        r = rng.rand()
+        labels: dict[str, str] = {}
+        annotations: dict[str, str] = {}
+        node_selector: dict[str, str] = {}
+        cpu = int(rng.choice([50, 100, 200, 500]))
+        mem = int(rng.choice([128, 256, 500, 1024])) * 1024 ** 2
+        if n_services and r < 0.4:  # service-member pods spread
+            labels["app"] = f"svc-{int(rng.randint(n_services))}"
+        if 0.4 <= r < 0.5:
+            node_selector["kt/pool"] = f"pool-{int(rng.randint(4))}"
+        if 0.5 <= r < 0.55:  # preferred zone affinity via annotation
+            annotations[api.AFFINITY_ANNOTATION_KEY] = json.dumps({
+                "nodeAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [{
+                        "weight": 10,
+                        "preference": {"matchExpressions": [{
+                            "key": api.ZONE_LABEL, "operator": "In",
+                            "values": [f"zone-{int(rng.randint(4))}"]}]},
+                    }]}})
+        out.append(_pause_pod(i, namespace, labels=labels, milli_cpu=cpu,
+                              memory=mem, node_selector=node_selector,
+                              annotations=annotations))
+    return out
+
+
+def make_services(n: int, namespace: str = "default") -> list[api.Service]:
+    return [api.Service(name=f"svc-{i}", namespace=namespace,
+                        selector={"app": f"svc-{i}"}) for i in range(n)]
+
+
+def make_rig(n_nodes: int, n_pods: int, profile: str = "mixed",
+             n_zones: int = 4, n_services: int = 4):
+    """Assembled scheduler + pending pods — the mustSetupScheduler analogue
+    (util.go:46-74).  Returns (scheduler, pods)."""
+    from kubernetes_tpu.cache.scheduler_cache import SchedulerCache
+    from kubernetes_tpu.engine.generic_scheduler import GenericScheduler, Listers
+
+    cache = SchedulerCache()
+    for nd in make_nodes(n_nodes, profile=profile, n_zones=n_zones):
+        cache.add_node(nd)
+    sched = GenericScheduler(
+        cache=cache, listers=Listers(services=make_services(n_services)))
+    return sched, make_pods(n_pods, profile=profile, n_services=n_services)

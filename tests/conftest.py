@@ -1,11 +1,19 @@
 """Test environment: force JAX onto a virtual 8-device CPU platform so
 sharding/pjit paths are exercised without TPU hardware (the driver separately
-dry-runs the multi-chip path)."""
+dry-runs the multi-chip path).
+
+The axon TPU plugin (sitecustomize) overrides ``JAX_PLATFORMS`` at interpreter
+startup, so the env var alone is not enough — we also force the platform via
+``jax.config`` before any backend is initialized."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags +
                                " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
