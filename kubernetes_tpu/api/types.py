@@ -182,8 +182,12 @@ class LabelSelector:
 
 @dataclass(frozen=True)
 class PodAffinityTerm:
+    """getNamespacesFromPodAffinityTerm (priorities/util/topologies.go:31-38)
+    distinguishes nil namespaces (=> the affinity pod's own namespace) from an
+    empty list (=> every namespace), hence Optional here."""
+
     label_selector: Optional[LabelSelector] = None
-    namespaces: tuple[str, ...] = ()  # empty => the pod's own namespace
+    namespaces: Optional[tuple[str, ...]] = None  # None => own ns; () => all
     topology_key: str = ""
 
 
@@ -425,9 +429,10 @@ def _parse_label_selector(d: Optional[dict]) -> Optional[LabelSelector]:
 
 
 def _parse_pod_affinity_term(d: dict) -> PodAffinityTerm:
+    ns = d.get("namespaces")
     return PodAffinityTerm(
         label_selector=_parse_label_selector(d.get("labelSelector")),
-        namespaces=tuple(d.get("namespaces") or ()),
+        namespaces=None if ns is None else tuple(ns),
         topology_key=d.get("topologyKey", ""))
 
 

@@ -47,6 +47,9 @@ class SchedulerCache:
         self._node_order: list[str] = []
         self._pod_states: dict[str, _PodState] = {}
         self._node_pods: dict[str, dict[str, api.Pod]] = {}
+        # PodsWithAffinity analogue (node_info.go podsWithAffinity): attached
+        # pods carrying any affinity annotation, for the sig compiler.
+        self._affinity_pods: dict[str, api.Pod] = {}
         self._nt: Optional[fc.NodeTensors] = None
         self._agg: Optional[fc.NodeAggregates] = None
         self._ep: Optional[fc.ExistingPodTensors] = None
@@ -148,12 +151,22 @@ class SchedulerCache:
     def node_pods(self, node_name: str) -> list[api.Pod]:
         return list(self._node_pods.get(node_name, {}).values())
 
+    def affinity_pods(self) -> list[tuple[api.Pod, int]]:
+        """(pod, node index) for every attached pod with affinity annotations
+        (incl. assumed pods — matching the reference's assumed-pod
+        visibility).  Node index -1 if the pod's node is unknown."""
+        self._ensure_tensors()
+        return [(p, self._nt.name_to_idx.get(p.node_name, -1))
+                for p in self._affinity_pods.values()]
+
     # ---- tensor maintenance -------------------------------------------
 
     def _attach(self, pod: api.Pod, node_name: str) -> None:
         if not node_name:
             return
         self._node_pods.setdefault(node_name, {})[pod.key] = pod
+        if pod.affinity() is not None:
+            self._affinity_pods[pod.key] = pod
         if not self._dirty_nodes and self._nt is not None:
             idx = self._nt.name_to_idx.get(node_name)
             if idx is None:
@@ -170,6 +183,7 @@ class SchedulerCache:
             return
         pods = self._node_pods.get(node_name, {})
         pods.pop(pod.key, None)
+        self._affinity_pods.pop(pod.key, None)
         if not self._dirty_nodes and self._nt is not None:
             idx = self._nt.name_to_idx.get(node_name)
             if idx is not None:

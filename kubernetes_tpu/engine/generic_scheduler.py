@@ -98,7 +98,10 @@ class GenericScheduler:
         batch = fb.compile_batch(
             pods, nt, self.cache.space, ep=ep, nodes=nodes,
             spread_selectors=self.listers.spread_selectors,
-            controller_refs=self.listers.controller_refs)
+            controller_refs=self.listers.controller_refs,
+            affinity_pods=self.cache.affinity_pods(),
+            hard_pod_affinity_weight=(
+                self.policy.hard_pod_affinity_symmetric_weight))
         db = sv.device_batch(batch)
         dc = sv.device_cluster(nt, agg, self.cache.space)
         return batch, db, dc, nt

@@ -32,11 +32,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_tpu.api.policy import Policy, expand_predicates
+from kubernetes_tpu.features.affinity import AffinityTensors
 from kubernetes_tpu.features.batch import PodBatch
 from kubernetes_tpu.features.compiler import (FeatureSpace, NodeAggregates,
                                               NodeTensors, RES_CPU, RES_MEM,
                                               RES_PODS)
-from kubernetes_tpu.ops import combine, predicates as pr, priorities as prio
+from kubernetes_tpu.ops import (combine, interpod, predicates as pr,
+                                priorities as prio)
 
 # Predicates whose masks do not depend on in-batch placements.
 STATIC_PREDICATES = ("PodFitsHost", "MatchNodeSelector", "HostName",
@@ -44,21 +46,44 @@ STATIC_PREDICATES = ("PodFitsHost", "MatchNodeSelector", "HostName",
                      "CheckNodeDiskPressure", "NewNodeLabelPredicate")
 # Implemented dynamic predicates.
 DYNAMIC_PREDICATES = ("PodFitsResources", "PodFitsHostPorts", "PodFitsPorts",
-                      "NoDiskConflict")
+                      "NoDiskConflict", "MatchInterPodAffinity")
 # Recognized but not yet tensorized: evaluated as pass-through (tracked so
 # callers can surface the gap).  NoVolumeZoneConflict / MaxPD need PV/PVC
-# listers; MatchInterPodAffinity lands with the affinity kernels.
+# listers.
 PASSTHROUGH_PREDICATES = ("NoVolumeZoneConflict", "MaxEBSVolumeCount",
-                          "MaxGCEPDVolumeCount", "MatchInterPodAffinity",
-                          "ServiceAffinity")
+                          "MaxGCEPDVolumeCount", "ServiceAffinity")
 
 STATIC_PRIORITIES = ("NodeAffinityPriority", "TaintTolerationPriority",
                      "ImageLocalityPriority", "NodePreferAvoidPodsPriority",
                      "EqualPriority", "NodeLabelPriority")
 DYNAMIC_PRIORITIES = ("LeastRequestedPriority", "MostRequestedPriority",
                       "BalancedResourceAllocation", "SelectorSpreadPriority",
-                      "ServiceSpreadingPriority")
-PASSTHROUGH_PRIORITIES = ("InterPodAffinityPriority", "ServiceAntiAffinityPriority")
+                      "ServiceSpreadingPriority", "InterPodAffinityPriority")
+PASSTHROUGH_PRIORITIES = ("ServiceAntiAffinityPriority",)
+
+
+class DeviceAffinity(NamedTuple):
+    """AffinityTensors' array fields as device arrays (features/affinity.py
+    documents each; host-only fields n_default/has_any are dropped)."""
+
+    node_dom: jnp.ndarray
+    match_key: jnp.ndarray
+    match_cnt: jnp.ndarray
+    match_total: jnp.ndarray
+    match_src: jnp.ndarray
+    aff_need: jnp.ndarray
+    aff_self: jnp.ndarray
+    anti_need: jnp.ndarray
+    pref_w: jnp.ndarray
+    decl_key: jnp.ndarray
+    decl_reach: jnp.ndarray
+    decl_match: jnp.ndarray
+    decl_src: jnp.ndarray
+    sym_key: jnp.ndarray
+    sym_w: jnp.ndarray
+    sym_cnt: jnp.ndarray
+    sym_match: jnp.ndarray
+    sym_src: jnp.ndarray
 
 
 class DeviceBatch(NamedTuple):
@@ -86,6 +111,7 @@ class DeviceBatch(NamedTuple):
     spread_incr: jnp.ndarray
     node_zone_id: jnp.ndarray
     avoid_mask: jnp.ndarray
+    aff: DeviceAffinity
 
 
 class DeviceCluster(NamedTuple):
@@ -113,7 +139,11 @@ def _pad_cols(a: np.ndarray, width: int) -> np.ndarray:
 
 
 def device_batch(b: PodBatch) -> DeviceBatch:
-    return DeviceBatch(*[jnp.asarray(getattr(b, f)) for f in DeviceBatch._fields])
+    parts = [jnp.asarray(getattr(b, f)) for f in DeviceBatch._fields
+             if f != "aff"]
+    aff = DeviceAffinity(*[jnp.asarray(getattr(b.aff, f))
+                           for f in DeviceAffinity._fields])
+    return DeviceBatch(*parts, aff=aff)
 
 
 def device_cluster(nt: NodeTensors, agg: NodeAggregates,
@@ -159,6 +189,11 @@ def _predicate_mask(name: str, b: DeviceBatch, c: DeviceCluster,
         return pr.pod_fits_host_ports(b.ports, c.ports_used)
     if name == "NoDiskConflict":
         return pr.no_disk_conflict(b.vol_rw, b.vol_ro, c.vol_any, c.vol_rw)
+    if name == "MatchInterPodAffinity":
+        a = b.aff
+        return interpod.predicate_mask(a.aff_need, a.aff_self, a.anti_need,
+                                       a.decl_match, a.match_cnt,
+                                       a.match_total, a.decl_reach)
     if name in PASSTHROUGH_PREDICATES:
         return jnp.ones((p, n_nodes), bool)
     raise KeyError(f"unknown predicate {name!r}")
@@ -174,9 +209,11 @@ def _priority_plane(name: str, b: DeviceBatch, c: DeviceCluster,
     if name == "BalancedResourceAllocation":
         return prio.balanced_resource_allocation(b.nonzero, c.nonzero, c.alloc)
     if name == "NodeAffinityPriority":
-        return prio.node_affinity(b.sel_group, b.sel_pref_counts)
+        return prio.node_affinity(b.sel_group, b.sel_pref_counts,
+                                  c.schedulable)
     if name == "TaintTolerationPriority":
-        return prio.taint_toleration(b.tol_prefer, c.taints_prefer)
+        return prio.taint_toleration(b.tol_prefer, c.taints_prefer,
+                                     c.schedulable)
     if name == "ImageLocalityPriority":
         return prio.image_locality(b.images, c.image_kib)
     if name == "NodePreferAvoidPodsPriority":
@@ -184,7 +221,12 @@ def _priority_plane(name: str, b: DeviceBatch, c: DeviceCluster,
     if name in ("SelectorSpreadPriority", "ServiceSpreadingPriority"):
         return prio.selector_spread(b.spread_group, b.spread_node_counts,
                                     b.spread_zone_counts, b.spread_has_zones,
-                                    b.node_zone_id)
+                                    b.node_zone_id, c.schedulable)
+    if name == "InterPodAffinityPriority":
+        a = b.aff
+        counts = interpod.priority_counts(a.pref_w, a.match_cnt, a.sym_match,
+                                          a.sym_w, a.sym_cnt)
+        return interpod.priority_score(counts, c.schedulable, prio._trunc)
     if name == "NodeLabelPriority":
         return prio.node_label(p, extra["node_label_prio_row"])
     if name == "EqualPriority":
@@ -242,6 +284,7 @@ class Solver:
         """
         n = c.alloc.shape[0]
         p = b.request.shape[0]
+        a = b.aff
 
         # Hoist placement-invariant work: static predicate masks and static
         # priority planes are the big vocab contractions.
@@ -255,6 +298,7 @@ class Solver:
         use_ports = any(nm in self.predicate_names
                         for nm in ("PodFitsHostPorts", "PodFitsPorts"))
         use_volumes = "NoDiskConflict" in self.predicate_names
+        use_interpod = "MatchInterPodAffinity" in self.predicate_names
         static_score = jnp.zeros((p, n), jnp.float32)
         dynamic_prios = []
         for name, weight in self.priority_specs:
@@ -264,58 +308,82 @@ class Solver:
                 static_score += jnp.float32(weight) * \
                     _priority_plane(name, b, c, n, {})
         dynamic_prios = tuple(dynamic_prios)
+        use_interpod_prio = any(nm == "InterPodAffinityPriority"
+                                for nm, _ in dynamic_prios)
+        track_affinity = use_interpod or use_interpod_prio
 
         fits_pods_alloc = c.alloc[:, RES_PODS]
         zone_ids = b.node_zone_id  # [N]
+        f32 = jnp.float32
 
         def step(state, xs):
-            (requested, nonzero, ports_used, vol_any, vol_rw,
-             sp_node, sp_zone, counter) = state
-            (req_i, zero_i, nz_i, ports_i, vro_i, vrw_i, smask_i, sscore_i,
-             sgroup_i, incr_i) = xs
+            counter = state["counter"]
 
             # Dynamic predicates on current aggregates (predicates.go:444-485,
             # :721-741, :100-153) — O(N) per step.
-            feasible = smask_i
+            feasible = xs["smask"]
             if use_resources:
+                requested = state["requested"]
                 fits_pods = (requested[:, RES_PODS] + 1) <= fits_pods_alloc
                 free = c.alloc[:, :3] - requested[:, :3]
-                fits_res = jnp.all(req_i[None, :3] <= free, axis=-1)
-                feasible &= fits_pods & (zero_i | fits_res)
+                fits_res = jnp.all(xs["req"][None, :3] <= free, axis=-1)
+                feasible &= fits_pods & (xs["zero"] | fits_res)
             if use_ports:
                 port_conflict = jnp.einsum(
-                    "c,nc->n", ports_i.astype(jnp.float32),
-                    ports_used.astype(jnp.float32)) > 0
+                    "c,nc->n", xs["ports"].astype(f32),
+                    state["ports_used"].astype(f32)) > 0
                 feasible &= ~port_conflict
             if use_volumes:
                 vol_conflict = (
-                    jnp.einsum("w,nw->n", vrw_i.astype(jnp.float32),
-                               vol_any.astype(jnp.float32)) +
-                    jnp.einsum("w,nw->n", vro_i.astype(jnp.float32),
-                               vol_rw.astype(jnp.float32))) > 0
+                    jnp.einsum("w,nw->n", xs["vrw"].astype(f32),
+                               state["vol_any"].astype(f32)) +
+                    jnp.einsum("w,nw->n", xs["vro"].astype(f32),
+                               state["vol_rw"].astype(f32))) > 0
                 feasible &= ~vol_conflict
+            if track_affinity:
+                reach = state["match_cnt"] > 0.0  # [Sm, N]
+            if use_interpod:
+                # MatchInterPodAffinity for one pod against current state
+                # (predicates.go:825-853 with the self-match escape hatch).
+                live = xs["aff_need"] & ~(xs["aff_self"] &
+                                          (state["match_total"] == 0.0))
+                viol = (jnp.einsum("s,sn->n", live.astype(f32),
+                                   (~reach).astype(f32)) +
+                        jnp.einsum("s,sn->n", xs["anti_need"].astype(f32),
+                                   reach.astype(f32)) +
+                        jnp.einsum("s,sn->n", xs["decl_match"].astype(f32),
+                                   state["decl_reach"].astype(f32))) > 0
+                feasible &= ~viol
 
             # Dynamic priorities against current aggregates.
-            score = sscore_i
+            score = xs["sscore"]
             for name, weight in dynamic_prios:
-                w = jnp.float32(weight)
+                w = f32(weight)
                 if name == "LeastRequestedPriority":
                     score = score + w * prio.least_requested(
-                        nz_i[None], nonzero, c.alloc)[0]
+                        xs["nz"][None], state["nonzero"], c.alloc)[0]
                 elif name == "MostRequestedPriority":
                     score = score + w * prio.most_requested(
-                        nz_i[None], nonzero, c.alloc)[0]
+                        xs["nz"][None], state["nonzero"], c.alloc)[0]
                 elif name == "BalancedResourceAllocation":
                     score = score + w * prio.balanced_resource_allocation(
-                        nz_i[None], nonzero, c.alloc)[0]
-                elif name in ("SelectorSpreadPriority", "ServiceSpreadingPriority"):
+                        xs["nz"][None], state["nonzero"], c.alloc)[0]
+                elif name in ("SelectorSpreadPriority",
+                              "ServiceSpreadingPriority"):
                     score = score + w * prio.selector_spread(
-                        sgroup_i[None], sp_node, sp_zone,
-                        jnp.asarray(b.spread_has_zones), zone_ids)[0]
+                        xs["sgroup"][None], state["sp_node"],
+                        state["sp_zone"], jnp.asarray(b.spread_has_zones),
+                        zone_ids, c.schedulable)[0]
+                elif name == "InterPodAffinityPriority":
+                    counts = interpod.priority_counts(
+                        xs["pref_w"][None], state["match_cnt"],
+                        xs["sym_match"][None], a.sym_w, state["sym_cnt"])
+                    score = score + w * interpod.priority_score(
+                        counts, c.schedulable, prio._trunc)[0]
 
             # selectHost (generic_scheduler.go:124-141): round-robin among
             # max-score feasible nodes; counter bumps only on success.
-            neg = jnp.float32(-jnp.inf)
+            neg = f32(-jnp.inf)
             masked = jnp.where(feasible, score, neg)
             max_score = jnp.max(masked)
             any_feasible = jnp.any(feasible)
@@ -330,31 +398,63 @@ class Solver:
             placed = choice >= 0
             onehot = (jnp.arange(n, dtype=jnp.int32) == choice) & placed
             oh_i = onehot.astype(jnp.int32)
-            oh_f = onehot.astype(jnp.float32)
-            requested = requested + oh_i[:, None] * req_i[None, :]
-            nonzero = nonzero + oh_i[:, None] * nz_i[None, :]
-            ports_used = ports_used | (onehot[:, None] & ports_i[None, :])
-            vol_any = vol_any | (onehot[:, None] & (vrw_i | vro_i)[None, :])
-            vol_rw = vol_rw | (onehot[:, None] & vrw_i[None, :])
-            sp_node = sp_node + incr_i.astype(jnp.float32)[:, None] * oh_f[None, :]
+            oh_f = onehot.astype(f32)
+            new_state = dict(state)
+            new_state["requested"] = state["requested"] + \
+                oh_i[:, None] * xs["req"][None, :]
+            new_state["nonzero"] = state["nonzero"] + \
+                oh_i[:, None] * xs["nz"][None, :]
+            new_state["ports_used"] = state["ports_used"] | \
+                (onehot[:, None] & xs["ports"][None, :])
+            new_state["vol_any"] = state["vol_any"] | \
+                (onehot[:, None] & (xs["vrw"] | xs["vro"])[None, :])
+            new_state["vol_rw"] = state["vol_rw"] | \
+                (onehot[:, None] & xs["vrw"][None, :])
+            new_state["sp_node"] = state["sp_node"] + \
+                xs["incr"].astype(f32)[:, None] * oh_f[None, :]
             zid = jnp.where(placed, zone_ids[jnp.clip(choice, 0)], -1)
-            zoh = (jnp.arange(sp_zone.shape[1], dtype=jnp.int32) == zid)
-            sp_zone = sp_zone + incr_i.astype(jnp.float32)[:, None] * \
-                zoh.astype(jnp.float32)[None, :]
-            counter = counter + jnp.where(any_feasible, jnp.uint32(1),
-                                          jnp.uint32(0))
-            return (requested, nonzero, ports_used, vol_any, vol_rw,
-                    sp_node, sp_zone, counter), choice
+            zoh = (jnp.arange(state["sp_zone"].shape[1], dtype=jnp.int32)
+                   == zid)
+            new_state["sp_zone"] = state["sp_zone"] + \
+                xs["incr"].astype(f32)[:, None] * zoh.astype(f32)[None, :]
+            if track_affinity:
+                (new_state["match_cnt"], new_state["match_total"],
+                 new_state["decl_reach"], new_state["sym_cnt"]) = \
+                    interpod.place_update(
+                        a.node_dom, a.match_key, state["match_cnt"],
+                        state["match_total"], xs["match_src"],
+                        a.decl_key, state["decl_reach"], xs["decl_src"],
+                        a.sym_key, state["sym_cnt"], xs["sym_src"],
+                        choice, placed)
+            new_state["counter"] = counter + \
+                jnp.where(any_feasible, jnp.uint32(1), jnp.uint32(0))
+            return new_state, choice
 
-        init = (c.requested, c.nonzero, c.ports_used, c.vol_any, c.vol_rw,
-                jnp.asarray(b.spread_node_counts),
-                jnp.asarray(b.spread_zone_counts), last_node_index)
-        xs = (b.request, b.zero_request, b.nonzero, b.ports, b.vol_ro,
-              b.vol_rw, static_mask, static_score, b.spread_group,
-              b.spread_incr)
-        (requested, nonzero, ports_used, vol_any, vol_rw, _, _, counter), \
-            choices = jax.lax.scan(step, init, xs)
-        new_c = c._replace(requested=requested, nonzero=nonzero,
-                           ports_used=ports_used, vol_any=vol_any,
-                           vol_rw=vol_rw)
-        return choices, counter, new_c
+        init = {
+            "requested": c.requested, "nonzero": c.nonzero,
+            "ports_used": c.ports_used, "vol_any": c.vol_any,
+            "vol_rw": c.vol_rw,
+            "sp_node": jnp.asarray(b.spread_node_counts),
+            "sp_zone": jnp.asarray(b.spread_zone_counts),
+            "counter": last_node_index,
+        }
+        xs = {
+            "req": b.request, "zero": b.zero_request, "nz": b.nonzero,
+            "ports": b.ports, "vro": b.vol_ro, "vrw": b.vol_rw,
+            "smask": static_mask, "sscore": static_score,
+            "sgroup": b.spread_group, "incr": b.spread_incr,
+        }
+        if track_affinity:
+            init.update(match_cnt=a.match_cnt, match_total=a.match_total,
+                        decl_reach=a.decl_reach, sym_cnt=a.sym_cnt)
+            xs.update(aff_need=a.aff_need, aff_self=a.aff_self,
+                      anti_need=a.anti_need, decl_match=a.decl_match,
+                      match_src=a.match_src, decl_src=a.decl_src,
+                      pref_w=a.pref_w, sym_match=a.sym_match,
+                      sym_src=a.sym_src)
+        final, choices = jax.lax.scan(step, init, xs)
+        new_c = c._replace(requested=final["requested"],
+                           nonzero=final["nonzero"],
+                           ports_used=final["ports_used"],
+                           vol_any=final["vol_any"], vol_rw=final["vol_rw"])
+        return choices, final["counter"], new_c

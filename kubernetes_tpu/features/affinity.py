@@ -1,0 +1,476 @@
+"""Inter-pod (anti-)affinity compilation: terms -> sig tables -> tensors.
+
+The reference evaluates inter-pod affinity as nested loops over
+(candidate pod x existing pods x terms x nodes) — the quadratic heart of
+``predicates.go:825-1068`` and ``interpod_affinity.go:117-260``.  The TPU
+recast groups every term by its *signature* — (resolved namespace set,
+selector, topology key[, weight]) — and precomputes one [S, N] row table per
+signature family, so the whole per-(pod,node) evaluation becomes three
+[P,S] @ [S,N] contractions on the MXU (see ops/interpod.py).
+
+Three signature families:
+
+``match`` sigs (M) — "does an existing pod match this (ns, selector)?",
+    used by the candidate's OWN terms: required affinity (reach must be
+    nonzero), required anti-affinity (reach must be zero), and preferred
+    ±weight (reach count scales the score).  Reach of sig s =
+    per-node count of matching existing pods' topology domains.
+
+``decl`` sigs (D) — anti-affinity terms DECLARED by existing pods
+    (satisfiesExistingPodsAntiAffinity, predicates.go:1000-1035): candidate
+    matching the sig may not land in the topology of any declaring pod.
+
+``sym`` sigs (Y) — the priority's symmetric soft part
+    (interpod_affinity.go:164-196): terms declared by existing pods
+    (required affinity x hardPodAffinityWeight, preferred affinity +w,
+    preferred anti-affinity -w) score candidate pods that match them.
+
+Topology: ``node_dom[K, N]`` holds a compact domain id per (key, node), -1
+when the node lacks the label; key index -1 in a sig means the term had an
+empty topologyKey, which the reference resolves as "any default failure
+domain" (topologies.go:66-76).  The first ``n_default`` rows are the default
+failure-domain keys.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.features import compiler as fc
+
+# Resolved namespace marker: () after resolution means "all namespaces".
+_ALL_NS = ()
+
+
+def _resolve_ns(term: api.PodAffinityTerm, owner: api.Pod) -> tuple[str, ...]:
+    """getNamespacesFromPodAffinityTerm (topologies.go:31-38)."""
+    if term.namespaces is None:
+        return (owner.namespace,)
+    return tuple(sorted(set(term.namespaces)))
+
+
+def _sel_sig(sel: Optional[api.LabelSelector]):
+    """Hashable selector identity.  None (nil selector) matches nothing
+    (LabelSelectorAsSelector -> Nothing)."""
+    if sel is None:
+        return None
+    return (tuple(sorted(sel.match_labels)),
+            tuple(sorted((e.key, e.operator, tuple(sorted(e.values)))
+                         for e in sel.match_expressions)))
+
+
+@dataclass(frozen=True)
+class Sig:
+    """One deduplicated term signature."""
+
+    namespaces: tuple[str, ...]  # () = all namespaces
+    selector: object             # _sel_sig output (None = matches nothing)
+    key: str                     # topology key ("" = default domains)
+    weight: int = 0              # sym sigs only (signed)
+
+
+class AffinityTensors(NamedTuple):
+    """Device-ready affinity tables for one batch.  All S dims are >= 1
+    (padded with inert rows) so shapes are stable when no affinity exists."""
+
+    node_dom: np.ndarray     # [K, N] int32 domain ids, -1 absent
+    n_default: np.ndarray    # [] int32 — first rows of node_dom = default keys
+    # -- match sigs (candidate's own terms) --
+    match_key: np.ndarray    # [Sm] int32 key row, -1 = any-default
+    match_cnt: np.ndarray    # [Sm, N] f32 — matching existing pods per domain-reach
+    match_total: np.ndarray  # [Sm] f32 — matching existing pods anywhere
+    match_src: np.ndarray    # [P, Sm] bool — batch pod matches sig (placement source)
+    aff_need: np.ndarray     # [P, Sm] bool — required affinity
+    aff_self: np.ndarray     # [P, Sm] bool — self-match escape (predicates.go:1038-1048)
+    anti_need: np.ndarray    # [P, Sm] bool — required anti-affinity
+    pref_w: np.ndarray       # [P, Sm] f32 — signed preferred weight sum
+    # -- decl sigs (existing pods' hard anti-affinity) --
+    decl_key: np.ndarray     # [Sd] int32
+    decl_reach: np.ndarray   # [Sd, N] bool — forbidden topology of declaring pods
+    decl_match: np.ndarray   # [P, Sd] bool — candidate is repelled by sig
+    decl_src: np.ndarray     # [P, Sd] bool — batch pod declares sig
+    # -- sym sigs (existing pods' scored terms) --
+    sym_key: np.ndarray      # [Ss] int32
+    sym_w: np.ndarray        # [Ss] f32 signed weight
+    sym_cnt: np.ndarray      # [Ss, N] f32 — declaring term instances per domain-reach
+    sym_match: np.ndarray    # [P, Ss] bool — candidate matches sig
+    sym_src: np.ndarray      # [P, Ss] bool — batch pod declares term with sig
+    has_any: bool            # static: skip all kernels when False
+
+
+def _pod_matches_sig(sig: Sig, ns: str, labels: dict[str, str]) -> bool:
+    if sig.namespaces != _ALL_NS and ns not in sig.namespaces:
+        return False
+    if sig.selector is None:
+        return False
+    ml, mexpr = sig.selector
+    for k, v in ml:
+        if labels.get(k) != v:
+            return False
+    for k, op, vals in mexpr:
+        has = k in labels
+        if op == "In":
+            if not has or labels[k] not in vals:
+                return False
+        elif op == "NotIn":
+            if has and labels[k] in vals:
+                return False
+        elif op == "Exists":
+            if not has:
+                return False
+        elif op == "DoesNotExist":
+            if has:
+                return False
+        else:
+            return False
+    return True
+
+
+def _sig_match_existing(sig: Sig, ep: fc.ExistingPodTensors,
+                        space: fc.FeatureSpace) -> np.ndarray:
+    """[M] bool — existing pods matching sig (ns + selector), vectorized over
+    the existing-pod label multi-hot."""
+    m = ep.labels.shape[0]
+    cand = ep.alive & (ep.node_idx >= 0)
+    if sig.namespaces != _ALL_NS:
+        ns_ids = [space.namespaces.get(n) for n in sig.namespaces]
+        ns_ids = [i for i in ns_ids if i >= 0]
+        if not ns_ids:
+            return np.zeros(m, bool)
+        cand &= np.isin(ep.ns_id, ns_ids)
+    if sig.selector is None:
+        return np.zeros(m, bool)
+    ml, mexpr = sig.selector
+    mask = cand
+    for k, v in ml:
+        kv = space.labels.kv_get(k, v)
+        mask = mask & (ep.labels[:, kv] if kv >= 0 else False)
+    for k, op, vals in mexpr:
+        kid = space.labels.key_get(k)
+        has = ep.labels[:, kid] if kid >= 0 else np.zeros(m, bool)
+        ids = [space.labels.kv_get(k, v) for v in vals]
+        ids = [i for i in ids if i >= 0]
+        inset = ep.labels[:, ids].any(1) if ids else np.zeros(m, bool)
+        if op == "In":
+            mask = mask & inset
+        elif op == "NotIn":
+            mask = mask & ~inset
+        elif op == "Exists":
+            mask = mask & has
+        elif op == "DoesNotExist":
+            mask = mask & ~has
+        else:
+            return np.zeros(m, bool)
+    return np.asarray(mask, bool)
+
+
+class _DomainTable:
+    """node_dom builder: interned topology keys -> per-node domain ids."""
+
+    def __init__(self, nodes: Sequence[api.Node], n: int):
+        self.nodes = nodes
+        self.n = n
+        self.keys: list[str] = list(api.DEFAULT_FAILURE_DOMAINS)
+        self.key_to_row: dict[str, int] = {k: i for i, k in enumerate(self.keys)}
+        self.n_default = len(self.keys)
+
+    def row(self, key: str) -> int:
+        """Row index for a non-empty topology key ('' handled by caller as -1)."""
+        r = self.key_to_row.get(key)
+        if r is None:
+            r = len(self.keys)
+            self.keys.append(key)
+            self.key_to_row[key] = r
+        return r
+
+    def build(self) -> np.ndarray:
+        n = self.n
+        dom = np.full((len(self.keys), n), -1, np.int32)
+        for ki, key in enumerate(self.keys):
+            vals: dict[str, int] = {}
+            for i, node in enumerate(self.nodes):
+                v = node.labels.get(key)
+                if v:  # len(labels[key]) > 0 (topologies.go:58)
+                    dom[ki, i] = vals.setdefault(v, len(vals))
+        return dom
+
+    def same_topo_row(self, dom: np.ndarray, key_row: int,
+                      node_idx: int) -> np.ndarray:
+        """[N] bool — nodes sharing topology with node_idx under key_row
+        (-1 = any default key), NodesHaveSameTopologyKey semantics."""
+        if key_row >= 0:
+            d = dom[key_row]
+            return (d == d[node_idx]) & (d >= 0)
+        out = np.zeros(dom.shape[1], bool)
+        for r in range(self.n_default):
+            d = dom[r]
+            out |= (d == d[node_idx]) & (d >= 0)
+        return out
+
+
+@dataclass
+class _SigTable:
+    sig_to_idx: dict[Sig, int] = field(default_factory=dict)
+    sigs: list[Sig] = field(default_factory=list)
+
+    def idx(self, sig: Sig) -> int:
+        i = self.sig_to_idx.get(sig)
+        if i is None:
+            i = len(self.sigs)
+            self.sig_to_idx[sig] = i
+            self.sigs.append(sig)
+        return i
+
+
+def _pod_terms(pod: api.Pod):
+    """(required_affinity, required_anti, preferred_affinity_weighted,
+    preferred_anti_weighted) — getPodAffinityTerms/getPodAntiAffinityTerms
+    (predicates.go:881-906) + the priority's preferred lists."""
+    aff = pod.affinity()
+    req_a: tuple = ()
+    req_aa: tuple = ()
+    pref_a: tuple = ()
+    pref_aa: tuple = ()
+    if aff is not None:
+        if aff.pod_affinity is not None:
+            req_a = aff.pod_affinity.required
+            pref_a = aff.pod_affinity.preferred
+        if aff.pod_anti_affinity is not None:
+            req_aa = aff.pod_anti_affinity.required
+            pref_aa = aff.pod_anti_affinity.preferred
+    return req_a, req_aa, pref_a, pref_aa
+
+
+def pod_has_affinity(pod: api.Pod) -> bool:
+    """PodsWithAffinity membership (node_info.go): any affinity annotation."""
+    return pod.affinity() is not None
+
+
+def compile_affinity(pods: Sequence[api.Pod],
+                     affinity_pods: Sequence[tuple[api.Pod, int]],
+                     ep: Optional[fc.ExistingPodTensors],
+                     nodes: Optional[Sequence[api.Node]],
+                     n_nodes: int,
+                     space: fc.FeatureSpace,
+                     hard_pod_affinity_weight: int = 1) -> AffinityTensors:
+    """Build the batch's affinity tables.
+
+    ``affinity_pods``: (existing pod, node index) for every assigned pod with
+    an affinity annotation (the cache's PodsWithAffinity analogue).
+    ``ep``: existing-pod label tensors for vectorized own-term matching.
+    ``nodes`` may be None (no label access): every topology domain is then
+    empty, matching nodes without the label.
+    """
+    p = len(pods)
+    n = n_nodes
+    dt = _DomainTable(nodes or [], n)
+
+    m_tab, d_tab, y_tab = _SigTable(), _SigTable(), _SigTable()
+
+    # -- candidate pods' own terms -> match sigs ------------------------
+    pod_m: list[list[tuple[int, str]]] = []  # per pod: (sig idx, kind)
+    pod_pref: list[list[tuple[int, int]]] = []  # per pod: (sig idx, ±weight)
+    any_affinity = False
+    for pod in pods:
+        req_a, req_aa, pref_a, pref_aa = _pod_terms(pod)
+        entries: list[tuple[int, str]] = []
+        prefs: list[tuple[int, int]] = []
+        for t in req_a:
+            sig = Sig(_resolve_ns(t, pod), _sel_sig(t.label_selector),
+                      t.topology_key)
+            entries.append((m_tab.idx(sig), "aff"))
+        for t in req_aa:
+            sig = Sig(_resolve_ns(t, pod), _sel_sig(t.label_selector),
+                      t.topology_key)
+            entries.append((m_tab.idx(sig), "anti"))
+        for wt in pref_a:
+            if wt.weight == 0:
+                continue
+            t = wt.pod_affinity_term
+            sig = Sig(_resolve_ns(t, pod), _sel_sig(t.label_selector),
+                      t.topology_key)
+            prefs.append((m_tab.idx(sig), wt.weight))
+        for wt in pref_aa:
+            if wt.weight == 0:
+                continue
+            t = wt.pod_affinity_term
+            sig = Sig(_resolve_ns(t, pod), _sel_sig(t.label_selector),
+                      t.topology_key)
+            prefs.append((m_tab.idx(sig), -wt.weight))
+        if entries or prefs:
+            any_affinity = True
+        pod_m.append(entries)
+        pod_pref.append(prefs)
+
+    # -- existing pods' terms -> decl + sym sigs ------------------------
+    decl_sources: dict[int, list[int]] = {}  # decl sig -> [node_idx]
+    sym_sources: dict[int, list[int]] = {}   # sym sig -> [node_idx] per instance
+    for epod, nidx in affinity_pods:
+        if nidx < 0 or nidx >= n:
+            continue
+        req_a, req_aa, pref_a, pref_aa = _pod_terms(epod)
+        for t in req_aa:
+            sig = Sig(_resolve_ns(t, epod), _sel_sig(t.label_selector),
+                      t.topology_key)
+            decl_sources.setdefault(d_tab.idx(sig), []).append(nidx)
+            any_affinity = True
+        if hard_pod_affinity_weight > 0:
+            for t in req_a:
+                sig = Sig(_resolve_ns(t, epod), _sel_sig(t.label_selector),
+                          t.topology_key, weight=hard_pod_affinity_weight)
+                sym_sources.setdefault(y_tab.idx(sig), []).append(nidx)
+                any_affinity = True
+        for wt in pref_a:
+            if wt.weight == 0:
+                continue
+            t = wt.pod_affinity_term
+            sig = Sig(_resolve_ns(t, epod), _sel_sig(t.label_selector),
+                      t.topology_key, weight=wt.weight)
+            sym_sources.setdefault(y_tab.idx(sig), []).append(nidx)
+            any_affinity = True
+        for wt in pref_aa:
+            if wt.weight == 0:
+                continue
+            t = wt.pod_affinity_term
+            sig = Sig(_resolve_ns(t, epod), _sel_sig(t.label_selector),
+                      t.topology_key, weight=-wt.weight)
+            sym_sources.setdefault(y_tab.idx(sig), []).append(nidx)
+            any_affinity = True
+
+    # Batch pods that DECLARE terms (for in-batch sequential visibility):
+    # placing pod j extends decl reach / sym counts / match counts.
+    # Register their sigs too so the scan state has rows for them.
+    pod_decl: list[list[int]] = []
+    pod_sym: list[list[int]] = []
+    for pod in pods:
+        req_a, req_aa, pref_a, pref_aa = _pod_terms(pod)
+        dsigs: list[int] = []
+        ysigs: list[int] = []
+        for t in req_aa:
+            sig = Sig(_resolve_ns(t, pod), _sel_sig(t.label_selector),
+                      t.topology_key)
+            dsigs.append(d_tab.idx(sig))
+        if hard_pod_affinity_weight > 0:
+            for t in req_a:
+                sig = Sig(_resolve_ns(t, pod), _sel_sig(t.label_selector),
+                          t.topology_key, weight=hard_pod_affinity_weight)
+                ysigs.append(y_tab.idx(sig))
+        for wt in pref_a:
+            if wt.weight == 0:
+                continue
+            t = wt.pod_affinity_term
+            ysigs.append(y_tab.idx(Sig(_resolve_ns(t, pod),
+                                       _sel_sig(t.label_selector),
+                                       t.topology_key, weight=wt.weight)))
+        for wt in pref_aa:
+            if wt.weight == 0:
+                continue
+            t = wt.pod_affinity_term
+            ysigs.append(y_tab.idx(Sig(_resolve_ns(t, pod),
+                                       _sel_sig(t.label_selector),
+                                       t.topology_key, weight=-wt.weight)))
+        pod_decl.append(dsigs)
+        pod_sym.append(ysigs)
+
+    # Assign key rows now that all sigs are known.
+    def key_row(sig: Sig) -> int:
+        return -1 if sig.key == "" else dt.row(sig.key)
+
+    m_rows = [key_row(s) for s in m_tab.sigs]
+    d_rows = [key_row(s) for s in d_tab.sigs]
+    y_rows = [key_row(s) for s in y_tab.sigs]
+    node_dom = dt.build()
+
+    sm, sd, sy = max(len(m_tab.sigs), 1), max(len(d_tab.sigs), 1), \
+        max(len(y_tab.sigs), 1)
+
+    # -- match sig state from existing pods -----------------------------
+    match_cnt = np.zeros((sm, n), np.float32)
+    match_total = np.zeros(sm, np.float32)
+    if ep is not None:
+        for si, sig in enumerate(m_tab.sigs):
+            me = _sig_match_existing(sig, ep, space)
+            if not me.any():
+                continue
+            nidxs = ep.node_idx[me]
+            match_total[si] = float(len(nidxs))
+            krow = m_rows[si]
+            for ni in nidxs:
+                match_cnt[si] += dt.same_topo_row(node_dom, krow, int(ni))
+
+    decl_reach = np.zeros((sd, n), bool)
+    for si, nidxs in decl_sources.items():
+        krow = d_rows[si]
+        for ni in set(nidxs):
+            decl_reach[si] |= dt.same_topo_row(node_dom, krow, ni)
+
+    sym_cnt = np.zeros((sy, n), np.float32)
+    for si, nidxs in sym_sources.items():
+        krow = y_rows[si]
+        for ni in nidxs:  # one instance per declaring term occurrence
+            sym_cnt[si] += dt.same_topo_row(node_dom, krow, ni)
+
+    # -- per-pod incidence matrices --------------------------------------
+    aff_need = np.zeros((p, sm), bool)
+    aff_self = np.zeros((p, sm), bool)
+    anti_need = np.zeros((p, sm), bool)
+    pref_w = np.zeros((p, sm), np.float32)
+    match_src = np.zeros((p, sm), bool)
+    decl_match = np.zeros((p, sd), bool)
+    decl_src = np.zeros((p, sd), bool)
+    sym_match = np.zeros((p, sy), bool)
+    sym_src = np.zeros((p, sy), bool)
+
+    # Candidate-vs-sig matching memoized by (namespace, labels) template:
+    # pods stamped from one controller share labels, so each template is
+    # matched against each sig family once.
+    tmpl_cache: dict = {}
+    for i, pod in enumerate(pods):
+        for si, kind in pod_m[i]:
+            if kind == "aff":
+                aff_need[i, si] = True
+            else:
+                anti_need[i, si] = True
+        for si, w in pod_pref[i]:
+            pref_w[i, si] += w
+        for si in pod_decl[i]:
+            decl_src[i, si] = True
+        for si in pod_sym[i]:
+            sym_src[i, si] = True
+        tkey = (pod.namespace, tuple(sorted(pod.labels.items())))
+        rows = tmpl_cache.get(tkey)
+        if rows is None:
+            rows = (
+                np.array([_pod_matches_sig(s, pod.namespace, pod.labels)
+                          for s in m_tab.sigs] or [False], bool),
+                np.array([_pod_matches_sig(s, pod.namespace, pod.labels)
+                          for s in d_tab.sigs] or [False], bool),
+                np.array([_pod_matches_sig(s, pod.namespace, pod.labels)
+                          for s in y_tab.sigs] or [False], bool))
+            tmpl_cache[tkey] = rows
+        match_src[i, :len(rows[0])] = rows[0][:sm]
+        decl_match[i, :len(rows[1])] = rows[1][:sd]
+        sym_match[i, :len(rows[2])] = rows[2][:sy]
+        # Self-match escape hatch (predicates.go:1038-1048).
+        for si, kind in pod_m[i]:
+            if kind == "aff" and match_src[i, si]:
+                aff_self[i, si] = True
+
+    return AffinityTensors(
+        node_dom=node_dom,
+        n_default=np.int32(dt.n_default),
+        match_key=np.asarray(m_rows or [-1], np.int32)[:sm],
+        match_cnt=match_cnt, match_total=match_total, match_src=match_src,
+        aff_need=aff_need, aff_self=aff_self, anti_need=anti_need,
+        pref_w=pref_w,
+        decl_key=np.asarray(d_rows or [-1], np.int32)[:sd],
+        decl_reach=decl_reach, decl_match=decl_match, decl_src=decl_src,
+        sym_key=np.asarray(y_rows or [-1], np.int32)[:sy],
+        sym_w=np.asarray([s.weight for s in y_tab.sigs] or [0],
+                         np.float32)[:sy],
+        sym_cnt=sym_cnt, sym_match=sym_match, sym_src=sym_src,
+        has_any=any_affinity)

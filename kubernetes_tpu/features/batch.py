@@ -23,6 +23,7 @@ import numpy as np
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.features import compiler as fc
+from kubernetes_tpu.features.affinity import AffinityTensors, compile_affinity
 
 
 @dataclass
@@ -52,6 +53,7 @@ class PodBatch:
     spread_incr: np.ndarray    # [P, S] bool — placing pod i increments group s
     node_zone_id: np.ndarray   # [N] int32 — compact zone id, -1 = no zone
     avoid_mask: np.ndarray     # [P, N] bool — NodePreferAvoidPods hit
+    aff: AffinityTensors       # inter-pod (anti-)affinity sig tables
 
     @property
     def p(self) -> int:
@@ -215,7 +217,9 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
                   ep: Optional[fc.ExistingPodTensors] = None,
                   nodes: Optional[Sequence[api.Node]] = None,
                   spread_selectors: Optional[SpreadSelectors] = None,
-                  controller_refs: Optional[ControllerRefs] = None) -> PodBatch:
+                  controller_refs: Optional[ControllerRefs] = None,
+                  affinity_pods: Sequence[tuple[api.Pod, int]] = (),
+                  hard_pod_affinity_weight: int = 1) -> PodBatch:
     """Compile a pending-pod batch against the current node tensors."""
     p = len(pods)
     n = nt.n
@@ -279,7 +283,9 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
 
     node_zone_id = _node_zone_ids(nt, space)
     num_zones = int(node_zone_id.max()) + 1 if (node_zone_id >= 0).any() else 0
-    any_zones = num_zones > 0
+    # haveZones iff some READY node carries zone info (the reference's
+    # countsByZone only sees the ready node list, selector_spreading.go:121).
+    any_zones = bool(((node_zone_id >= 0) & nt.schedulable).any())
 
     spread_sig_to_group: dict = {}
     spread_groups_meta: list[tuple[str, list]] = []  # (namespace, selectors)
@@ -342,7 +348,8 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
                 spread_sig_to_group[ssig] = sg
                 spread_groups_meta.append((pod.namespace, sels))
                 ncounts, zcounts = _spread_counts(
-                    pod.namespace, sels, ep, space, n, node_zone_id, num_zones)
+                    pod.namespace, sels, ep, space, n, node_zone_id, num_zones,
+                    nt.schedulable)
                 spread_node_rows.append(ncounts)
                 spread_zone_rows.append(zcounts)
                 spread_has_zone.append(any_zones and len(sels) > 0)
@@ -373,6 +380,9 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
                         for sel in sels):
                     spread_incr[i, s] = True
 
+    aff = compile_affinity(pods, affinity_pods, ep, nodes, n, space,
+                           hard_pod_affinity_weight)
+
     return PodBatch(
         pods=list(pods), request=request, zero_request=zero_req, nonzero=nonzero,
         best_effort=best_effort, host_idx=host_idx, ports=ports,
@@ -382,16 +392,17 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
         sel_pref_counts=sel_pref, spread_group=spread_group,
         spread_node_counts=sp_n, spread_zone_counts=sp_z,
         spread_has_zones=sp_hz, spread_incr=spread_incr,
-        node_zone_id=node_zone_id, avoid_mask=avoid_mask)
+        node_zone_id=node_zone_id, avoid_mask=avoid_mask, aff=aff)
 
 
 def _spread_counts(namespace: str, selectors: list,
                    ep: fc.ExistingPodTensors, space: fc.FeatureSpace,
-                   n: int, node_zone_id: np.ndarray,
-                   num_zones: int) -> tuple[np.ndarray, np.ndarray]:
+                   n: int, node_zone_id: np.ndarray, num_zones: int,
+                   schedulable: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """SelectorSpread count phase (selector_spreading.go:89-135): count
     existing same-namespace, non-deleted pods matching ANY selector, per node
-    and per zone."""
+    and per zone.  Only ready nodes are iterated by the reference, so
+    non-schedulable nodes' pods never enter the node or zone counts."""
     Z = max(num_zones, 1)
     if not selectors:
         return np.zeros(n, np.float32), np.zeros(Z, np.float32)
@@ -411,6 +422,7 @@ def _spread_counts(namespace: str, selectors: list,
             match |= _label_selector_match_mask(sel, ep.labels, space)
     match &= cand
     node_counts = np.bincount(ep.node_idx[match], minlength=n).astype(np.float32)[:n]
+    node_counts = np.where(schedulable, node_counts, 0.0).astype(np.float32)
     zone_counts = np.zeros(Z, np.float32)
     if num_zones > 0:
         zmask = node_zone_id >= 0

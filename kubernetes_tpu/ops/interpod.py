@@ -1,0 +1,115 @@
+"""Inter-pod (anti-)affinity device kernels.
+
+The quadratic (pods x pods via topology) computation of
+``predicates.go:825-1068`` and ``interpod_affinity.go:117-260`` lands here as
+three [P,S] @ [S,N] contractions over the sig tables built by
+``features/affinity.py`` — the attention-matrix-shaped term of this domain,
+blockwise over sigs instead of sequence.
+
+All functions are pure and jit/pjit-compatible; the node axis may be sharded
+(rows [S, N] shard over nodes; incidence [P, S] replicates or shards over
+the pod/batch axis).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.api.types import DEFAULT_FAILURE_DOMAINS
+
+# node_dom's first rows are always the default failure domains
+# (pkg/api/types.go:3053-3063); static so empty-topology-key terms can slice.
+N_DEFAULT_KEYS = len(DEFAULT_FAILURE_DOMAINS)
+
+
+def _bmm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """[P,S] bool x [S,N] bool -> [P,N] bool any-pair contraction (MXU)."""
+    return jnp.einsum("ps,sn->pn", a.astype(jnp.float32),
+                      b.astype(jnp.float32)) > 0
+
+
+def topo_rows(node_dom: jnp.ndarray, keys: jnp.ndarray,
+              choice: jnp.ndarray) -> jnp.ndarray:
+    """[S, N] bool — per-sig "same topology as node ``choice``" rows.
+
+    NodesHaveSameTopologyKey (topologies.go:66-76): key row >= 0 compares
+    that key's domain ids; -1 (empty topologyKey) matches under ANY default
+    failure-domain key."""
+    dom_sel = node_dom[jnp.clip(keys, 0)]              # [S, N]
+    dom_c = jnp.take(dom_sel, choice, axis=1)          # [S]
+    specific = (dom_sel == dom_c[:, None]) & (dom_sel >= 0)
+    ddom = node_dom[:N_DEFAULT_KEYS]                   # [D, N]
+    ddc = jnp.take(ddom, choice, axis=1)               # [D]
+    any_default = jnp.any((ddom == ddc[:, None]) & (ddom >= 0), axis=0)
+    return jnp.where((keys >= 0)[:, None], specific, any_default[None, :])
+
+
+def predicate_mask(aff_need: jnp.ndarray, aff_self: jnp.ndarray,
+                   anti_need: jnp.ndarray, decl_match: jnp.ndarray,
+                   match_cnt: jnp.ndarray, match_total: jnp.ndarray,
+                   decl_reach: jnp.ndarray) -> jnp.ndarray:
+    """MatchInterPodAffinity (predicates.go:825-853) -> [P,N] bool.
+
+    1. existing pods' anti-affinity may not reach the node (:1000-1035);
+    2. every required affinity term must reach, unless disregarded by the
+       self-match escape: pod matches its own term and no pod matches it
+       anywhere (:1038-1048);
+    3. no required anti-affinity term may reach (:1052-1058)."""
+    reach = match_cnt > 0.0                            # [Sm, N]
+    live = aff_need & ~(aff_self & (match_total == 0.0)[None, :])
+    violate = _bmm(live, ~reach) | _bmm(anti_need, reach) | \
+        _bmm(decl_match, decl_reach)
+    return ~violate
+
+
+def priority_counts(pref_w: jnp.ndarray, match_cnt: jnp.ndarray,
+                    sym_match: jnp.ndarray, sym_w: jnp.ndarray,
+                    sym_cnt: jnp.ndarray) -> jnp.ndarray:
+    """CalculateInterPodAffinityPriority's raw counts (interpod_affinity.go:
+    148-196): candidate's preferred ±w terms against matching existing pods,
+    plus the symmetric part — existing pods' required (x hardPodAffinity
+    weight) and preferred ±w terms that the candidate matches."""
+    own = jnp.einsum("ps,sn->pn", pref_w, match_cnt)
+    sym = jnp.einsum("ps,sn->pn", sym_match.astype(jnp.float32) * sym_w[None, :],
+                     sym_cnt)
+    return own + sym
+
+
+def priority_score(counts: jnp.ndarray, schedulable: jnp.ndarray,
+                   trunc) -> jnp.ndarray:
+    """0-anchored min-max to 0-10 ints (interpod_affinity.go:222-244):
+    maxCount/minCount start at 0, so uniformly-positive rows keep min 0 and
+    uniformly-negative rows keep max 0.  Normalization spans only the ready
+    node list the reference scores."""
+    neg = jnp.float32(-jnp.inf)
+    pos = jnp.float32(jnp.inf)
+    max_c = jnp.maximum(
+        jnp.max(jnp.where(schedulable[None, :], counts, neg), axis=1), 0.0)
+    min_c = jnp.minimum(
+        jnp.min(jnp.where(schedulable[None, :], counts, pos), axis=1), 0.0)
+    denom = (max_c - min_c)[:, None]
+    score = trunc(10.0 * (counts - min_c[:, None]) / jnp.maximum(denom, 1e-9))
+    return jnp.where(denom > 0, score, 0.0)
+
+
+def place_update(node_dom: jnp.ndarray,
+                 match_key: jnp.ndarray, match_cnt: jnp.ndarray,
+                 match_total: jnp.ndarray, match_src_i: jnp.ndarray,
+                 decl_key: jnp.ndarray, decl_reach: jnp.ndarray,
+                 decl_src_i: jnp.ndarray,
+                 sym_key: jnp.ndarray, sym_cnt: jnp.ndarray,
+                 sym_src_i: jnp.ndarray,
+                 choice: jnp.ndarray, placed: jnp.ndarray):
+    """Sequential-visibility state update: pod i placed on ``choice`` becomes
+    an existing pod for every later pod (the batched AssumePod).  Returns
+    (match_cnt, match_total, decl_reach, sym_cnt) updated."""
+    ok = placed.astype(jnp.float32)
+    safe = jnp.maximum(choice, 0)
+    m_rows = topo_rows(node_dom, match_key, safe).astype(jnp.float32)
+    match_cnt = match_cnt + ok * match_src_i.astype(jnp.float32)[:, None] * m_rows
+    match_total = match_total + ok * match_src_i.astype(jnp.float32)
+    d_rows = topo_rows(node_dom, decl_key, safe)
+    decl_reach = decl_reach | (placed & decl_src_i[:, None] & d_rows)
+    y_rows = topo_rows(node_dom, sym_key, safe).astype(jnp.float32)
+    sym_cnt = sym_cnt + ok * sym_src_i.astype(jnp.float32)[:, None] * y_rows
+    return match_cnt, match_total, decl_reach, sym_cnt

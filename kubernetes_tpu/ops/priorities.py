@@ -93,25 +93,30 @@ def balanced_resource_allocation(pod_nonzero: jnp.ndarray,
     return jnp.where((cpu_frac >= 1.0) | (mem_frac >= 1.0), 0.0, score)
 
 
-def node_affinity(sel_group: jnp.ndarray,
-                  sel_pref_counts: jnp.ndarray) -> jnp.ndarray:
+def node_affinity(sel_group: jnp.ndarray, sel_pref_counts: jnp.ndarray,
+                  schedulable: jnp.ndarray) -> jnp.ndarray:
     """CalculateNodeAffinityPriority (node_affinity.go:32-86): weighted
     preferred-term match counts, normalized per pod to int(10 * count/max);
-    all-zero when no term matches anywhere."""
+    all-zero when no term matches anywhere.  The reference only iterates the
+    ready node list, so the max spans schedulable nodes."""
     counts = sel_pref_counts[sel_group].astype(jnp.float32)  # [P,N]
-    max_count = jnp.max(counts, axis=1, keepdims=True)
+    max_count = jnp.max(jnp.where(schedulable[None, :], counts, 0.0),
+                        axis=1, keepdims=True)
     score = _trunc(10.0 * counts / jnp.maximum(max_count, 1e-9))
     return jnp.where(max_count > 0, score, 0.0)
 
 
 def taint_toleration(pod_tol_prefer: jnp.ndarray,
-                     node_taints_prefer: jnp.ndarray) -> jnp.ndarray:
+                     node_taints_prefer: jnp.ndarray,
+                     schedulable: jnp.ndarray) -> jnp.ndarray:
     """ComputeTaintTolerationPriority (taint_toleration.go:54-105): count
     intolerable PreferNoSchedule taints per node; score
-    int((1 - count/max)*10), or 10 for every node when max==0."""
+    int((1 - count/max)*10), or 10 for every node when max==0 (max over the
+    ready node list the reference scores)."""
     counts = jnp.einsum("pt,nt->pn", (~pod_tol_prefer).astype(jnp.float32),
                         node_taints_prefer.astype(jnp.float32))
-    max_count = jnp.max(counts, axis=1, keepdims=True)
+    max_count = jnp.max(jnp.where(schedulable[None, :], counts, 0.0),
+                        axis=1, keepdims=True)
     score = _trunc((1.0 - counts / jnp.maximum(max_count, 1e-9)) * 10.0)
     return jnp.where(max_count > 0, score, 10.0)
 
@@ -119,7 +124,8 @@ def taint_toleration(pod_tol_prefer: jnp.ndarray,
 def selector_spread(spread_group: jnp.ndarray, spread_node_counts: jnp.ndarray,
                     spread_zone_counts: jnp.ndarray,
                     spread_has_zones: jnp.ndarray,
-                    node_zone_id: jnp.ndarray) -> jnp.ndarray:
+                    node_zone_id: jnp.ndarray,
+                    schedulable: jnp.ndarray) -> jnp.ndarray:
     """SelectorSpreadPriority (selector_spreading.go:63-175): fewer same-
     selector pods is better; with zones, blend node score 1/3 with zone score
     2/3 (zoneWeighting, selector_spreading.go:39).
@@ -134,7 +140,10 @@ def selector_spread(spread_group: jnp.ndarray, spread_node_counts: jnp.ndarray,
         zc, jnp.clip(node_zone_id, 0)[None, :].repeat(zc.shape[0], 0), axis=1)
     zcounts = jnp.where(node_has_zone[None, :], zcounts, 0.0)  # [P,N]
     has_zones = spread_has_zones[spread_group][:, None]  # [P,1]
-    max_count = jnp.max(counts, axis=1, keepdims=True)
+    # countsByNodeName/maxCountByNodeName only span the ready node list
+    # (selector_spreading.go:95-135 iterates `nodes`).
+    max_count = jnp.max(jnp.where(schedulable[None, :], counts, 0.0),
+                        axis=1, keepdims=True)
     f = jnp.where(max_count > 0,
                   10.0 * ((max_count - counts) / jnp.maximum(max_count, 1e-9)),
                   10.0)

@@ -24,7 +24,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubernetes_tpu.engine.solver import DeviceBatch, DeviceCluster
+from kubernetes_tpu.engine.solver import (DeviceAffinity, DeviceBatch,
+                                          DeviceCluster)
 
 BATCH_AXIS = "batch"
 NODE_AXIS = "nodes"
@@ -63,6 +64,27 @@ def shard_cluster(c: DeviceCluster, mesh: Mesh) -> DeviceCluster:
     return DeviceCluster(**out)
 
 
+# DeviceAffinity: [S, N] row tables shard over nodes, [P, S] incidence over
+# the batch axis, small [S]/[K] vectors replicate.
+_AFF_NODE_ROW_FIELDS = {"node_dom", "match_cnt", "decl_reach", "sym_cnt"}
+_AFF_POD_FIELDS = {"match_src", "aff_need", "aff_self", "anti_need",
+                   "pref_w", "decl_match", "decl_src", "sym_match", "sym_src"}
+
+
+def _shard_affinity(a: DeviceAffinity, mesh: Mesh,
+                    shard_pods: bool) -> DeviceAffinity:
+    out = {}
+    for name, arr in zip(DeviceAffinity._fields, a):
+        if name in _AFF_NODE_ROW_FIELDS:
+            spec = P(None, NODE_AXIS)
+        elif name in _AFF_POD_FIELDS and shard_pods:
+            spec = P(BATCH_AXIS, None)
+        else:
+            spec = P(*([None] * arr.ndim))
+        out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return DeviceAffinity(**out)
+
+
 def shard_batch(b: DeviceBatch, mesh: Mesh,
                 shard_pods: bool = False) -> DeviceBatch:
     """Shard group tables over nodes; optionally shard pod-axis tensors over
@@ -72,6 +94,9 @@ def shard_batch(b: DeviceBatch, mesh: Mesh,
     for name, arr in zip(DeviceBatch._fields, b):
         if name == "pods":
             out[name] = arr
+            continue
+        if name == "aff":
+            out[name] = _shard_affinity(arr, mesh, shard_pods)
             continue
         if name in _BATCH_NODE_LAST_FIELDS:
             spec = P(None, NODE_AXIS)

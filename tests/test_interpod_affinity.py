@@ -1,0 +1,251 @@
+"""Inter-pod (anti-)affinity semantics tests.
+
+Table-driven scenarios modeled on the reference's
+``predicates_test.go`` (TestInterPodAffinity*) and
+``interpod_affinity_test.go`` expectations: required/preferred terms,
+the self-match escape hatch, existing-pod symmetry, empty-topology-key
+default domains, namespace resolution, and in-batch sequential visibility.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.policy import Policy, PredicateSpec, PrioritySpec
+from kubernetes_tpu.cache.scheduler_cache import SchedulerCache
+from kubernetes_tpu.engine.generic_scheduler import FitError, GenericScheduler
+
+from helpers import make_node, make_pod
+
+
+def _aff_required(selector: dict, topo: str, namespaces=None, anti=False) -> dict:
+    term = {"labelSelector": {"matchLabels": selector}, "topologyKey": topo}
+    if namespaces is not None:
+        term["namespaces"] = namespaces
+    key = "podAntiAffinity" if anti else "podAffinity"
+    return {key: {"requiredDuringSchedulingIgnoredDuringExecution": [term]}}
+
+
+def _aff_preferred(selector: dict, topo: str, weight: int, anti=False) -> dict:
+    key = "podAntiAffinity" if anti else "podAffinity"
+    return {key: {"preferredDuringSchedulingIgnoredDuringExecution": [
+        {"weight": weight,
+         "podAffinityTerm": {"labelSelector": {"matchLabels": selector},
+                             "topologyKey": topo}}]}}
+
+
+ZONE = api.ZONE_LABEL
+
+
+def _zone_cluster(sched=None):
+    """4 nodes in 2 zones."""
+    s = sched or GenericScheduler()
+    for i, zone in enumerate(["z1", "z1", "z2", "z2"]):
+        s.cache.add_node(make_node(f"n{i}", labels={ZONE: zone}))
+    return s
+
+
+def _place(s, pod, node):
+    pod.node_name = node
+    s.cache.add_pod(pod)
+
+
+class TestAffinityPredicate:
+    def test_required_affinity_colocates_by_zone(self):
+        s = _zone_cluster()
+        _place(s, make_pod(labels={"app": "db"}), "n2")  # z2
+        got = s.schedule(make_pod(affinity=_aff_required({"app": "db"}, ZONE)))
+        assert got in ("n2", "n3")
+
+    def test_required_affinity_unmatched_no_self_match_fails(self):
+        s = _zone_cluster()
+        with pytest.raises(FitError):
+            s.schedule(make_pod(affinity=_aff_required({"app": "db"}, ZONE)))
+
+    def test_self_match_escape_hatch(self):
+        # First pod of a collection: matches its own term, no other pod
+        # matches anywhere -> the requirement is disregarded
+        # (predicates.go:1038-1048).
+        s = _zone_cluster()
+        got = s.schedule(make_pod(labels={"app": "db"},
+                                  affinity=_aff_required({"app": "db"}, ZONE)))
+        assert got.startswith("n")
+
+    def test_self_match_with_existing_match_elsewhere_restricts(self):
+        # A matching pod exists (z1) => escape hatch does NOT apply even
+        # though the pod matches its own selector; must land in z1.
+        s = _zone_cluster()
+        _place(s, make_pod(labels={"app": "db"}), "n0")
+        got = s.schedule(make_pod(labels={"app": "db"},
+                                  affinity=_aff_required({"app": "db"}, ZONE)))
+        assert got in ("n0", "n1")
+
+    def test_required_anti_affinity_repels_zone(self):
+        s = _zone_cluster()
+        _place(s, make_pod(labels={"app": "web"}), "n0")  # z1
+        got = s.schedule(make_pod(
+            affinity=_aff_required({"app": "web"}, ZONE, anti=True)))
+        assert got in ("n2", "n3")
+
+    def test_existing_pod_anti_affinity_symmetry(self):
+        # Existing pod declares anti-affinity against app=web in its zone;
+        # a new app=web pod may not land in that zone
+        # (satisfiesExistingPodsAntiAffinity, predicates.go:1000-1035).
+        s = _zone_cluster()
+        _place(s, make_pod(labels={"app": "lonely"},
+                           affinity=_aff_required({"app": "web"}, ZONE,
+                                                  anti=True)), "n0")
+        got = s.schedule(make_pod(labels={"app": "web"}))
+        assert got in ("n2", "n3")
+
+    def test_empty_topology_key_uses_default_domains(self):
+        # Empty topologyKey -> any default failure domain key
+        # (topologies.go:66-76); zone label is a default domain.
+        s = _zone_cluster()
+        _place(s, make_pod(labels={"app": "web"}), "n0")
+        got = s.schedule(make_pod(
+            affinity=_aff_required({"app": "web"}, "", anti=True)))
+        assert got in ("n2", "n3")
+
+    def test_namespace_nil_restricts_to_own(self):
+        # nil namespaces resolves to the affinity pod's own namespace; a
+        # match in another namespace does not satisfy the term.
+        s = _zone_cluster()
+        _place(s, make_pod(labels={"app": "db"}, namespace="other"), "n0")
+        with pytest.raises(FitError):
+            s.schedule(make_pod(namespace="default",
+                                affinity=_aff_required({"app": "db"}, ZONE)))
+
+    def test_namespace_empty_list_matches_all(self):
+        s = _zone_cluster()
+        _place(s, make_pod(labels={"app": "db"}, namespace="other"), "n2")
+        got = s.schedule(make_pod(
+            namespace="default",
+            affinity=_aff_required({"app": "db"}, ZONE, namespaces=[])))
+        assert got in ("n2", "n3")
+
+    def test_namespace_explicit_list(self):
+        s = _zone_cluster()
+        _place(s, make_pod(labels={"app": "db"}, namespace="other"), "n2")
+        got = s.schedule(make_pod(
+            namespace="default",
+            affinity=_aff_required({"app": "db"}, ZONE, namespaces=["other"])))
+        assert got in ("n2", "n3")
+
+    def test_hostname_topology(self):
+        # kubernetes.io/hostname label topo: affinity binds to the exact node.
+        s = GenericScheduler()
+        for i in range(3):
+            s.cache.add_node(make_node(
+                f"n{i}", labels={api.HOSTNAME_LABEL: f"n{i}"}))
+        _place(s, make_pod(labels={"app": "db"}), "n1")
+        got = s.schedule(make_pod(
+            affinity=_aff_required({"app": "db"}, api.HOSTNAME_LABEL)))
+        assert got == "n1"
+
+
+class TestAffinityPriority:
+    def _score_policy(self):
+        return Policy(
+            predicates=[PredicateSpec("PodFitsResources")],
+            priorities=[PrioritySpec("InterPodAffinityPriority", 1)])
+
+    def test_preferred_affinity_prefers_matching_zone(self):
+        s = _zone_cluster(GenericScheduler(policy=self._score_policy()))
+        _place(s, make_pod(labels={"app": "db"}), "n2")
+        got = s.schedule(make_pod(
+            affinity=_aff_preferred({"app": "db"}, ZONE, weight=5)))
+        assert got in ("n2", "n3")
+
+    def test_preferred_anti_affinity_avoids_matching_zone(self):
+        s = _zone_cluster(GenericScheduler(policy=self._score_policy()))
+        _place(s, make_pod(labels={"app": "web"}), "n0")
+        got = s.schedule(make_pod(
+            affinity=_aff_preferred({"app": "web"}, ZONE, weight=5,
+                                    anti=True)))
+        assert got in ("n2", "n3")
+
+    def test_hard_affinity_symmetry_weight(self):
+        # Existing pod's REQUIRED affinity matching the candidate boosts the
+        # existing pod's topology by hardPodAffinityWeight
+        # (interpod_affinity.go:164-183).
+        s = _zone_cluster(GenericScheduler(policy=self._score_policy()))
+        _place(s, make_pod(labels={"app": "other"},
+                           affinity=_aff_required({"app": "web"}, ZONE)), "n2")
+        got = s.schedule(make_pod(labels={"app": "web"}))
+        assert got in ("n2", "n3")
+
+    def test_soft_symmetry_anti(self):
+        # Existing pod PREFERS no app=web in its zone; candidate app=web is
+        # pushed to the other zone.
+        s = _zone_cluster(GenericScheduler(policy=self._score_policy()))
+        _place(s, make_pod(labels={"app": "quiet"},
+                           affinity=_aff_preferred({"app": "web"}, ZONE,
+                                                   weight=3, anti=True)),
+               "n0")
+        got = s.schedule(make_pod(labels={"app": "web"}))
+        assert got in ("n2", "n3")
+
+    def test_zero_anchored_normalization(self):
+        # Uniformly-negative counts: max stays anchored at 0, so the least-
+        # negative zone still scores above the matching zone
+        # (interpod_affinity.go:222-236 maxCount starts at 0).
+        s = _zone_cluster(GenericScheduler(policy=self._score_policy()))
+        _place(s, make_pod(labels={"app": "web"}), "n0")
+        _place(s, make_pod(labels={"app": "web"}), "n0")
+        _place(s, make_pod(labels={"app": "web"}), "n2")
+        feasible, scores = s.solver.evaluate(
+            *s._compile([make_pod(affinity=_aff_preferred(
+                {"app": "web"}, ZONE, weight=1, anti=True))])[1:3])
+        sc = np.asarray(scores)[0]
+        # z1 has 2 matches (count -2), z2 has 1 (count -1): 10*(c-min)/(0-min)
+        assert sc[0] == sc[1] == 0.0
+        assert sc[2] == sc[3] == 5.0
+
+    def test_no_affinity_all_zero_scores(self):
+        s = _zone_cluster(GenericScheduler(policy=self._score_policy()))
+        feasible, scores = s.solver.evaluate(
+            *s._compile([make_pod()])[1:3])
+        assert (np.asarray(scores)[0] == 0).all()
+
+
+class TestSequentialVisibility:
+    def test_anti_affinity_spreads_within_batch(self):
+        # Two mutually anti-affine pods solved in ONE batch must land in
+        # different zones: the second sees the first's placement through the
+        # scan state (the batched assumed-pod cache).
+        s = _zone_cluster()
+        aff = _aff_required({"app": "ha"}, ZONE, anti=True)
+        p1 = make_pod(labels={"app": "ha"}, affinity=aff)
+        p2 = make_pod(labels={"app": "ha"}, affinity=aff)
+        got = s.schedule_batch([p1, p2])
+        zones = {{"n0": "z1", "n1": "z1", "n2": "z2", "n3": "z2"}[g]
+                 for g in got}
+        assert zones == {"z1", "z2"}
+
+    def test_affinity_follows_within_batch(self):
+        # Pod 2 requires colocation with app=db; the only app=db pod is pod 1
+        # placed earlier in the same batch (self-match escape doesn't apply to
+        # pod 2; it must follow pod 1's zone).
+        s = _zone_cluster()
+        p1 = make_pod(labels={"app": "db"}, node_selector={ZONE: "z2"})
+        p2 = make_pod(affinity=_aff_required({"app": "db"}, ZONE))
+        got = s.schedule_batch([p1, p2])
+        assert got[0] in ("n2", "n3")
+        assert got[1] in ("n2", "n3")
+
+    def test_batch_spread_three_zones(self):
+        s = GenericScheduler()
+        for i, zone in enumerate(["z1", "z1", "z2", "z2", "z3", "z3"]):
+            s.cache.add_node(make_node(f"n{i}", labels={ZONE: zone}))
+        aff = _aff_required({"app": "ha"}, ZONE, anti=True)
+        pods = [make_pod(labels={"app": "ha"}, affinity=aff) for _ in range(4)]
+        got = s.schedule_batch(pods)
+        zmap = {"n0": "z1", "n1": "z1", "n2": "z2", "n3": "z2",
+                "n4": "z3", "n5": "z3"}
+        placed = [g for g in got if g is not None]
+        assert len(placed) == 3  # one per zone; 4th has nowhere to go
+        assert len({zmap[g] for g in placed}) == 3
+        assert got[3] is None
