@@ -27,6 +27,9 @@ class PredicateSpec:
     presence: bool = False
     # ServiceAffinity argument
     affinity_labels: tuple[str, ...] = ()
+    # MaxEBSVolumeCount / MaxGCEPDVolumeCount cap; 0 = provider default
+    # (39 / 16, env KUBE_MAX_PD_VOLS override — defaults.go:42-54)
+    max_volumes: int = 0
 
 
 @dataclass(frozen=True)
@@ -144,6 +147,34 @@ def policy_from_json(text: str) -> Policy:
             enable_https=bool(e.get("enableHttps", False)),
             http_timeout_s=float(e.get("httpTimeout", 5_000_000_000)) / 1e9))
     return Policy(predicates=preds, priorities=prios, extenders=exts)
+
+
+def service_affinity_labels(policy: Policy) -> tuple[str, ...]:
+    """Labels of the (single supported) ServiceAffinity predicate instance."""
+    for p in policy.predicates:
+        if p.name == "ServiceAffinity" and p.affinity_labels:
+            return p.affinity_labels
+    return ()
+
+
+def service_anti_affinity_labels(policy: Policy) -> tuple[str, ...]:
+    """Per-instance labels of ServiceAntiAffinityPriority entries, in policy
+    order (matches the solver's aux index assignment)."""
+    return tuple(s.anti_affinity_label for s in policy.priorities
+                 if s.name == "ServiceAntiAffinityPriority" and s.weight != 0)
+
+
+def node_label_args(policy: Policy):
+    """(labels, presence) of the CheckNodeLabelPresence predicate, or None."""
+    for p in policy.predicates:
+        if p.name == "NewNodeLabelPredicate" and p.labels:
+            return (p.labels, p.presence)
+    return None
+
+
+def node_label_prio_args(policy: Policy) -> tuple[tuple[str, bool], ...]:
+    return tuple((s.label, s.presence) for s in policy.priorities
+                 if s.name == "NodeLabelPriority" and s.weight != 0)
 
 
 def expand_predicates(policy: Policy) -> list[PredicateSpec]:

@@ -383,6 +383,25 @@ class Node:
 
 
 @dataclass
+class PersistentVolume:
+    """Scheduler-relevant PV fields (MaxPDVolumeCountChecker filters
+    predicates.go:284-316; VolumeZoneChecker reads zone/region labels
+    predicates.go:391-407)."""
+
+    name: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    gce_pd_name: str = ""
+    aws_ebs_id: str = ""
+
+
+@dataclass
+class PersistentVolumeClaim:
+    name: str = ""
+    namespace: str = "default"
+    volume_name: str = ""  # spec.volumeName; "" = unbound
+
+
+@dataclass
 class Service:
     name: str = ""
     namespace: str = "default"

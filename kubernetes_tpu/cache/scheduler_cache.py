@@ -50,6 +50,10 @@ class SchedulerCache:
         # PodsWithAffinity analogue (node_info.go podsWithAffinity): attached
         # pods carrying any affinity annotation, for the sig compiler.
         self._affinity_pods: dict[str, api.Pod] = {}
+        # Attached pods with volumes, for the MaxPD volume-count compiler
+        # (resolved against PV/PVC listers at batch compile time, matching
+        # the reference's per-evaluation resolution, predicates.go:260-266).
+        self._volume_pods: dict[str, api.Pod] = {}
         self._nt: Optional[fc.NodeTensors] = None
         self._agg: Optional[fc.NodeAggregates] = None
         self._ep: Optional[fc.ExistingPodTensors] = None
@@ -151,6 +155,33 @@ class SchedulerCache:
     def node_pods(self, node_name: str) -> list[api.Pod]:
         return list(self._node_pods.get(node_name, {}).values())
 
+    def service_peer_nodes(self, namespace: str,
+                           selector: dict[str, str]) -> list[str]:
+        """Node names hosting assigned pods matching a service selector in
+        a namespace (podLister.List(selector) + namespace filter, the
+        ServiceAffinity/ServiceAntiAffinity peer lookup,
+        predicates.go:678-690)."""
+        if not selector:
+            return []
+        out = []
+        for st in self._pod_states.values():
+            pod = st.pod
+            if pod.node_name and pod.namespace == namespace and \
+                    all(pod.labels.get(k) == v for k, v in selector.items()):
+                out.append(pod.node_name)
+        return out
+
+    def first_peer_node(self, namespace: str,
+                        selector: dict[str, str]) -> Optional[str]:
+        peers = self.service_peer_nodes(namespace, selector)
+        return peers[0] if peers else None
+
+    def volume_pods(self) -> list[tuple[api.Pod, int]]:
+        """(pod, node index) for attached pods with volumes (incl. assumed)."""
+        self._ensure_tensors()
+        return [(p, self._nt.name_to_idx.get(p.node_name, -1))
+                for p in self._volume_pods.values()]
+
     def affinity_pods(self) -> list[tuple[api.Pod, int]]:
         """(pod, node index) for every attached pod with affinity annotations
         (incl. assumed pods — matching the reference's assumed-pod
@@ -167,6 +198,8 @@ class SchedulerCache:
         self._node_pods.setdefault(node_name, {})[pod.key] = pod
         if pod.affinity() is not None:
             self._affinity_pods[pod.key] = pod
+        if pod.volumes:
+            self._volume_pods[pod.key] = pod
         if not self._dirty_nodes and self._nt is not None:
             idx = self._nt.name_to_idx.get(node_name)
             if idx is None:
@@ -184,6 +217,7 @@ class SchedulerCache:
         pods = self._node_pods.get(node_name, {})
         pods.pop(pod.key, None)
         self._affinity_pods.pop(pod.key, None)
+        self._volume_pods.pop(pod.key, None)
         if not self._dirty_nodes and self._nt is not None:
             idx = self._nt.name_to_idx.get(node_name)
             if idx is not None:

@@ -16,10 +16,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_tpu.api import types as api
-from kubernetes_tpu.api.policy import Policy, default_provider
+from kubernetes_tpu.api.policy import (Policy, default_provider,
+                                       node_label_args, node_label_prio_args,
+                                       service_affinity_labels,
+                                       service_anti_affinity_labels)
 from kubernetes_tpu.cache.scheduler_cache import SchedulerCache
 from kubernetes_tpu.engine import solver as sv
 from kubernetes_tpu.features import batch as fb
+from kubernetes_tpu.features.volumes import compile_volsvc
 from kubernetes_tpu.utils.trace import Trace
 
 
@@ -41,6 +45,30 @@ class Listers:
     services: list[api.Service] = field(default_factory=list)
     controllers: list[api.ReplicationController] = field(default_factory=list)
     replica_sets: list[api.ReplicaSet] = field(default_factory=list)
+    pvs: list[api.PersistentVolume] = field(default_factory=list)
+    pvcs: list[api.PersistentVolumeClaim] = field(default_factory=list)
+
+    def get_pv(self, name: str) -> api.PersistentVolume | None:
+        for pv in self.pvs:
+            if pv.name == name:
+                return pv
+        return None
+
+    def get_pvc(self, namespace: str, name: str) -> api.PersistentVolumeClaim | None:
+        for pvc in self.pvcs:
+            if pvc.namespace == namespace and pvc.name == name:
+                return pvc
+        return None
+
+    def first_service(self, pod: api.Pod) -> api.Service | None:
+        """GetPodServices[0] (the reference's ServiceAffinity/ServiceAnti
+        Affinity use only the first matching service,
+        predicates.go:676-678)."""
+        for s in self.services:
+            if s.namespace == pod.namespace and s.selector and \
+                    all(pod.labels.get(k) == v for k, v in s.selector.items()):
+                return s
+        return None
 
     def spread_selectors(self, pod: api.Pod) -> list:
         """GetPodServices/GetPodControllers/GetPodReplicaSets
@@ -95,13 +123,24 @@ class GenericScheduler:
     def _compile(self, pods: list[api.Pod]) -> tuple[fb.PodBatch, sv.DeviceBatch,
                                                      sv.DeviceCluster, list[str]]:
         nt, agg, ep, nodes = self.cache.snapshot()
+        volsvc = compile_volsvc(
+            pods, nodes, nt.schedulable,
+            volume_pods=self.cache.volume_pods(), listers=self.listers,
+            service_affinity_labels=service_affinity_labels(self.policy),
+            service_anti_affinity_labels=service_anti_affinity_labels(
+                self.policy),
+            node_label_args=node_label_args(self.policy),
+            node_label_prio_args=node_label_prio_args(self.policy),
+            service_peers=self.cache.service_peer_nodes,
+            first_peer=self.cache.first_peer_node)
         batch = fb.compile_batch(
             pods, nt, self.cache.space, ep=ep, nodes=nodes,
             spread_selectors=self.listers.spread_selectors,
             controller_refs=self.listers.controller_refs,
             affinity_pods=self.cache.affinity_pods(),
             hard_pod_affinity_weight=(
-                self.policy.hard_pod_affinity_symmetric_weight))
+                self.policy.hard_pod_affinity_symmetric_weight),
+            volsvc=volsvc)
         db = sv.device_batch(batch)
         dc = sv.device_cluster(nt, agg, self.cache.space)
         return batch, db, dc, nt

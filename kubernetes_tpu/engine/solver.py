@@ -25,13 +25,16 @@ sharded across a mesh (see kubernetes_tpu.parallel).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubernetes_tpu.api.policy import Policy, expand_predicates
+from kubernetes_tpu.api.policy import (DEFAULT_MAX_EBS_VOLUMES,
+                                       DEFAULT_MAX_GCE_PD_VOLUMES, Policy,
+                                       expand_predicates)
 from kubernetes_tpu.features.affinity import AffinityTensors
 from kubernetes_tpu.features.batch import PodBatch
 from kubernetes_tpu.features.compiler import (FeatureSpace, NodeAggregates,
@@ -43,23 +46,24 @@ from kubernetes_tpu.ops import (combine, interpod, predicates as pr,
 # Predicates whose masks do not depend on in-batch placements.
 STATIC_PREDICATES = ("PodFitsHost", "MatchNodeSelector", "HostName",
                      "PodToleratesNodeTaints", "CheckNodeMemoryPressure",
-                     "CheckNodeDiskPressure", "NewNodeLabelPredicate")
-# Implemented dynamic predicates.
+                     "CheckNodeDiskPressure", "NewNodeLabelPredicate",
+                     "NoVolumeZoneConflict", "ServiceAffinity")
+# Implemented dynamic predicates (masks read in-batch placement state).
 DYNAMIC_PREDICATES = ("PodFitsResources", "PodFitsHostPorts", "PodFitsPorts",
-                      "NoDiskConflict", "MatchInterPodAffinity")
-# Recognized but not yet tensorized: evaluated as pass-through (tracked so
-# callers can surface the gap).  NoVolumeZoneConflict / MaxPD need PV/PVC
-# listers.
-PASSTHROUGH_PREDICATES = ("NoVolumeZoneConflict", "MaxEBSVolumeCount",
-                          "MaxGCEPDVolumeCount", "ServiceAffinity")
+                      "NoDiskConflict", "MatchInterPodAffinity",
+                      "MaxEBSVolumeCount", "MaxGCEPDVolumeCount")
+PASSTHROUGH_PREDICATES = ()
 
 STATIC_PRIORITIES = ("NodeAffinityPriority", "TaintTolerationPriority",
                      "ImageLocalityPriority", "NodePreferAvoidPodsPriority",
-                     "EqualPriority", "NodeLabelPriority")
+                     "EqualPriority", "NodeLabelPriority",
+                     # Static-in-batch: peer counts are not yet updated by
+                     # in-batch placements (single-pod path is exact).
+                     "ServiceAntiAffinityPriority")
 DYNAMIC_PRIORITIES = ("LeastRequestedPriority", "MostRequestedPriority",
                       "BalancedResourceAllocation", "SelectorSpreadPriority",
                       "ServiceSpreadingPriority", "InterPodAffinityPriority")
-PASSTHROUGH_PRIORITIES = ("ServiceAntiAffinityPriority",)
+PASSTHROUGH_PRIORITIES = ()
 
 
 class DeviceAffinity(NamedTuple):
@@ -84,6 +88,25 @@ class DeviceAffinity(NamedTuple):
     sym_cnt: jnp.ndarray
     sym_match: jnp.ndarray
     sym_src: jnp.ndarray
+
+
+class DeviceVolSvc(NamedTuple):
+    """VolSvcTensors as device arrays (features/volumes.py documents each)."""
+
+    pd_pod_ebs: jnp.ndarray
+    pd_node_ebs: jnp.ndarray
+    pd_extra_ebs: jnp.ndarray
+    pd_pod_gce: jnp.ndarray
+    pd_node_gce: jnp.ndarray
+    pd_extra_gce: jnp.ndarray
+    vz_group: jnp.ndarray
+    vz_mask: jnp.ndarray
+    sa_group: jnp.ndarray
+    sa_mask: jnp.ndarray
+    saa_group: jnp.ndarray
+    saa_score: jnp.ndarray
+    nl_pred_row: jnp.ndarray
+    nl_prio_rows: jnp.ndarray
 
 
 class DeviceBatch(NamedTuple):
@@ -112,6 +135,7 @@ class DeviceBatch(NamedTuple):
     node_zone_id: jnp.ndarray
     avoid_mask: jnp.ndarray
     aff: DeviceAffinity
+    volsvc: DeviceVolSvc
 
 
 class DeviceCluster(NamedTuple):
@@ -140,10 +164,12 @@ def _pad_cols(a: np.ndarray, width: int) -> np.ndarray:
 
 def device_batch(b: PodBatch) -> DeviceBatch:
     parts = [jnp.asarray(getattr(b, f)) for f in DeviceBatch._fields
-             if f != "aff"]
+             if f not in ("aff", "volsvc")]
     aff = DeviceAffinity(*[jnp.asarray(getattr(b.aff, f))
                            for f in DeviceAffinity._fields])
-    return DeviceBatch(*parts, aff=aff)
+    volsvc = DeviceVolSvc(*[jnp.asarray(getattr(b.volsvc, f))
+                            for f in DeviceVolSvc._fields])
+    return DeviceBatch(*parts, aff=aff, volsvc=volsvc)
 
 
 def device_cluster(nt: NodeTensors, agg: NodeAggregates,
@@ -181,7 +207,21 @@ def _predicate_mask(name: str, b: DeviceBatch, c: DeviceCluster,
     if name == "CheckNodeDiskPressure":
         return pr.check_node_disk_pressure(p, c.disk_pressure)
     if name == "NewNodeLabelPredicate":
-        return pr.node_label_presence(p, extra["node_label_row"])
+        return pr.node_label_presence(p, b.volsvc.nl_pred_row)
+    if name == "NoVolumeZoneConflict":
+        return b.volsvc.vz_mask[b.volsvc.vz_group]
+    if name == "ServiceAffinity":
+        return b.volsvc.sa_mask[b.volsvc.sa_group]
+    if name == "MaxEBSVolumeCount":
+        return pr.max_pd_volume_count(b.volsvc.pd_pod_ebs,
+                                      b.volsvc.pd_extra_ebs,
+                                      b.volsvc.pd_node_ebs,
+                                      extra["max_ebs"])
+    if name == "MaxGCEPDVolumeCount":
+        return pr.max_pd_volume_count(b.volsvc.pd_pod_gce,
+                                      b.volsvc.pd_extra_gce,
+                                      b.volsvc.pd_node_gce,
+                                      extra["max_gce"])
     if name == "PodFitsResources":
         return pr.pod_fits_resources(b.request, b.zero_request, c.alloc,
                                      c.requested)
@@ -228,11 +268,11 @@ def _priority_plane(name: str, b: DeviceBatch, c: DeviceCluster,
                                           a.sym_w, a.sym_cnt)
         return interpod.priority_score(counts, c.schedulable, prio._trunc)
     if name == "NodeLabelPriority":
-        return prio.node_label(p, extra["node_label_prio_row"])
+        return prio.node_label(p, b.volsvc.nl_prio_rows[extra.get("aux", 0)])
+    if name == "ServiceAntiAffinityPriority":
+        return b.volsvc.saa_score[extra.get("aux", 0)][b.volsvc.saa_group]
     if name == "EqualPriority":
         return prio.equal_priority(p, n_nodes)
-    if name in PASSTHROUGH_PRIORITIES:
-        return jnp.zeros((p, n_nodes), jnp.float32)
     raise KeyError(f"unknown priority {name!r}")
 
 
@@ -242,10 +282,35 @@ class Solver:
     def __init__(self, policy: Policy):
         self.policy = policy
         self.predicate_names = tuple(p.name for p in expand_predicates(policy))
-        self.priority_specs = tuple((s.name, s.weight) for s in policy.priorities
-                                    if s.weight != 0)
+        # (name, weight, aux) — aux indexes per-instance policy-arg tables
+        # (ServiceAntiAffinityPriority / NodeLabelPriority rows).
+        specs = []
+        saa_i = nl_i = 0
+        for s in policy.priorities:
+            if s.weight == 0:
+                continue
+            if s.name == "ServiceAntiAffinityPriority":
+                specs.append((s.name, s.weight, saa_i))
+                saa_i += 1
+            elif s.name == "NodeLabelPriority":
+                specs.append((s.name, s.weight, nl_i))
+                nl_i += 1
+            else:
+                specs.append((s.name, s.weight, 0))
+        self.priority_specs = tuple(specs)
         self.passthrough = tuple(n for n in self.predicate_names
                                  if n in PASSTHROUGH_PREDICATES)
+        # MaxPD caps: policy value, else KUBE_MAX_PD_VOLS env, else provider
+        # default (defaults.go:42-54).
+        env_max = os.environ.get("KUBE_MAX_PD_VOLS", "")
+        env_val = int(env_max) if env_max.isdigit() else 0
+        self.extra = {"max_ebs": env_val or DEFAULT_MAX_EBS_VOLUMES,
+                      "max_gce": env_val or DEFAULT_MAX_GCE_PD_VOLUMES}
+        for spec in expand_predicates(policy):
+            if spec.name == "MaxEBSVolumeCount" and spec.max_volumes:
+                self.extra["max_ebs"] = spec.max_volumes
+            elif spec.name == "MaxGCEPDVolumeCount" and spec.max_volumes:
+                self.extra["max_gce"] = spec.max_volumes
 
     # -- one-shot batched evaluation ------------------------------------
 
@@ -253,7 +318,7 @@ class Solver:
     def masks(self, b: DeviceBatch, c: DeviceCluster) -> dict[str, jnp.ndarray]:
         """Per-predicate [P,N] masks (for Filter verbs / failure reporting)."""
         n = c.alloc.shape[0]
-        return {name: _predicate_mask(name, b, c, n, {})
+        return {name: _predicate_mask(name, b, c, n, self.extra)
                 for name in self.predicate_names}
 
     @functools.partial(jax.jit, static_argnums=(0,))
@@ -265,10 +330,11 @@ class Solver:
         feasible = jnp.broadcast_to(c.schedulable[None, :],
                                     (b.request.shape[0], n))
         for name in self.predicate_names:
-            feasible &= _predicate_mask(name, b, c, n, {})
+            feasible &= _predicate_mask(name, b, c, n, self.extra)
         scores = jnp.zeros((b.request.shape[0], n), jnp.float32)
-        for name, weight in self.priority_specs:
-            scores += jnp.float32(weight) * _priority_plane(name, b, c, n, {})
+        for name, weight, aux in self.priority_specs:
+            scores += jnp.float32(weight) * \
+                _priority_plane(name, b, c, n, {"aux": aux})
         return feasible, scores
 
     # -- sequential greedy solve ----------------------------------------
@@ -291,7 +357,7 @@ class Solver:
         static_mask = jnp.broadcast_to(c.schedulable[None, :], (p, n))
         for name in self.predicate_names:
             if name not in DYNAMIC_PREDICATES:
-                static_mask &= _predicate_mask(name, b, c, n, {})
+                static_mask &= _predicate_mask(name, b, c, n, self.extra)
         # Dynamic predicates run inside the scan, but only those the policy
         # actually configures (evaluate() and the reference honor the policy).
         use_resources = "PodFitsResources" in self.predicate_names
@@ -299,14 +365,16 @@ class Solver:
                         for nm in ("PodFitsHostPorts", "PodFitsPorts"))
         use_volumes = "NoDiskConflict" in self.predicate_names
         use_interpod = "MatchInterPodAffinity" in self.predicate_names
+        use_max_ebs = "MaxEBSVolumeCount" in self.predicate_names
+        use_max_gce = "MaxGCEPDVolumeCount" in self.predicate_names
         static_score = jnp.zeros((p, n), jnp.float32)
         dynamic_prios = []
-        for name, weight in self.priority_specs:
+        for name, weight, aux in self.priority_specs:
             if name in DYNAMIC_PRIORITIES:
                 dynamic_prios.append((name, weight))
             else:
                 static_score += jnp.float32(weight) * \
-                    _priority_plane(name, b, c, n, {})
+                    _priority_plane(name, b, c, n, {"aux": aux})
         dynamic_prios = tuple(dynamic_prios)
         use_interpod_prio = any(nm == "InterPodAffinityPriority"
                                 for nm, _ in dynamic_prios)
@@ -340,6 +408,17 @@ class Solver:
                     jnp.einsum("w,nw->n", xs["vro"].astype(f32),
                                state["vol_rw"].astype(f32))) > 0
                 feasible &= ~vol_conflict
+            for fam in ("ebs", "gce") if (use_max_ebs or use_max_gce) else ():
+                if (fam == "ebs" and not use_max_ebs) or \
+                        (fam == "gce" and not use_max_gce):
+                    continue
+                pd_node = state[f"pd_{fam}"]
+                pod_row = xs[f"pd_pod_{fam}"].astype(f32)
+                overlap = jnp.einsum("w,nw->n", pod_row, pd_node.astype(f32))
+                new = jnp.sum(pod_row) + xs[f"pd_extra_{fam}"].astype(f32)
+                total = jnp.sum(pd_node.astype(f32), axis=1) + new - overlap
+                feasible &= (new == 0) | \
+                    (total <= f32(self.extra[f"max_{fam}"]))
             if track_affinity:
                 reach = state["match_cnt"] > 0.0  # [Sm, N]
             if use_interpod:
@@ -417,6 +496,12 @@ class Solver:
                    == zid)
             new_state["sp_zone"] = state["sp_zone"] + \
                 xs["incr"].astype(f32)[:, None] * zoh.astype(f32)[None, :]
+            if use_max_ebs:
+                new_state["pd_ebs"] = state["pd_ebs"] | \
+                    (onehot[:, None] & xs["pd_pod_ebs"][None, :])
+            if use_max_gce:
+                new_state["pd_gce"] = state["pd_gce"] | \
+                    (onehot[:, None] & xs["pd_pod_gce"][None, :])
             if track_affinity:
                 (new_state["match_cnt"], new_state["match_total"],
                  new_state["decl_reach"], new_state["sym_cnt"]) = \
@@ -452,6 +537,14 @@ class Solver:
                       match_src=a.match_src, decl_src=a.decl_src,
                       pref_w=a.pref_w, sym_match=a.sym_match,
                       sym_src=a.sym_src)
+        if use_max_ebs:
+            init["pd_ebs"] = b.volsvc.pd_node_ebs
+            xs["pd_pod_ebs"] = b.volsvc.pd_pod_ebs
+            xs["pd_extra_ebs"] = b.volsvc.pd_extra_ebs
+        if use_max_gce:
+            init["pd_gce"] = b.volsvc.pd_node_gce
+            xs["pd_pod_gce"] = b.volsvc.pd_pod_gce
+            xs["pd_extra_gce"] = b.volsvc.pd_extra_gce
         final, choices = jax.lax.scan(step, init, xs)
         new_c = c._replace(requested=final["requested"],
                            nonzero=final["nonzero"],

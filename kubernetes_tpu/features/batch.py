@@ -24,6 +24,8 @@ import numpy as np
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.features import compiler as fc
 from kubernetes_tpu.features.affinity import AffinityTensors, compile_affinity
+from kubernetes_tpu.features.volumes import (VolSvcTensors, compile_volsvc,
+                                             empty_volsvc)
 
 
 @dataclass
@@ -54,6 +56,7 @@ class PodBatch:
     node_zone_id: np.ndarray   # [N] int32 — compact zone id, -1 = no zone
     avoid_mask: np.ndarray     # [P, N] bool — NodePreferAvoidPods hit
     aff: AffinityTensors       # inter-pod (anti-)affinity sig tables
+    volsvc: VolSvcTensors      # volume counts/zones + service (anti-)affinity
 
     @property
     def p(self) -> int:
@@ -219,8 +222,12 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
                   spread_selectors: Optional[SpreadSelectors] = None,
                   controller_refs: Optional[ControllerRefs] = None,
                   affinity_pods: Sequence[tuple[api.Pod, int]] = (),
-                  hard_pod_affinity_weight: int = 1) -> PodBatch:
-    """Compile a pending-pod batch against the current node tensors."""
+                  hard_pod_affinity_weight: int = 1,
+                  volsvc: Optional[VolSvcTensors] = None) -> PodBatch:
+    """Compile a pending-pod batch against the current node tensors.
+
+    ``volsvc``: precompiled volume/service tables (compile_volsvc); a
+    neutral all-pass table is built when omitted."""
     p = len(pods)
     n = nt.n
 
@@ -382,6 +389,11 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
 
     aff = compile_affinity(pods, affinity_pods, ep, nodes, n, space,
                            hard_pod_affinity_weight)
+    if volsvc is None:
+        if nodes is not None:
+            volsvc = compile_volsvc(pods, nodes, nt.schedulable)
+        else:
+            volsvc = empty_volsvc(p, n)
 
     return PodBatch(
         pods=list(pods), request=request, zero_request=zero_req, nonzero=nonzero,
@@ -392,7 +404,8 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
         sel_pref_counts=sel_pref, spread_group=spread_group,
         spread_node_counts=sp_n, spread_zone_counts=sp_z,
         spread_has_zones=sp_hz, spread_incr=spread_incr,
-        node_zone_id=node_zone_id, avoid_mask=avoid_mask, aff=aff)
+        node_zone_id=node_zone_id, avoid_mask=avoid_mask, aff=aff,
+        volsvc=volsvc)
 
 
 def _spread_counts(namespace: str, selectors: list,

@@ -25,7 +25,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kubernetes_tpu.engine.solver import (DeviceAffinity, DeviceBatch,
-                                          DeviceCluster)
+                                          DeviceCluster, DeviceVolSvc)
 
 BATCH_AXIS = "batch"
 NODE_AXIS = "nodes"
@@ -85,6 +85,31 @@ def _shard_affinity(a: DeviceAffinity, mesh: Mesh,
     return DeviceAffinity(**out)
 
 
+# DeviceVolSvc: node-axis tables shard over nodes; per-pod rows over batch.
+_VS_NODE_FIELDS = {"pd_node_ebs", "pd_node_gce", "nl_pred_row"}
+_VS_NODE_LAST_FIELDS = {"vz_mask", "sa_mask", "nl_prio_rows"}
+_VS_POD_FIELDS = {"pd_pod_ebs", "pd_pod_gce", "pd_extra_ebs", "pd_extra_gce",
+                  "vz_group", "sa_group", "saa_group"}
+
+
+def _shard_volsvc(v: DeviceVolSvc, mesh: Mesh,
+                  shard_pods: bool) -> DeviceVolSvc:
+    out = {}
+    for name, arr in zip(DeviceVolSvc._fields, v):
+        if name in _VS_NODE_FIELDS:
+            spec = P(NODE_AXIS) if arr.ndim == 1 else P(NODE_AXIS, None)
+        elif name in _VS_NODE_LAST_FIELDS:
+            spec = P(None, NODE_AXIS)
+        elif name == "saa_score":
+            spec = P(None, None, NODE_AXIS)
+        elif name in _VS_POD_FIELDS and shard_pods:
+            spec = P(BATCH_AXIS) if arr.ndim == 1 else P(BATCH_AXIS, None)
+        else:
+            spec = P(*([None] * arr.ndim))
+        out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return DeviceVolSvc(**out)
+
+
 def shard_batch(b: DeviceBatch, mesh: Mesh,
                 shard_pods: bool = False) -> DeviceBatch:
     """Shard group tables over nodes; optionally shard pod-axis tensors over
@@ -97,6 +122,9 @@ def shard_batch(b: DeviceBatch, mesh: Mesh,
             continue
         if name == "aff":
             out[name] = _shard_affinity(arr, mesh, shard_pods)
+            continue
+        if name == "volsvc":
+            out[name] = _shard_volsvc(arr, mesh, shard_pods)
             continue
         if name in _BATCH_NODE_LAST_FIELDS:
             spec = P(None, NODE_AXIS)

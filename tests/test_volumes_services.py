@@ -1,0 +1,217 @@
+"""Volume-count, volume-zone, and service (anti-)affinity semantics tests
+(MaxPDVolumeCountChecker predicates.go:155-316, VolumeZoneChecker :318-418,
+CheckServiceAffinity :623-719, CalculateAntiAffinityPriority
+selector_spreading.go:193-253)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.policy import (Policy, PredicateSpec, PrioritySpec,
+                                       default_provider)
+from kubernetes_tpu.engine.generic_scheduler import (FitError,
+                                                     GenericScheduler, Listers)
+
+from helpers import make_node, make_pod
+
+
+def _ebs_pod(name, *vol_ids, pvc=None):
+    vols = [api.Volume(name=f"v{i}", aws_ebs_id=v)
+            for i, v in enumerate(vol_ids)]
+    if pvc:
+        vols.append(api.Volume(name="pvc", pvc_claim_name=pvc))
+    return make_pod(name, volumes=vols)
+
+
+def _max_ebs_policy(cap):
+    return Policy(predicates=[PredicateSpec("MaxEBSVolumeCount",
+                                            max_volumes=cap),
+                              PredicateSpec("PodFitsResources")],
+                  priorities=[PrioritySpec("LeastRequestedPriority", 1)])
+
+
+class TestMaxPDVolumeCount:
+    def test_cap_respected(self):
+        s = GenericScheduler(policy=_max_ebs_policy(2))
+        s.cache.add_node(make_node("n0"))
+        p1 = _ebs_pod("p1", "vol-a", "vol-b")
+        assert s.schedule(p1) == "n0"
+        p1.node_name = "n0"
+        s.cache.add_pod(p1)
+        with pytest.raises(FitError) as e:
+            s.schedule(_ebs_pod("p2", "vol-c"))
+        assert "MaxEBSVolumeCount" in str(e.value.failed_predicates)
+
+    def test_overlapping_volume_not_double_counted(self):
+        s = GenericScheduler(policy=_max_ebs_policy(2))
+        s.cache.add_node(make_node("n0"))
+        p1 = _ebs_pod("p1", "vol-a", "vol-b")
+        p1.node_name = "n0"
+        s.cache.add_pod(p1)
+        # vol-a already mounted: only counts once -> still fits.
+        assert s.schedule(_ebs_pod("p2", "vol-a")) == "n0"
+
+    def test_no_relevant_volumes_passes_even_over_cap(self):
+        s = GenericScheduler(policy=_max_ebs_policy(1))
+        s.cache.add_node(make_node("n0"))
+        p1 = _ebs_pod("p1", "vol-a", "vol-b")  # over cap, placed externally
+        p1.node_name = "n0"
+        s.cache.add_pod(p1)
+        # quick return at predicates.go:245-247: no volumes -> pass.
+        assert s.schedule(make_pod("plain")) == "n0"
+
+    def test_pvc_backed_volume_counts(self):
+        listers = Listers(
+            pvs=[api.PersistentVolume(name="pv-1", aws_ebs_id="vol-x")],
+            pvcs=[api.PersistentVolumeClaim(name="claim-1",
+                                            volume_name="pv-1")])
+        s = GenericScheduler(policy=_max_ebs_policy(1), listers=listers)
+        s.cache.add_node(make_node("n0"))
+        p1 = _ebs_pod("p1", "vol-a")
+        p1.node_name = "n0"
+        s.cache.add_pod(p1)
+        with pytest.raises(FitError):
+            s.schedule(_ebs_pod("p2", pvc="claim-1"))
+
+    def test_missing_pvc_counts_as_one(self):
+        s = GenericScheduler(policy=_max_ebs_policy(1))
+        s.cache.add_node(make_node("n0"))
+        # missing PVC assumed to match (predicates.go:195-204): counts 1 <= 1.
+        assert s.schedule(_ebs_pod("p1", pvc="ghost")) == "n0"
+        with_extra = _ebs_pod("p2", "vol-a", pvc="ghost")  # 1 + 1 > 1
+        with pytest.raises(FitError):
+            s.schedule(with_extra)
+
+    def test_unbound_pvc_fails_everywhere(self):
+        listers = Listers(pvcs=[api.PersistentVolumeClaim(name="c1",
+                                                          volume_name="")])
+        s = GenericScheduler(policy=_max_ebs_policy(39), listers=listers)
+        s.cache.add_node(make_node("n0"))
+        with pytest.raises(FitError):
+            s.schedule(_ebs_pod("p1", pvc="c1"))
+
+    def test_batch_sequential_cap(self):
+        # Three single-volume pods, cap 2: third pod must go elsewhere.
+        s = GenericScheduler(policy=_max_ebs_policy(2))
+        s.cache.add_node(make_node("n0"))
+        s.cache.add_node(make_node("n1"))
+        pods = [_ebs_pod(f"p{i}", f"vol-{i}") for i in range(3)]
+        got = s.schedule_batch(pods)
+        assert sorted(got).count("n0") <= 2
+        assert len([g for g in got if g]) == 3
+
+
+def _vz_policy():
+    return Policy(predicates=[PredicateSpec("NoVolumeZoneConflict"),
+                              PredicateSpec("PodFitsResources")],
+                  priorities=[PrioritySpec("LeastRequestedPriority", 1)])
+
+
+class TestVolumeZone:
+    def _listers(self, zone):
+        return Listers(
+            pvs=[api.PersistentVolume(name="pv-z", labels={
+                api.ZONE_LABEL: zone})],
+            pvcs=[api.PersistentVolumeClaim(name="claim-z",
+                                            volume_name="pv-z")])
+
+    def test_zone_match_required(self):
+        s = GenericScheduler(policy=_vz_policy(), listers=self._listers("z2"))
+        s.cache.add_node(make_node("n0", labels={api.ZONE_LABEL: "z1"}))
+        s.cache.add_node(make_node("n1", labels={api.ZONE_LABEL: "z2"}))
+        pod = make_pod(volumes=[api.Volume(name="v",
+                                           pvc_claim_name="claim-z")])
+        assert s.schedule(pod) == "n1"
+
+    def test_unlabeled_node_passes(self):
+        # Node without zone constraints is OK (predicates.go:362-368).
+        s = GenericScheduler(policy=_vz_policy(), listers=self._listers("z9"))
+        s.cache.add_node(make_node("n0", labels={api.ZONE_LABEL: "z1"}))
+        s.cache.add_node(make_node("n1"))
+        pod = make_pod(volumes=[api.Volume(name="v",
+                                           pvc_claim_name="claim-z")])
+        assert s.schedule(pod) == "n1"
+
+    def test_no_pvc_volumes_pass(self):
+        s = GenericScheduler(policy=_vz_policy())
+        s.cache.add_node(make_node("n0", labels={api.ZONE_LABEL: "z1"}))
+        assert s.schedule(make_pod()) == "n0"
+
+
+class TestServiceAffinity:
+    def _policy(self):
+        return Policy(
+            predicates=[PredicateSpec("ServiceAffinity",
+                                      affinity_labels=("region",)),
+                        PredicateSpec("PodFitsResources")],
+            priorities=[PrioritySpec("LeastRequestedPriority", 1)])
+
+    def _cluster(self, listers):
+        s = GenericScheduler(policy=self._policy(), listers=listers)
+        s.cache.add_node(make_node("n0", labels={"region": "r1"}))
+        s.cache.add_node(make_node("n1", labels={"region": "r2"}))
+        return s
+
+    def test_node_selector_pins_label(self):
+        s = self._cluster(Listers())
+        got = s.schedule(make_pod(node_selector={"region": "r2"}))
+        assert got == "n1"
+
+    def test_inherits_from_service_peer(self):
+        listers = Listers(services=[api.Service(name="db",
+                                                selector={"app": "db"})])
+        s = self._cluster(listers)
+        peer = make_pod(labels={"app": "db"})
+        peer.node_name = "n1"  # peer in r2
+        s.cache.add_pod(peer)
+        got = s.schedule(make_pod(labels={"app": "db"}))
+        assert got == "n1"
+
+    def test_no_peers_all_nodes_ok(self):
+        s = self._cluster(Listers())
+        assert s.schedule(make_pod()) in ("n0", "n1")
+
+
+class TestServiceAntiAffinity:
+    def _policy(self):
+        return Policy(
+            predicates=[PredicateSpec("PodFitsResources")],
+            priorities=[PrioritySpec("ServiceAntiAffinityPriority", 1,
+                                     anti_affinity_label="rack")])
+
+    def test_spreads_by_label_value(self):
+        listers = Listers(services=[api.Service(name="web",
+                                                selector={"app": "web"})])
+        s = GenericScheduler(policy=self._policy(), listers=listers)
+        s.cache.add_node(make_node("n0", labels={"rack": "a"}))
+        s.cache.add_node(make_node("n1", labels={"rack": "b"}))
+        peer = make_pod(labels={"app": "web"})
+        peer.node_name = "n0"
+        s.cache.add_pod(peer)
+        got = s.schedule(make_pod(labels={"app": "web"}))
+        assert got == "n1"  # rack b has no service pods
+
+    def test_unlabeled_nodes_score_zero(self):
+        listers = Listers(services=[api.Service(name="web",
+                                                selector={"app": "web"})])
+        s = GenericScheduler(policy=self._policy(), listers=listers)
+        s.cache.add_node(make_node("n0", labels={"rack": "a"}))
+        s.cache.add_node(make_node("n1"))  # unlabeled: score 0
+        got = s.schedule(make_pod(labels={"app": "web"}))
+        # no service pods yet: labeled node scores 10, unlabeled 0.
+        assert got == "n0"
+
+
+class TestDefaultProviderEndToEnd:
+    def test_default_policy_with_pd_volumes(self):
+        # The default provider wires MaxEBS/MaxGCE/NoVolumeZoneConflict; a
+        # plain cluster with PD pods must still schedule.
+        s = GenericScheduler(policy=default_provider())
+        for i in range(3):
+            s.cache.add_node(make_node(f"n{i}"))
+        got = s.schedule_batch(
+            [_ebs_pod("e1", "vol-1"), make_pod("plain"),
+             make_pod(volumes=[api.Volume(name="g", gce_pd_name="pd-1")])])
+        assert all(g is not None for g in got)
