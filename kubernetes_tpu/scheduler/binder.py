@@ -1,0 +1,79 @@
+"""Binding: committing a scheduling decision.
+
+The reference commits by POSTing a Binding to the apiserver, which CAS-sets
+``spec.nodeName`` only while it is empty (BindingREST.Create -> assignPod ->
+setPodHostAndAnnotations, pkg/registry/pod/etcd/etcd.go:286-330) — the
+atomic conflict detector for optimistic concurrency.
+
+``Binder`` is the protocol; ``InMemoryBinder`` reproduces the CAS semantics
+for the integration/perf rigs (the in-process-apiserver analogue), and
+``HTTPBinder`` speaks to a real apiserver.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Optional, Protocol
+
+from kubernetes_tpu.api import types as api
+
+
+class BindConflict(Exception):
+    """spec.nodeName was already set (the CAS failed)."""
+
+
+class Binder(Protocol):
+    def bind(self, pod: api.Pod, node_name: str) -> None: ...
+
+
+class InMemoryBinder:
+    """CAS-binding against an in-memory pod table (etcd.go:299-330)."""
+
+    def __init__(self) -> None:
+        self._bound: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def bind(self, pod: api.Pod, node_name: str) -> None:
+        with self._lock:
+            current = self._bound.get(pod.key, "")
+            if current:
+                raise BindConflict(
+                    f"pod {pod.key} is already assigned to node {current}")
+            self._bound[pod.key] = node_name
+
+    def bound_node(self, pod_key: str) -> Optional[str]:
+        with self._lock:
+            return self._bound.get(pod_key)
+
+    def unbind(self, pod_key: str) -> None:
+        with self._lock:
+            self._bound.pop(pod_key, None)
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._bound)
+
+
+class HTTPBinder:
+    """POST /api/v1/namespaces/<ns>/bindings (factory.go:576-587)."""
+
+    def __init__(self, api_base: str, timeout: float = 10.0):
+        self.api_base = api_base.rstrip("/")
+        self.timeout = timeout
+
+    def bind(self, pod: api.Pod, node_name: str) -> None:
+        body = json.dumps({
+            "apiVersion": "v1", "kind": "Binding",
+            "metadata": {"name": pod.name, "namespace": pod.namespace},
+            "target": {"apiVersion": "v1", "kind": "Node",
+                       "name": node_name},
+        }).encode()
+        req = urllib.request.Request(
+            f"{self.api_base}/api/v1/namespaces/{pod.namespace}/bindings",
+            data=body, headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            if resp.status >= 300:
+                raise BindConflict(f"bind failed: HTTP {resp.status}")

@@ -1,0 +1,77 @@
+"""Pod queue: the scheduler's FIFO (pkg/client/cache/fifo.go).
+
+Same contract the reference's scheduler relies on: items keyed by pod key;
+Add/Update replace in place without changing queue position; Delete removes;
+Pop blocks until an item is available and returns the OLDEST item; re-adding
+a popped key re-queues it at the back.  ``pop_all`` drains everything at
+once — the batched entry point the TPU solver feeds on.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, Optional
+
+from kubernetes_tpu.api import types as api
+
+
+class FIFO:
+    def __init__(self) -> None:
+        self._lock = threading.Condition()
+        self._items: dict[str, api.Pod] = {}
+        self._queue: collections.deque[str] = collections.deque()
+        self._closed = False
+
+    def add(self, pod: api.Pod) -> None:
+        with self._lock:
+            key = pod.key
+            if key not in self._items:
+                self._queue.append(key)
+            self._items[key] = pod
+            self._lock.notify()
+
+    def update(self, pod: api.Pod) -> None:
+        self.add(pod)
+
+    def delete(self, pod_key: str) -> None:
+        with self._lock:
+            self._items.pop(pod_key, None)
+            # Lazy removal: stale keys are skipped at pop time.
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[api.Pod]:
+        """Blocking pop of the oldest pod; None on close/timeout."""
+        with self._lock:
+            while True:
+                while self._queue:
+                    key = self._queue.popleft()
+                    pod = self._items.pop(key, None)
+                    if pod is not None:
+                        return pod
+                if self._closed:
+                    return None
+                if not self._lock.wait(timeout=timeout):
+                    return None
+
+    def pop_all(self, wait_first: bool = True,
+                timeout: Optional[float] = None) -> list[api.Pod]:
+        """Drain the whole pending queue (blocks for the first item when
+        ``wait_first``).  The batched scheduling entry point."""
+        first = self.pop(timeout=timeout) if wait_first else None
+        out = [first] if first is not None else []
+        with self._lock:
+            while self._queue:
+                key = self._queue.popleft()
+                pod = self._items.pop(key, None)
+                if pod is not None:
+                    out.append(pod)
+        return out

@@ -1,0 +1,181 @@
+"""The scheduling daemon loop.
+
+The reference's ``Scheduler`` (plugin/pkg/scheduler/scheduler.go:46-154)
+runs ``scheduleOne`` forever: blocking pop -> Schedule -> optimistic
+AssumePod -> async Bind; on bind failure ForgetPod + error handler with
+per-pod backoff requeue (factory.go:512-556).  This daemon keeps that state
+machine and adds the TPU-native batched drain: ``schedule_pending`` pops the
+whole queue and solves it as ONE device batch, assuming and binding every
+placement — same observable behavior, three orders of magnitude fewer
+device round-trips.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.engine.generic_scheduler import FitError, GenericScheduler
+from kubernetes_tpu.scheduler.backoff import PodBackoff
+from kubernetes_tpu.scheduler.binder import Binder, InMemoryBinder
+from kubernetes_tpu.scheduler.queue import FIFO
+from kubernetes_tpu.utils.events import EventRecorder
+from kubernetes_tpu.utils.metrics import SchedulerMetrics
+
+DEFAULT_SCHEDULER_NAME = api.DEFAULT_SCHEDULER_NAME
+
+
+@dataclass
+class SchedulerConfig:
+    """The reference's scheduler.Config (scheduler.go:46-77)."""
+
+    algorithm: GenericScheduler
+    binder: Binder = field(default_factory=InMemoryBinder)
+    recorder: EventRecorder = field(default_factory=EventRecorder)
+    metrics: SchedulerMetrics = field(default_factory=SchedulerMetrics)
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    # Pod-condition updater analogue (factory.go:589-600); called with
+    # (pod, reason, message) when scheduling fails.
+    condition_updater: Optional[Callable[[api.Pod, str, str], None]] = None
+    async_bind: bool = True
+
+
+class Scheduler:
+    def __init__(self, config: SchedulerConfig):
+        self.config = config
+        self.queue = FIFO()
+        self.backoff = PodBackoff()
+        self._stop = threading.Event()
+        self._bind_threads: list[threading.Thread] = []
+
+    # -- queue feed (the reflector-handler analogue) ---------------------
+
+    def responsible_for(self, pod: api.Pod) -> bool:
+        """Multi-scheduler dispatch by annotation (factory.go:428-434)."""
+        return pod.scheduler_name == self.config.scheduler_name
+
+    def enqueue(self, pod: api.Pod) -> None:
+        if self.responsible_for(pod) and not pod.node_name:
+            self.queue.add(pod)
+
+    # -- one-pod path (scheduleOne, scheduler.go:93-154) -----------------
+
+    def schedule_one(self, timeout: Optional[float] = None) -> bool:
+        """Pop + schedule + assume + bind one pod; False if queue empty."""
+        pod = self.queue.pop(timeout=timeout)
+        if pod is None:
+            return False
+        start = time.perf_counter()
+        try:
+            dest = self.config.algorithm.schedule(pod)
+        except FitError as err:
+            self._handle_failure(pod, "FailedScheduling", str(err))
+            return True
+        algo_us = (time.perf_counter() - start) * 1e6
+        self.config.metrics.scheduling_algorithm_latency.observe(algo_us)
+        self._assume_and_bind(pod, dest, start)
+        return True
+
+    # -- batched path (the TPU drain) ------------------------------------
+
+    def schedule_pending(self, wait_first: bool = True,
+                         timeout: Optional[float] = None) -> int:
+        """Drain the queue and solve it as one device batch.  Returns the
+        number of pods popped (scheduled or failed)."""
+        pods = self.queue.pop_all(wait_first=wait_first, timeout=timeout)
+        if not pods:
+            return 0
+        start = time.perf_counter()
+        placements = self.config.algorithm.schedule_batch(pods)
+        algo_us = (time.perf_counter() - start) * 1e6 / len(pods)
+        for _ in pods:
+            self.config.metrics.scheduling_algorithm_latency.observe(algo_us)
+        for pod, dest in zip(pods, placements):
+            if dest is None:
+                self._handle_failure(
+                    pod, "FailedScheduling",
+                    f"pod ({pod.name}) failed to fit in any node")
+            else:
+                self._assume_and_bind(pod, dest, start)
+        return len(pods)
+
+    # -- run loops --------------------------------------------------------
+
+    def run(self, batched: bool = True) -> threading.Thread:
+        """wait.Until(scheduleOne, 0, stop) (scheduler.go:89-91), in a
+        daemon thread; batched mode drains the queue per iteration."""
+        def loop():
+            while not self._stop.is_set():
+                if batched:
+                    self.schedule_pending(timeout=0.05)
+                else:
+                    self.schedule_one(timeout=0.05)
+        t = threading.Thread(target=loop, daemon=True,
+                             name="scheduler-loop")
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()
+        for t in self._bind_threads:
+            t.join(timeout=5)
+
+    def wait_for_binds(self) -> None:
+        for t in list(self._bind_threads):
+            t.join()
+        self._bind_threads = [t for t in self._bind_threads if t.is_alive()]
+
+    # -- internals --------------------------------------------------------
+
+    def _assume_and_bind(self, pod: api.Pod, dest: str, start: float) -> None:
+        cache = self.config.algorithm.cache
+        # Optimistic assume before the async bind; an assume error is logged
+        # and binding proceeds anyway (scheduler.go:116-120).
+        assumed = True
+        try:
+            cache.assume_pod(pod, dest)
+        except ValueError:
+            assumed = False
+
+        def bind():
+            bind_start = time.perf_counter()
+            try:
+                self.config.binder.bind(pod, dest)
+            except Exception as err:  # noqa: BLE001 — bind errors requeue
+                # ForgetPod + error handler (scheduler.go:139-148).
+                if assumed:
+                    cache.forget_pod(pod)
+                self._handle_failure(pod, "FailedScheduling",
+                                     f"Binding rejected: {err}")
+                return
+            us = (time.perf_counter() - bind_start) * 1e6
+            self.config.metrics.binding_latency.observe(us)
+            self.config.metrics.e2e_scheduling_latency.observe(
+                (time.perf_counter() - start) * 1e6)
+            self.config.recorder.eventf(
+                pod.key, "Normal", "Scheduled",
+                f"Successfully assigned {pod.name} to {dest}")
+
+        if self.config.async_bind:
+            t = threading.Thread(target=bind, daemon=True)
+            t.start()
+            self._bind_threads.append(t)
+        else:
+            bind()
+
+    def _handle_failure(self, pod: api.Pod, reason: str, message: str) -> None:
+        """Event + condition update + backoff requeue (factory.go:512-556)."""
+        self.config.recorder.eventf(pod.key, "Warning", reason, message)
+        if self.config.condition_updater is not None:
+            self.config.condition_updater(pod, "Unschedulable", message)
+        backoff_s = self.backoff.get_backoff(pod.key)
+
+        def requeue():
+            if not self._stop.wait(backoff_s):
+                pod.node_name = ""
+                self.queue.add(pod)
+        threading.Thread(target=requeue, daemon=True).start()

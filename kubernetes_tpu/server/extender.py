@@ -1,0 +1,192 @@
+"""Scheduler-extender HTTP server: the TPU hook for a stock control plane.
+
+Implements the wire protocol the reference's ``HTTPExtender`` speaks
+(extender.go:95-187, schema plugin/pkg/scheduler/api/v1/types.go:134-163):
+
+    POST {urlPrefix}/{apiVersion}/{filterVerb}     ExtenderArgs -> ExtenderFilterResult
+    POST {urlPrefix}/{apiVersion}/{prioritizeVerb} ExtenderArgs -> HostPriorityList
+
+A stock kube-scheduler configured with
+``examples/scheduler-policy-config-with-extender.json`` delegates its
+Filter/Prioritize calls here unchanged; each request carries the pod and the
+candidate node list, the engine answers from one batched device evaluation.
+
+Also serves GET /healthz, /metrics (Prometheus text), and /configz — the
+daemon endpoints every reference binary exposes (app/server.go:93-109).
+
+Run: ``python -m kubernetes_tpu.server.extender --port 12346``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.policy import Policy, default_provider, policy_from_json
+from kubernetes_tpu.cache.scheduler_cache import SchedulerCache
+from kubernetes_tpu.engine.generic_scheduler import GenericScheduler, Listers
+from kubernetes_tpu.utils.metrics import SchedulerMetrics
+
+
+class ExtenderCore:
+    """Stateless per-request engine: each Filter/Prioritize call carries its
+    own node list, so a fresh cache is compiled per request (the extender
+    protocol's contract; state, if any, belongs to the calling scheduler)."""
+
+    def __init__(self, policy: Policy | None = None):
+        self.policy = policy or default_provider()
+        self.metrics = SchedulerMetrics()
+        self._lock = threading.Lock()
+        self._solver_holder: GenericScheduler | None = None
+
+    def _engine(self, nodes: list[api.Node]) -> GenericScheduler:
+        cache = SchedulerCache()
+        for nd in nodes:
+            cache.add_node(nd)
+        eng = GenericScheduler(policy=self.policy, cache=cache,
+                               listers=Listers())
+        with self._lock:
+            if self._solver_holder is not None:
+                # Reuse the compiled Solver (same policy): jit caches carry.
+                eng.solver = self._solver_holder.solver
+            else:
+                self._solver_holder = eng
+        return eng
+
+    def _evaluate(self, args: dict):
+        # Accept both v1 lowercase keys and internal-type capitalized keys
+        # (clients serialize either depending on codec).
+        pod = api.pod_from_json(args.get("pod") or args.get("Pod") or {})
+        nodes_obj = args.get("nodes") or args.get("Nodes") or {}
+        node_items = nodes_obj.get("items") or nodes_obj.get("Items") or []
+        nodes = [api.node_from_json(n) for n in node_items]
+        eng = self._engine(nodes)
+        _, db, dc, nt = eng._compile([pod])
+        feasible, scores = eng.solver.evaluate(db, dc)
+        return pod, nodes, node_items, np.asarray(feasible[0]), \
+            np.asarray(scores[0]), eng, db, dc, nt
+
+    def filter(self, args: dict) -> dict:
+        """ExtenderArgs -> ExtenderFilterResult (extender.go:97-125)."""
+        try:
+            pod, nodes, node_items, feasible, _, eng, db, dc, nt = \
+                self._evaluate(args)
+            failed: dict[str, str] = {}
+            keep = []
+            masks = None
+            for i, nd in enumerate(nodes):
+                if feasible[i]:
+                    keep.append(node_items[i])
+                else:
+                    if masks is None:
+                        masks = {k: np.asarray(v[0]) for k, v in
+                                 eng.solver.masks(db, dc).items()}
+                    reasons = [p for p, m in masks.items() if not m[i]] \
+                        if nt.schedulable[i] else ["Unschedulable"]
+                    failed[nd.name] = ", ".join(reasons) or "does not fit"
+            return {"nodes": {"items": keep}, "failedNodes": failed}
+        except Exception as err:  # noqa: BLE001 — wire contract: Error field
+            return {"nodes": {"items": []}, "failedNodes": {},
+                    "error": str(err)}
+
+    def prioritize(self, args: dict) -> list[dict]:
+        """ExtenderArgs -> HostPriorityList (extender.go:130-154).  Combined
+        weighted scores are rescaled to the extender's 0-10 band."""
+        try:
+            _, nodes, _, feasible, scores, *_ = self._evaluate(args)
+            smax = float(scores.max()) if len(scores) else 0.0
+            out = []
+            for i, nd in enumerate(nodes):
+                score = int(10.0 * scores[i] / smax) if smax > 0 else 0
+                out.append({"host": nd.name, "score": score})
+            return out
+        except Exception:  # noqa: BLE001 — prioritize errors are ignorable
+            nodes_obj = args.get("nodes") or args.get("Nodes") or {}
+            items = nodes_obj.get("items") or nodes_obj.get("Items") or []
+            return [{"host": (nd.get("metadata") or {}).get("name", ""),
+                     "score": 0} for nd in items]
+
+
+def make_handler(core: ExtenderCore):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, body: bytes,
+                  ctype: str = "application/json") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, b"ok", "text/plain")
+            elif self.path == "/metrics":
+                self._send(200, core.metrics.expose().encode(), "text/plain")
+            elif self.path == "/configz":
+                cfg = {"predicates": [p.name for p in core.policy.predicates],
+                       "priorities": [(s.name, s.weight)
+                                      for s in core.policy.priorities]}
+                self._send(200, json.dumps(cfg).encode())
+            else:
+                self._send(404, b"not found", "text/plain")
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                args = json.loads(self.rfile.read(length) or b"{}")
+            except ValueError:
+                self._send(400, b'{"error": "bad json"}')
+                return
+            # Dispatch on the trailing verb; the prefix/apiVersion segments
+            # are caller-configured (extender.go:166 builds
+            # urlPrefix/apiVersion/verb).
+            verb = self.path.rstrip("/").rsplit("/", 1)[-1]
+            import time
+            start = time.perf_counter()
+            if verb == "filter":
+                result = core.filter(args)
+            elif verb == "prioritize":
+                result = core.prioritize(args)
+            else:
+                self._send(404, b'{"error": "unknown verb"}')
+                return
+            us = (time.perf_counter() - start) * 1e6
+            core.metrics.scheduling_algorithm_latency.observe(us)
+            self._send(200, json.dumps(result).encode())
+
+    return Handler
+
+
+def serve(port: int = 12346, policy: Policy | None = None,
+          host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    core = ExtenderCore(policy)
+    server = ThreadingHTTPServer((host, port), make_handler(core))
+    return server
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int, default=12346)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--policy-config-file", default="",
+                    help="scheduler policy JSON (CreateFromConfig analogue)")
+    opts = ap.parse_args()
+    policy = None
+    if opts.policy_config_file:
+        with open(opts.policy_config_file) as f:
+            policy = policy_from_json(f.read())
+    server = serve(opts.port, policy, opts.host)
+    print(f"tpu-scheduler extender listening on {opts.host}:{opts.port}")
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

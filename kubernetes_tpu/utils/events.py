@@ -1,0 +1,42 @@
+"""Event recording (pkg/client/record): every scheduling success/failure is
+posted as an event (scheduler.go:102,143,152).  Sinks are pluggable; the
+default keeps a bounded in-memory ring like the apiserver's event window."""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Event:
+    object_key: str   # "namespace/name"
+    event_type: str   # "Normal" | "Warning"
+    reason: str       # "Scheduled" | "FailedScheduling" | ...
+    message: str
+    timestamp: float
+
+
+class EventRecorder:
+    def __init__(self, max_events: int = 4096, sink=None):
+        self._events: collections.deque[Event] = collections.deque(
+            maxlen=max_events)
+        self._lock = threading.Lock()
+        self._sink = sink
+
+    def eventf(self, object_key: str, event_type: str, reason: str,
+               message: str) -> None:
+        ev = Event(object_key, event_type, reason, message, time.time())
+        with self._lock:
+            self._events.append(ev)
+        if self._sink is not None:
+            self._sink(ev)
+
+    def events(self, object_key: str | None = None) -> list[Event]:
+        with self._lock:
+            evs = list(self._events)
+        if object_key is not None:
+            evs = [e for e in evs if e.object_key == object_key]
+        return evs

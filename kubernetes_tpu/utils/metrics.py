@@ -1,0 +1,92 @@
+"""Prometheus-style metrics, wire-compatible text exposition.
+
+The scheduler's three histograms (plugin/pkg/scheduler/metrics/metrics.go:
+31-55): microseconds, exponential buckets 1ms * 2^k for 15 buckets, exposed
+at /metrics in the Prometheus text format every daemon serves.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable
+
+
+class Histogram:
+    """prometheus.Histogram with ExponentialBuckets semantics."""
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: Iterable[float]):
+        self.name = name
+        self.help = help_text
+        self.uppers = sorted(buckets)
+        self._counts = [0] * len(self.uppers)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, upper in enumerate(self.uppers):
+                if value <= upper:
+                    self._counts[i] += 1
+
+    def expose(self) -> str:
+        with self._lock:
+            lines = [f"# HELP {self.name} {self.help}",
+                     f"# TYPE {self.name} histogram"]
+            for upper, count in zip(self.uppers, self._counts):
+                lines.append(f'{self.name}_bucket{{le="{upper:g}"}} {count}')
+            lines.append(f'{self.name}_bucket{{le="+Inf"}} {self._count}')
+            lines.append(f"{self.name}_sum {self._sum:g}")
+            lines.append(f"{self.name}_count {self._count}")
+            return "\n".join(lines) + "\n"
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, by: int = 1) -> None:
+        with self._lock:
+            self._value += by
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def expose(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} counter\n"
+                f"{self.name} {self.value}\n")
+
+
+def exponential_buckets(start: float, factor: float, count: int) -> list[float]:
+    """prometheus.ExponentialBuckets."""
+    return [start * factor ** i for i in range(count)]
+
+
+class SchedulerMetrics:
+    """The scheduler's metric set (metrics.go:31-55), microseconds."""
+
+    def __init__(self) -> None:
+        buckets = exponential_buckets(1000, 2, 15)
+        self.e2e_scheduling_latency = Histogram(
+            "scheduler_e2e_scheduling_latency_microseconds",
+            "E2e scheduling latency (scheduling algorithm + binding)", buckets)
+        self.scheduling_algorithm_latency = Histogram(
+            "scheduler_scheduling_algorithm_latency_microseconds",
+            "Scheduling algorithm latency", buckets)
+        self.binding_latency = Histogram(
+            "scheduler_binding_latency_microseconds",
+            "Binding latency", buckets)
+
+    def expose(self) -> str:
+        return "".join(h.expose() for h in (
+            self.e2e_scheduling_latency, self.scheduling_algorithm_latency,
+            self.binding_latency))

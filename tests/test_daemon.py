@@ -1,0 +1,172 @@
+"""Scheduler daemon tests: queue, backoff, assume/bind state machine,
+events, metrics (scheduler.go:93-154, factory.go:512-688)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.engine.generic_scheduler import GenericScheduler
+from kubernetes_tpu.scheduler.backoff import PodBackoff
+from kubernetes_tpu.scheduler.binder import BindConflict, InMemoryBinder
+from kubernetes_tpu.scheduler.queue import FIFO
+from kubernetes_tpu.scheduler.scheduler import Scheduler, SchedulerConfig
+
+from helpers import make_node, make_pod
+
+
+def _scheduler(n_nodes=3, **cfg):
+    algo = GenericScheduler()
+    for i in range(n_nodes):
+        algo.cache.add_node(make_node(f"n{i}"))
+    config = SchedulerConfig(algorithm=algo, async_bind=False, **cfg)
+    return Scheduler(config)
+
+
+class TestFIFO:
+    def test_fifo_order_and_update_in_place(self):
+        q = FIFO()
+        a, b = make_pod("a"), make_pod("b")
+        q.add(a)
+        q.add(b)
+        a2 = make_pod("a")
+        a2.labels["v"] = "2"
+        q.update(a2)  # same key: replaces value, keeps position
+        got = q.pop()
+        assert got.name == "a" and got.labels.get("v") == "2"
+        assert q.pop().name == "b"
+
+    def test_delete_skipped_at_pop(self):
+        q = FIFO()
+        q.add(make_pod("a"))
+        q.add(make_pod("b"))
+        q.delete("default/a")
+        assert q.pop().name == "b"
+
+    def test_pop_timeout(self):
+        q = FIFO()
+        assert q.pop(timeout=0.05) is None
+
+    def test_pop_all_drains(self):
+        q = FIFO()
+        for i in range(5):
+            q.add(make_pod(f"p{i}"))
+        got = q.pop_all()
+        assert [p.name for p in got] == [f"p{i}" for i in range(5)]
+        assert len(q) == 0
+
+
+class TestBackoff:
+    def test_exponential_growth_capped(self):
+        clock = [0.0]
+        b = PodBackoff(now=lambda: clock[0])
+        got = [b.get_backoff("default/p") for _ in range(8)]
+        assert got == [1, 2, 4, 8, 16, 32, 60, 60]
+
+    def test_gc_resets_idle_entries(self):
+        clock = [0.0]
+        b = PodBackoff(now=lambda: clock[0])
+        b.get_backoff("default/p")
+        clock[0] += 61
+        b.gc()
+        assert b.get_backoff("default/p") == 1.0
+
+
+class TestScheduleOne:
+    def test_bind_and_event(self):
+        s = _scheduler()
+        pod = make_pod("p1")
+        s.enqueue(pod)
+        assert s.schedule_one(timeout=0.1)
+        binder = s.config.binder
+        assert binder.bound_node("default/p1") is not None
+        evs = s.config.recorder.events("default/p1")
+        assert evs and evs[-1].reason == "Scheduled"
+        assert s.config.metrics.e2e_scheduling_latency._count == 1
+
+    def test_assumed_pod_visible_to_next_decision(self):
+        # The assumed pod occupies capacity before the watch confirms
+        # (cache.go:107): a second large pod must go elsewhere.
+        algo = GenericScheduler()
+        algo.cache.add_node(make_node("n0", milli_cpu=1000))
+        algo.cache.add_node(make_node("n1", milli_cpu=1000))
+        s = Scheduler(SchedulerConfig(algorithm=algo, async_bind=False))
+        s.enqueue(make_pod("p1", cpu="800m"))
+        s.enqueue(make_pod("p2", cpu="800m"))
+        assert s.schedule_one(0.1) and s.schedule_one(0.1)
+        binder = s.config.binder
+        assert binder.bound_node("default/p1") != binder.bound_node("default/p2")
+
+    def test_unschedulable_gets_event_and_requeue(self):
+        s = _scheduler(n_nodes=1)
+        s.config.algorithm.cache.add_node(
+            make_node("full", milli_cpu=100))
+        pod = make_pod("big", cpu="64")
+        s.enqueue(pod)
+        assert s.schedule_one(timeout=0.1)
+        evs = s.config.recorder.events("default/big")
+        assert evs and evs[-1].reason == "FailedScheduling"
+        # Requeued after ~1s backoff.
+        time.sleep(1.2)
+        assert len(s.queue) == 1
+
+    def test_bind_conflict_forgets_assumed_pod(self):
+        class RejectingBinder(InMemoryBinder):
+            def bind(self, pod, node_name):
+                raise BindConflict("already bound")
+
+        algo = GenericScheduler()
+        algo.cache.add_node(make_node("n0"))
+        s = Scheduler(SchedulerConfig(algorithm=algo,
+                                      binder=RejectingBinder(),
+                                      async_bind=False))
+        s.enqueue(make_pod("p1"))
+        assert s.schedule_one(timeout=0.1)
+        # ForgetPod ran: the pod no longer occupies cache state.
+        assert algo.cache.pod_count() == 0
+        evs = s.config.recorder.events("default/p1")
+        assert evs and evs[-1].reason == "FailedScheduling"
+
+    def test_multi_scheduler_annotation_dispatch(self):
+        s = _scheduler()
+        other = make_pod("other")
+        other.annotations[api.SCHEDULER_NAME_ANNOTATION_KEY] = "my-scheduler"
+        s.enqueue(other)  # not responsible: dropped
+        assert len(s.queue) == 0
+        mine = make_pod("mine")
+        s.enqueue(mine)
+        assert len(s.queue) == 1
+
+
+class TestBatchedDrain:
+    def test_schedule_pending_places_all(self):
+        s = _scheduler(n_nodes=4)
+        for i in range(12):
+            s.enqueue(make_pod(f"p{i}"))
+        assert s.schedule_pending() == 12
+        assert s.config.binder.count() == 12
+        # Spread over all nodes by LeastRequested.
+        nodes = {s.config.binder.bound_node(f"default/p{i}")
+                 for i in range(12)}
+        assert len(nodes) == 4
+
+    def test_run_loop_drains_queue(self):
+        s = _scheduler(n_nodes=2)
+        t = s.run(batched=True)
+        for i in range(6):
+            s.enqueue(make_pod(f"p{i}"))
+        deadline = time.time() + 10
+        while s.config.binder.count() < 6 and time.time() < deadline:
+            time.sleep(0.05)
+        s.stop()
+        assert s.config.binder.count() == 6
+
+    def test_metrics_exposition_format(self):
+        s = _scheduler()
+        s.enqueue(make_pod("p1"))
+        s.schedule_one(timeout=0.1)
+        text = s.config.metrics.expose()
+        assert "scheduler_e2e_scheduling_latency_microseconds_bucket" in text
+        assert 'le="1000"' in text and 'le="+Inf"' in text

@@ -1,0 +1,128 @@
+"""Extender wire-protocol tests: POST filter/prioritize with reference-shaped
+JSON (ExtenderArgs -> ExtenderFilterResult / HostPriorityList,
+plugin/pkg/scheduler/api/v1/types.go:134-163) against a live HTTP server."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.server.extender import serve
+
+
+@pytest.fixture(scope="module")
+def server_port():
+    server = serve(port=0)  # ephemeral
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield port
+    server.shutdown()
+
+
+def _post(port, verb, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/scheduler/v1/{verb}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _node_json(name, cpu="4", mem="32Gi", labels=None, ready=True):
+    return {
+        "metadata": {"name": name, "labels": labels or {}},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": mem, "pods": "110"},
+            "conditions": [{"type": "Ready",
+                            "status": "True" if ready else "False"}],
+        },
+    }
+
+
+def _pod_json(name, cpu="100m", mem="256Mi", node_selector=None):
+    return {
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "nodeSelector": node_selector or {},
+            "containers": [{
+                "name": "c",
+                "resources": {"requests": {"cpu": cpu, "memory": mem}},
+            }],
+        },
+    }
+
+
+class TestFilterVerb:
+    def test_filters_infeasible_nodes(self, server_port):
+        result = _post(server_port, "filter", {
+            "pod": _pod_json("p", cpu="3"),
+            "nodes": {"items": [_node_json("big", cpu="4"),
+                                _node_json("small", cpu="1")]},
+        })
+        names = [n["metadata"]["name"] for n in result["nodes"]["items"]]
+        assert names == ["big"]
+        assert "small" in result["failedNodes"]
+        assert "PodFitsResources" in result["failedNodes"]["small"]
+
+    def test_node_selector(self, server_port):
+        result = _post(server_port, "filter", {
+            "pod": _pod_json("p", node_selector={"disk": "ssd"}),
+            "nodes": {"items": [
+                _node_json("ssd", labels={"disk": "ssd"}),
+                _node_json("hdd", labels={"disk": "hdd"})]},
+        })
+        names = [n["metadata"]["name"] for n in result["nodes"]["items"]]
+        assert names == ["ssd"]
+
+    def test_unready_node_filtered(self, server_port):
+        result = _post(server_port, "filter", {
+            "pod": _pod_json("p"),
+            "nodes": {"items": [_node_json("up"),
+                                _node_json("down", ready=False)]},
+        })
+        names = [n["metadata"]["name"] for n in result["nodes"]["items"]]
+        assert names == ["up"]
+        assert result["failedNodes"]["down"] == "Unschedulable"
+
+    def test_capitalized_keys_accepted(self, server_port):
+        result = _post(server_port, "filter", {
+            "Pod": _pod_json("p"),
+            "Nodes": {"Items": [_node_json("n1")]},
+        })
+        assert len(result["nodes"]["items"]) == 1
+
+
+class TestPrioritizeVerb:
+    def test_scores_favor_emptier_node(self, server_port):
+        result = _post(server_port, "prioritize", {
+            "pod": _pod_json("p", cpu="1"),
+            "nodes": {"items": [_node_json("big", cpu="16"),
+                                _node_json("small", cpu="2")]},
+        })
+        scores = {e["host"]: e["score"] for e in result}
+        assert set(scores) == {"big", "small"}
+        assert scores["big"] >= scores["small"]
+        assert all(0 <= s <= 10 for s in scores.values())
+
+
+class TestDaemonEndpoints:
+    def test_healthz(self, server_port):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server_port}/healthz", timeout=10) as r:
+            assert r.read() == b"ok"
+
+    def test_metrics(self, server_port):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server_port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "scheduler_scheduling_algorithm_latency_microseconds" in text
+
+    def test_configz(self, server_port):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server_port}/configz", timeout=10) as r:
+            cfg = json.loads(r.read())
+        assert "GeneralPredicates" in cfg["predicates"]
