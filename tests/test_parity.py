@@ -1,0 +1,223 @@
+"""Differential decision-parity tests: the tensor engine vs the pure-Python
+oracle (kubernetes_tpu/oracle.py, Go semantics re-derived independently)
+over randomized clusters — the dual-run harness SURVEY.md §7.7 calls for.
+
+Every pending pod must agree with the oracle on (a) the exact feasible node
+set, (b) the exact combined integer score of every feasible node, and
+(c) the chosen host being in the oracle's argmax set (the reference's tie
+order is nondeterministic, so parity is set membership)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu import oracle
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.engine.generic_scheduler import GenericScheduler, Listers
+from kubernetes_tpu.cache.scheduler_cache import SchedulerCache
+
+from helpers import make_node, make_pod
+
+ZONE = api.ZONE_LABEL
+REGION = api.REGION_LABEL
+
+
+def _rand_cluster(rng: np.random.RandomState, n_nodes=12, n_existing=25):
+    nodes = []
+    for i in range(n_nodes):
+        labels = {api.HOSTNAME_LABEL: f"n{i}"}
+        if rng.rand() < 0.8:
+            labels[ZONE] = f"z{rng.randint(3)}"
+            labels[REGION] = f"r{rng.randint(2)}"
+        if rng.rand() < 0.4:
+            labels["disk"] = rng.choice(["ssd", "hdd"])
+        if rng.rand() < 0.3:
+            labels["pool"] = f"pool-{rng.randint(3)}"
+        taints = None
+        if rng.rand() < 0.2:
+            taints = [{"key": "dedicated", "value": "infra",
+                       "effect": rng.choice(["NoSchedule",
+                                             "PreferNoSchedule"])}]
+        conditions = [("Ready", "True" if rng.rand() > 0.1 else "False")]
+        if rng.rand() < 0.15:
+            conditions.append(("MemoryPressure", "True"))
+        if rng.rand() < 0.1:
+            conditions.append(("DiskPressure", "True"))
+        nodes.append(make_node(
+            f"n{i}", milli_cpu=int(rng.choice([2000, 4000, 8000])),
+            memory=int(rng.choice([4, 8, 16])) * 1024 ** 3,
+            pods=int(rng.choice([5, 20, 110])),
+            labels=labels, taints=taints, conditions=conditions))
+
+    services = [api.Service(name=f"svc{i}", selector={"app": f"app{i}"})
+                for i in range(3)]
+    controllers = [api.ReplicationController(name=f"rc{i}",
+                                             selector={"app": f"app{i}"})
+                   for i in range(2)]
+
+    existing = []
+    for i in range(n_existing):
+        labels = {}
+        if rng.rand() < 0.7:
+            labels["app"] = f"app{rng.randint(4)}"
+        affinity = None
+        r = rng.rand()
+        if r < 0.15:
+            affinity = {"podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "labelSelector": {"matchLabels": {
+                        "app": f"app{rng.randint(4)}"}},
+                    "topologyKey": ZONE}]}}
+        elif r < 0.25:
+            affinity = {"podAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [{
+                    "weight": int(rng.randint(1, 10)),
+                    "podAffinityTerm": {
+                        "labelSelector": {"matchLabels": {
+                            "app": f"app{rng.randint(4)}"}},
+                        "topologyKey": ZONE}}]}}
+        pod = make_pod(
+            f"existing-{i}",
+            cpu=f"{int(rng.choice([50, 100, 250, 500]))}m",
+            memory=f"{int(rng.choice([64, 128, 256]))}Mi",
+            labels=labels, affinity=affinity,
+            host_ports=[8080] if rng.rand() < 0.1 else None)
+        pod.node_name = f"n{rng.randint(n_nodes)}"
+        existing.append(pod)
+
+    return nodes, existing, services, controllers
+
+
+def _rand_pending(rng: np.random.RandomState, i: int) -> api.Pod:
+    kwargs: dict = {}
+    r = rng.rand()
+    if r < 0.6:
+        kwargs["cpu"] = f"{int(rng.choice([100, 500, 1000, 3000]))}m"
+        kwargs["memory"] = f"{int(rng.choice([128, 512, 2048]))}Mi"
+    if rng.rand() < 0.5:
+        kwargs["labels"] = {"app": f"app{rng.randint(4)}"}
+    if rng.rand() < 0.2:
+        kwargs["node_selector"] = {"disk": rng.choice(["ssd", "hdd"])}
+    if rng.rand() < 0.15:
+        kwargs["host_ports"] = [8080]
+    if rng.rand() < 0.2:
+        kwargs["tolerations"] = [{"key": "dedicated", "operator": "Equal",
+                                  "value": "infra", "effect": "NoSchedule"}]
+    r = rng.rand()
+    if r < 0.12:
+        kwargs["affinity"] = {"podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "labelSelector": {"matchLabels": {"app": f"app{rng.randint(4)}"}},
+                "topologyKey": ZONE}]}}
+    elif r < 0.24:
+        kwargs["affinity"] = {"podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [{
+                "labelSelector": {"matchLabels": {"app": f"app{rng.randint(4)}"}},
+                "topologyKey": rng.choice([ZONE, ""])}]}}
+    elif r < 0.36:
+        kwargs["affinity"] = {
+            "nodeAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [{
+                    "weight": int(rng.randint(1, 20)),
+                    "preference": {"matchExpressions": [{
+                        "key": "pool", "operator": "In",
+                        "values": [f"pool-{rng.randint(3)}"]}]}}]},
+            "podAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [{
+                    "weight": int(rng.randint(1, 10)),
+                    "podAffinityTerm": {
+                        "labelSelector": {"matchLabels": {
+                            "app": f"app{rng.randint(4)}"}},
+                        "topologyKey": ZONE}}]}}
+    if rng.rand() < 0.1:
+        kwargs["volumes"] = [api.Volume(name="d",
+                                        aws_ebs_id=f"vol-{rng.randint(3)}")]
+    return make_pod(f"pending-{i}", **kwargs)
+
+
+def _build_engine(nodes, existing, services, controllers):
+    cache = SchedulerCache()
+    for nd in nodes:
+        cache.add_node(nd)
+    for p in existing:
+        cache.add_pod(p)
+    listers = Listers(services=list(services), controllers=list(controllers))
+    return GenericScheduler(cache=cache, listers=listers)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_randomized_decision_parity(seed):
+    rng = np.random.RandomState(seed)
+    nodes, existing, services, controllers = _rand_cluster(rng)
+    cluster = oracle.ClusterState(
+        nodes=nodes, pods=existing, services=services,
+        controllers=controllers)
+    engine = _build_engine(nodes, existing, services, controllers)
+
+    node_names = [n.name for n in nodes]
+    ready = {n.name for n in cluster.ready_nodes()}
+
+    mismatches = []
+    for i in range(20):
+        pod = _rand_pending(rng, i)
+        # Oracle.
+        fits, _ = oracle.find_nodes_that_fit(pod, cluster)
+        oracle_feasible = {n.name for n in fits}
+        oracle_scores = oracle.prioritize(pod, cluster)
+        # Engine (single-pod evaluate over the same state).
+        _, db, dc, nt = engine._compile([pod])
+        feasible, scores = engine.solver.evaluate(db, dc)
+        feasible = np.asarray(feasible)[0]
+        scores = np.asarray(scores)[0]
+        eng_feasible = {nm for j, nm in enumerate(nt.names)
+                        if feasible[j] and nm in ready}
+        if eng_feasible != oracle_feasible:
+            mismatches.append(
+                (pod.name, "feasible", oracle_feasible ^ eng_feasible))
+            continue
+        for j, nm in enumerate(nt.names):
+            if nm in oracle_feasible:
+                if int(scores[j]) != oracle_scores[nm]:
+                    mismatches.append(
+                        (pod.name, f"score[{nm}]",
+                         (int(scores[j]), oracle_scores[nm])))
+        if oracle_feasible:
+            got = engine.schedule(pod)
+            best = oracle.schedule(pod, cluster)
+            if got not in best:
+                mismatches.append((pod.name, "choice", (got, best)))
+    assert not mismatches, mismatches
+
+
+def test_parity_with_volumes_and_pvcs():
+    rng = np.random.RandomState(99)
+    nodes, existing, services, controllers = _rand_cluster(rng, n_nodes=8)
+    pvs = [api.PersistentVolume(name=f"pv{i}", aws_ebs_id=f"vol-pv{i}",
+                                labels={ZONE: f"z{i % 3}"})
+           for i in range(3)]
+    pvcs = [api.PersistentVolumeClaim(name=f"claim{i}", volume_name=f"pv{i}")
+            for i in range(3)]
+    cluster = oracle.ClusterState(
+        nodes=nodes, pods=existing, services=services,
+        controllers=controllers, pvs=pvs, pvcs=pvcs)
+    engine = _build_engine(nodes, existing, services, controllers)
+    engine.listers.pvs = pvs
+    engine.listers.pvcs = pvcs
+    ready = {n.name for n in cluster.ready_nodes()}
+
+    for i in range(8):
+        pod = make_pod(
+            f"vp-{i}", cpu="100m", memory="128Mi",
+            volumes=[api.Volume(name="v",
+                                pvc_claim_name=f"claim{rng.randint(3)}")])
+        fits, _ = oracle.find_nodes_that_fit(pod, cluster)
+        oracle_feasible = {n.name for n in fits}
+        _, db, dc, nt = engine._compile([pod])
+        feasible, _ = engine.solver.evaluate(db, dc)
+        feasible = np.asarray(feasible)[0]
+        eng_feasible = {nm for j, nm in enumerate(nt.names)
+                        if feasible[j] and nm in ready}
+        assert eng_feasible == oracle_feasible, (pod.name, i)
