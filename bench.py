@@ -5,9 +5,10 @@ cluster — the TPU recast of the reference's scheduler density/perf rig
 nodes and 30k pods / 1k nodes, drained one pod at a time).
 
 Default shape is the north-star from BASELINE.json: 30,000 pending pods onto
-5,000 nodes with the default policy, solved as one sequential-greedy device
-scan with full placement visibility (every pod sees all earlier placements,
-exactly like the reference's assumed-pod cache).  Prints ONE JSON line:
+5,000 nodes with the default policy, run through the FULL daemon path —
+queue drain -> host feature compile -> one sequential-greedy device scan
+(every pod sees all earlier placements, exactly like the reference's
+assumed-pod cache) -> assume -> CAS bind.  Prints ONE JSON line:
 
     {"metric": ..., "value": pods_per_sec, "unit": "pods/s", "vs_baseline": x}
 
@@ -23,8 +24,6 @@ import os
 import sys
 import time
 
-import numpy as np
-
 
 def main() -> None:
     n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
@@ -32,53 +31,25 @@ def main() -> None:
     profile = os.environ.get("BENCH_PROFILE", "mixed")
 
     import jax
-    from kubernetes_tpu.perf import synth
+    from kubernetes_tpu.perf.harness import density
+
+    print(f"bench: {n_nodes} nodes x {n_pods} pods, profile={profile}, "
+          f"backend={jax.default_backend()}", file=sys.stderr)
 
     t0 = time.perf_counter()
-    sched, pods = synth.make_rig(n_nodes, n_pods, profile=profile,
-                                 n_zones=8, n_services=16)
-    print(f"setup: {n_nodes} nodes, {n_pods} pods, profile={profile}, "
-          f"backend={jax.default_backend()} ({time.perf_counter() - t0:.1f}s)",
-          file=sys.stderr)
-
-    # Host feature compile (counted in e2e below, measured separately here).
-    t0 = time.perf_counter()
-    batch, db, dc, nt = sched._compile(pods)
-    host_s = time.perf_counter() - t0
-    print(f"host feature compile: {host_s:.2f}s", file=sys.stderr)
-
-    # Warm-up solve (jit compile), then timed steady-state solves.
-    t0 = time.perf_counter()
-    choices, _, _ = sched.solver.solve_sequential(
-        db, dc, np.uint32(0))
-    choices.block_until_ready()
-    print(f"compile+first solve: {time.perf_counter() - t0:.1f}s",
-          file=sys.stderr)
-
-    reps = int(os.environ.get("BENCH_REPS", "3"))
-    device_s = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        choices, _, _ = sched.solver.solve_sequential(db, dc, np.uint32(0))
-        choices.block_until_ready()
-        device_s.append(time.perf_counter() - t0)
-    solve_s = min(device_s)
-    placed = int((np.asarray(choices) >= 0).sum())
-
-    e2e_s = host_s + solve_s
-    pods_per_sec = n_pods / e2e_s
-    print(f"device solve: {solve_s:.3f}s (min of {reps}); "
-          f"e2e {e2e_s:.3f}s; placed {placed}/{n_pods}; "
-          f"{pods_per_sec:,.0f} pods/s e2e, {n_pods / solve_s:,.0f} device-only",
-          file=sys.stderr)
+    result = density(n_nodes, n_pods, profile=profile)
+    print(f"total incl. setup+compile: {time.perf_counter() - t0:.1f}s; "
+          f"timed e2e {result.elapsed_s:.3f}s; "
+          f"scheduled {result.scheduled}/{n_pods}", file=sys.stderr)
 
     baseline = 8.0  # test/e2e/density.go:48 MinPodsPerSecondThroughput
     print(json.dumps({
         "metric": f"scheduler throughput, {n_pods} pods onto {n_nodes} nodes "
-                  f"(default policy, sequential-visibility batched solve)",
-        "value": round(pods_per_sec, 1),
+                  f"(default policy, full daemon: queue->batched device "
+                  f"solve->assume->bind)",
+        "value": round(result.pods_per_second, 1),
         "unit": "pods/s",
-        "vs_baseline": round(pods_per_sec / baseline, 1),
+        "vs_baseline": round(result.pods_per_second / baseline, 1),
     }))
 
 

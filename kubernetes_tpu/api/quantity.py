@@ -8,6 +8,7 @@ or milli-value (``MilliValue()``).
 
 from __future__ import annotations
 
+import functools
 from fractions import Fraction
 
 # Binary SI (1024-based) and decimal SI (1000-based) suffix tables, per
@@ -40,13 +41,17 @@ def parse_quantity(s: str | int | float) -> Fraction:
     return Fraction(s)
 
 
+@functools.lru_cache(maxsize=4096)
 def value(s: str | int | float) -> int:
-    """Quantity.Value(): ceil to integer (quantity.go rounds up)."""
+    """Quantity.Value(): ceil to integer (quantity.go rounds up).  Memoized:
+    cluster workloads reuse a handful of distinct quantity strings, and the
+    batch compiler parses them per pod."""
     f = parse_quantity(s)
     return int(-((-f.numerator) // f.denominator))  # ceil
 
 
+@functools.lru_cache(maxsize=4096)
 def milli_value(s: str | int | float) -> int:
-    """Quantity.MilliValue(): value * 1000, ceil to integer."""
+    """Quantity.MilliValue(): value * 1000, ceil to integer.  Memoized."""
     f = parse_quantity(s) * 1000
     return int(-((-f.numerator) // f.denominator))  # ceil

@@ -96,6 +96,47 @@ class SchedulerCache:
             pod=pod, assumed=True, deadline=self._now() + self.ttl)
         self._attach(pod, node_name)
 
+    def assume_pods(self, assignments: list[tuple[api.Pod, str]],
+                    strict: bool = True) -> list[str]:
+        """Bulk AssumePod for a solved batch: same state machine as
+        assume_pod, with the tensor updates vectorized (the per-pod path is
+        O(pods x numpy-call overhead) at 30k-pod batches).
+
+        With ``strict=False`` already-cached pods are skipped and their keys
+        returned (the daemon logs and proceeds, scheduler.go:116-120)."""
+        self._ensure_tensors()
+        deadline = self._now() + self.ttl
+        pods, idxs = [], []
+        skipped: list[str] = []
+        for pod, node_name in assignments:
+            key = pod.key
+            if key in self._pod_states:
+                if strict:
+                    raise ValueError(f"pod {key} already in cache")
+                skipped.append(key)
+                continue
+            pod.node_name = node_name
+            self._pod_states[key] = _PodState(pod=pod, assumed=True,
+                                              deadline=deadline)
+            self._node_pods.setdefault(node_name, {})[key] = pod
+            if pod.affinity() is not None:
+                self._affinity_pods[key] = pod
+            if pod.volumes:
+                self._volume_pods[key] = pod
+            idx = self._nt.name_to_idx.get(node_name)
+            if idx is None:
+                self._mark_nodes_dirty()
+            else:
+                pods.append(pod)
+                idxs.append(idx)
+        if not self._dirty_nodes and pods:
+            self._agg = fc.add_pods_to_aggregates_bulk(
+                self._agg, idxs, pods, self.space)
+            self._ep = fc.existing_pods_add_bulk(
+                self._ep, pods, idxs, self.space)
+        self.generation += len(assignments)
+        return skipped
+
     def forget_pod(self, pod: api.Pod) -> None:
         """ForgetPod (cache.go:135-158): only assumed pods may be forgotten."""
         key = pod.key

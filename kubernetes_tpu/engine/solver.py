@@ -133,7 +133,8 @@ class DeviceBatch(NamedTuple):
     spread_has_zones: jnp.ndarray
     spread_incr: jnp.ndarray
     node_zone_id: jnp.ndarray
-    avoid_mask: jnp.ndarray
+    avoid_group: jnp.ndarray
+    avoid_rows: jnp.ndarray
     aff: DeviceAffinity
     volsvc: DeviceVolSvc
 
@@ -257,7 +258,7 @@ def _priority_plane(name: str, b: DeviceBatch, c: DeviceCluster,
     if name == "ImageLocalityPriority":
         return prio.image_locality(b.images, c.image_kib)
     if name == "NodePreferAvoidPodsPriority":
-        return prio.node_prefer_avoid(b.avoid_mask)
+        return prio.node_prefer_avoid(b.avoid_group, b.avoid_rows)
     if name in ("SelectorSpreadPriority", "ServiceSpreadingPriority"):
         return prio.selector_spread(b.spread_group, b.spread_node_counts,
                                     b.spread_zone_counts, b.spread_has_zones,

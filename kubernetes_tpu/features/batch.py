@@ -54,7 +54,8 @@ class PodBatch:
     spread_has_zones: np.ndarray    # [S] bool — haveZones for the group
     spread_incr: np.ndarray    # [P, S] bool — placing pod i increments group s
     node_zone_id: np.ndarray   # [N] int32 — compact zone id, -1 = no zone
-    avoid_mask: np.ndarray     # [P, N] bool — NodePreferAvoidPods hit
+    avoid_group: np.ndarray    # [P] int32 — controller-signature group
+    avoid_rows: np.ndarray     # [G, N] bool — NodePreferAvoidPods hit
     aff: AffinityTensors       # inter-pod (anti-)affinity sig tables
     volsvc: VolSvcTensors      # volume counts/zones + service (anti-)affinity
 
@@ -254,7 +255,9 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
     tol_pref = np.zeros((p, space.taints.capacity), bool)
     has_tols = np.zeros(p, bool)
     images = np.zeros((p, space.images.capacity), np.int32)
-    avoid_mask = np.zeros((p, n), bool)
+    avoid_group = np.zeros(p, np.int32)
+    avoid_rows_map: dict = {(): 0}
+    avoid_rows: list[np.ndarray] = [np.zeros(n, bool)]
 
     # Parse the taint vocabulary once; every pod's tolerations are matched
     # against it host-side, turning device-side toleration checks into a
@@ -287,6 +290,10 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
     sel_rows: list[np.ndarray] = []
     pref_rows: list[np.ndarray] = []
     sel_group = np.zeros(p, np.int32)
+    # Lister lookups memoized by (namespace, labels): controller-stamped
+    # pods share both, and the listers answer from labels alone.
+    _sel_memo: dict = {}
+    _ref_memo: dict = {}
 
     node_zone_id = _node_zone_ids(nt, space)
     num_zones = int(node_zone_id.max()) + 1 if (node_zone_id >= 0).any() else 0
@@ -325,13 +332,22 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
                 images[i, space.images.id(c.image)] += 1
 
         # NodePreferAvoidPods: mark nodes whose annotation lists one of the
-        # pod's controllers (priorities.go:326-398).
+        # pod's controllers (priorities.go:326-398), deduped by controller
+        # signature so the [P, N] plane is a gather of few [N] rows.
         if controller_refs is not None and nodes is not None:
-            refs = controller_refs(pod)
-            if refs:
+            lkey = (pod.namespace, tuple(sorted(pod.labels.items())))
+            refs = _ref_memo.get(lkey)
+            if refs is None:
+                refs = _ref_memo[lkey] = tuple(controller_refs(pod))
+            g = avoid_rows_map.get(refs)
+            if g is None:
+                row = np.zeros(n, bool)
                 for ni, avoids in enumerate(node_avoids):
                     if any(r in avoids for r in refs):
-                        avoid_mask[i, ni] = True
+                        row[ni] = True
+                g = avoid_rows_map[refs] = len(avoid_rows)
+                avoid_rows.append(row)
+            avoid_group[i] = g
 
         # Selector group (nodeSelector + node affinity).
         aff = pod.affinity()
@@ -347,7 +363,10 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
 
         # Spread group (services/RCs/RSs selecting this pod), if listers given.
         if spread_selectors is not None and ep is not None:
-            sels = spread_selectors(pod)
+            lkey = (pod.namespace, tuple(sorted(pod.labels.items())))
+            sels = _sel_memo.get(lkey)
+            if sels is None:
+                sels = _sel_memo[lkey] = spread_selectors(pod)
             ssig = (pod.namespace, tuple(sorted(repr(s) for s in sels)))
             sg = spread_sig_to_group.get(ssig)
             if sg is None:
@@ -378,14 +397,21 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
     # pod cache, cache.go:107).
     spread_incr = np.zeros((p, S), bool)
     if spread_groups_meta:
+        incr_memo: dict = {}
         for i, pod in enumerate(pods):
             if pod.deletion_timestamp is not None:
                 continue
-            for s, (ns, sels) in enumerate(spread_groups_meta):
-                if ns == pod.namespace and any(
-                        _selector_matches_pod_labels(sel, pod.labels)
-                        for sel in sels):
-                    spread_incr[i, s] = True
+            lkey = (pod.namespace, tuple(sorted(pod.labels.items())))
+            row = incr_memo.get(lkey)
+            if row is None:
+                row = np.zeros(S, bool)
+                for s, (ns, sels) in enumerate(spread_groups_meta):
+                    if ns == pod.namespace and any(
+                            _selector_matches_pod_labels(sel, pod.labels)
+                            for sel in sels):
+                        row[s] = True
+                incr_memo[lkey] = row
+            spread_incr[i] = row
 
     aff = compile_affinity(pods, affinity_pods, ep, nodes, n, space,
                            hard_pod_affinity_weight)
@@ -404,8 +430,8 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
         sel_pref_counts=sel_pref, spread_group=spread_group,
         spread_node_counts=sp_n, spread_zone_counts=sp_z,
         spread_has_zones=sp_hz, spread_incr=spread_incr,
-        node_zone_id=node_zone_id, avoid_mask=avoid_mask, aff=aff,
-        volsvc=volsvc)
+        node_zone_id=node_zone_id, avoid_group=avoid_group,
+        avoid_rows=np.stack(avoid_rows), aff=aff, volsvc=volsvc)
 
 
 def _spread_counts(namespace: str, selectors: list,

@@ -133,6 +133,7 @@ class ExistingPodTensors:
     deleted: np.ndarray        # [M] bool (DeletionTimestamp set)
     keys: list[Optional[str]]  # slot -> pod key
     key_to_slot: dict[str, int]
+    free_slots: list[int]      # O(1) slot allocation (popped LIFO)
 
 
 def compile_nodes(nodes: Sequence[api.Node], space: FeatureSpace) -> NodeTensors:
@@ -250,6 +251,40 @@ def add_pod_to_aggregates(agg: NodeAggregates, node_idx: int, pod: api.Pod,
     return agg
 
 
+def add_pods_to_aggregates_bulk(agg: NodeAggregates,
+                                node_idxs: Sequence[int],
+                                pods: Sequence[api.Pod],
+                                space: FeatureSpace) -> NodeAggregates:
+    """Bulk NodeInfo.addPod for a solved batch: one vectorized update instead
+    of per-pod row ops.  Equivalent to repeated add_pod_to_aggregates
+    (tested by tests/test_cache_bulk.py)."""
+    # Intern first so column growth happens once.
+    for pod in pods:
+        for port in pod.used_host_ports():
+            space.ports.id(str(port))
+        for v in pod.volumes:
+            for token, _ in FeatureSpace.volume_tokens(v):
+                space.volumes.id(token)
+    agg = _grow_aggregate_columns(agg, space)
+    idxs = np.asarray(node_idxs, np.int64)
+    req = np.stack([pod_resource_row(p) for p in pods])
+    nz = np.stack([pod_nonzero_row(p) for p in pods])
+    np.add.at(agg.requested, idxs, req)
+    np.add.at(agg.nonzero, idxs, nz)
+    for idx, pod in zip(node_idxs, pods):
+        if pod.used_host_ports():
+            for pid in _pod_port_ids(pod, space):
+                agg.ports_used[idx, pid] = True
+        if pod.volumes:
+            for vid, ro in _pod_volume_ids(pod, space):
+                agg.vol_any_count[idx, vid] += 1
+                if not ro:
+                    agg.vol_rw_count[idx, vid] += 1
+                agg.vol_any[idx, vid] = agg.vol_any_count[idx, vid] > 0
+                agg.vol_rw[idx, vid] = agg.vol_rw_count[idx, vid] > 0
+    return agg
+
+
 def remove_pod_from_aggregates(agg: NodeAggregates, node_idx: int, pod: api.Pod,
                                space: FeatureSpace,
                                node_pods: Sequence[api.Pod]) -> NodeAggregates:
@@ -301,7 +336,8 @@ def empty_existing_pods(space: FeatureSpace, cap: int = 256) -> ExistingPodTenso
         alive=np.zeros(cap, bool),
         deleted=np.zeros(cap, bool),
         keys=[None] * cap,
-        key_to_slot={})
+        key_to_slot={},
+        free_slots=list(range(cap - 1, -1, -1)))
 
 
 def existing_pods_add(ep: ExistingPodTensors, pod: api.Pod, node_idx: int,
@@ -312,8 +348,7 @@ def existing_pods_add(ep: ExistingPodTensors, pod: api.Pod, node_idx: int,
     ep.labels = _grow_cols(ep.labels, space.labels.capacity)
     slot = ep.key_to_slot.get(pod.key)
     if slot is None:
-        free = np.nonzero(~ep.alive)[0]
-        if len(free) == 0:
+        if not ep.free_slots:
             m = len(ep.keys)
             ep.labels = np.concatenate([ep.labels, np.zeros_like(ep.labels)], 0)
             ep.ns_id = np.concatenate([ep.ns_id, np.zeros(m, np.int32)])
@@ -321,9 +356,8 @@ def existing_pods_add(ep: ExistingPodTensors, pod: api.Pod, node_idx: int,
             ep.alive = np.concatenate([ep.alive, np.zeros(m, bool)])
             ep.deleted = np.concatenate([ep.deleted, np.zeros(m, bool)])
             ep.keys += [None] * m
-            slot = m
-        else:
-            slot = int(free[0])
+            ep.free_slots.extend(range(2 * m - 1, m - 1, -1))
+        slot = ep.free_slots.pop()
         ep.key_to_slot[pod.key] = slot
         ep.keys[slot] = pod.key
     ep.labels[slot] = False
@@ -337,10 +371,55 @@ def existing_pods_add(ep: ExistingPodTensors, pod: api.Pod, node_idx: int,
     return ep
 
 
+def existing_pods_add_bulk(ep: ExistingPodTensors, pods: Sequence[api.Pod],
+                           node_idxs: Sequence[int],
+                           space: FeatureSpace) -> ExistingPodTensors:
+    """Bulk existing_pods_add: one growth pass + vectorized row writes."""
+    for pod in pods:
+        for k, v in pod.labels.items():
+            space.labels.kv_id(k, v)
+            space.labels.key_id(k)
+    ep.labels = _grow_cols(ep.labels, space.labels.capacity)
+    need = sum(1 for p in pods if p.key not in ep.key_to_slot)
+    while len(ep.free_slots) < need:
+        m = len(ep.keys)
+        ep.labels = np.concatenate([ep.labels, np.zeros_like(ep.labels)], 0)
+        ep.ns_id = np.concatenate([ep.ns_id, np.zeros(m, np.int32)])
+        ep.node_idx = np.concatenate([ep.node_idx, np.full(m, -1, np.int32)])
+        ep.alive = np.concatenate([ep.alive, np.zeros(m, bool)])
+        ep.deleted = np.concatenate([ep.deleted, np.zeros(m, bool)])
+        ep.keys += [None] * m
+        ep.free_slots.extend(range(2 * m - 1, m - 1, -1))
+    slots = np.empty(len(pods), np.int64)
+    for i, pod in enumerate(pods):
+        slot = ep.key_to_slot.get(pod.key)
+        if slot is None:
+            slot = ep.free_slots.pop()
+            ep.key_to_slot[pod.key] = slot
+            ep.keys[slot] = pod.key
+        slots[i] = slot
+    ep.labels[slots] = False
+    rows, cols = [], []
+    for i, pod in enumerate(pods):
+        for k, v in pod.labels.items():
+            rows.append(slots[i])
+            cols.append(space.labels.kv_id(k, v))
+            rows.append(slots[i])
+            cols.append(space.labels.key_id(k))
+    if rows:
+        ep.labels[rows, cols] = True
+    ep.ns_id[slots] = [space.namespaces.id(p.namespace) for p in pods]
+    ep.node_idx[slots] = np.asarray(node_idxs, np.int64)
+    ep.alive[slots] = True
+    ep.deleted[slots] = [p.deletion_timestamp is not None for p in pods]
+    return ep
+
+
 def existing_pods_remove(ep: ExistingPodTensors, pod_key: str) -> ExistingPodTensors:
     slot = ep.key_to_slot.pop(pod_key, None)
     if slot is not None:
         ep.alive[slot] = False
         ep.node_idx[slot] = -1
         ep.keys[slot] = None
+        ep.free_slots.append(slot)
     return ep

@@ -182,11 +182,13 @@ def node_label(n_pods: int, node_row: jnp.ndarray) -> jnp.ndarray:
                             (n_pods, node_row.shape[0]))
 
 
-def node_prefer_avoid(avoid_mask: jnp.ndarray) -> jnp.ndarray:
+def node_prefer_avoid(avoid_group: jnp.ndarray,
+                      avoid_rows: jnp.ndarray) -> jnp.ndarray:
     """CalculateNodePreferAvoidPodsPriority (priorities.go:326-398): 0 where
-    the node's preferAvoidPods annotation names the pod's controller, else 10.
-    ``avoid_mask`` [P,N] is compiled host-side from annotations + listers."""
-    return jnp.where(avoid_mask, 0.0, 10.0)
+    the node's preferAvoidPods annotation names the pod's controller, else
+    10.  Rows [G,N] are compiled host-side per controller signature and
+    gathered per pod."""
+    return jnp.where(avoid_rows[avoid_group], 0.0, 10.0)
 
 
 def equal_priority(n_pods: int, n_nodes: int) -> jnp.ndarray:

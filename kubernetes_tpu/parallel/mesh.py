@@ -47,10 +47,10 @@ _CLUSTER_NODE_FIELDS = set(DeviceCluster._fields)
 _BATCH_POD_FIELDS = {"request", "zero_request", "nonzero", "best_effort",
                      "host_idx", "ports", "vol_ro", "vol_rw", "tol_nosched",
                      "tol_prefer", "has_tolerations", "images", "sel_group",
-                     "spread_group", "spread_incr", "avoid_mask"}
+                     "spread_group", "spread_incr", "avoid_group"}
 # Group tables etc. whose last/only meaningful axis is nodes.
 _BATCH_NODE_LAST_FIELDS = {"sel_required", "sel_pref_counts",
-                           "spread_node_counts"}
+                           "spread_node_counts", "avoid_rows"}
 _BATCH_REPLICATED_FIELDS = {"spread_zone_counts", "spread_has_zones"}
 _BATCH_NODE_VEC_FIELDS = {"node_zone_id"}
 
@@ -128,8 +128,6 @@ def shard_batch(b: DeviceBatch, mesh: Mesh,
             continue
         if name in _BATCH_NODE_LAST_FIELDS:
             spec = P(None, NODE_AXIS)
-        elif name == "avoid_mask":
-            spec = P(BATCH_AXIS if shard_pods else None, NODE_AXIS)
         elif name in _BATCH_NODE_VEC_FIELDS:
             spec = P(NODE_AXIS)
         elif name in _BATCH_REPLICATED_FIELDS:

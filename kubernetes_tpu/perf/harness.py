@@ -1,0 +1,115 @@
+"""Scheduler performance harness — the ``test/component/scheduler/perf``
+rig rebuilt around the TPU engine.
+
+The reference drives a real scheduler against an in-process apiserver with
+fabricated nodes and pause pods, printing pods-scheduled-per-second until
+the queue drains (scheduler_test.go:26-60), plus a ``BenchmarkScheduling``
+matrix over {100, 1000} nodes x {0, 1000} preexisting pods
+(scheduler_bench_test.go:24-46).  Here the full daemon (queue -> batched
+device solve -> assume -> CAS bind) runs against the in-memory binder; both
+density shapes and the benchmark matrix are callable and runnable as
+``python -m kubernetes_tpu.perf.harness``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from dataclasses import dataclass
+
+from kubernetes_tpu.perf import synth
+from kubernetes_tpu.scheduler.binder import InMemoryBinder
+from kubernetes_tpu.scheduler.scheduler import Scheduler, SchedulerConfig
+
+
+@dataclass
+class DensityResult:
+    num_nodes: int
+    num_pods: int
+    elapsed_s: float
+    scheduled: int
+    pods_per_second: float
+    algorithm_ms_per_pod: float
+
+
+def _make_daemon(num_nodes: int, profile: str = "uniform",
+                 preexisting: int = 0) -> Scheduler:
+    sched, _ = synth.make_rig(num_nodes, 0, profile=profile)
+    pre = synth.make_pods(preexisting, profile=profile, name_prefix="pre")
+    for pod, dest in zip(pre, sched.schedule_batch(pre)):
+        if dest is not None:
+            pod.node_name = dest
+            sched.cache.add_pod(pod)
+    return Scheduler(SchedulerConfig(algorithm=sched, binder=InMemoryBinder(),
+                                     async_bind=False))
+
+
+def density(num_nodes: int, num_pods: int, profile: str = "uniform",
+            preexisting: int = 0, warm: bool = True,
+            quiet: bool = False) -> DensityResult:
+    """Density test (scheduler_test.go:26-60): N pods onto M nodes, full
+    daemon path, wall-clock throughput."""
+    daemon = _make_daemon(num_nodes, profile, preexisting)
+    pods = synth.make_pods(num_pods, profile=profile)
+    if warm:
+        # Pre-trace the device program at the batch shape (first XLA compile
+        # is excluded like the reference excludes apiserver warmup).
+        daemon.config.algorithm.schedule_batch(pods[:num_pods])
+    for pod in pods:
+        daemon.enqueue(pod)
+    start = time.perf_counter()
+    popped = daemon.schedule_pending(wait_first=False)
+    daemon.wait_for_binds()
+    elapsed = time.perf_counter() - start
+    scheduled = daemon.config.binder.count()
+    if not quiet:
+        print(f"density {num_nodes} nodes x {num_pods} pods: "
+              f"{scheduled} scheduled in {elapsed:.3f}s = "
+              f"{scheduled / elapsed:,.0f} pods/s", file=sys.stderr)
+    assert popped == num_pods
+    return DensityResult(
+        num_nodes=num_nodes, num_pods=num_pods, elapsed_s=elapsed,
+        scheduled=scheduled, pods_per_second=scheduled / elapsed,
+        algorithm_ms_per_pod=elapsed / max(scheduled, 1) * 1e3)
+
+
+BENCH_MATRIX = ((100, 0), (100, 1000), (1000, 0), (1000, 1000))
+
+
+def benchmark_scheduling(num_pods: int = 1000,
+                         matrix=BENCH_MATRIX) -> list[DensityResult]:
+    """BenchmarkScheduling (scheduler_bench_test.go:24-46): ns/op over the
+    {nodes} x {preexisting} matrix."""
+    results = []
+    for num_nodes, preexisting in matrix:
+        r = density(num_nodes, num_pods, preexisting=preexisting)
+        print(f"BenchmarkScheduling/{num_nodes}-nodes/"
+              f"{preexisting}-pods: {r.elapsed_s / num_pods * 1e9:,.0f} "
+              f"ns/op", file=sys.stderr)
+        results.append(r)
+    return results
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=1000)
+    ap.add_argument("--pods", type=int, default=30000)
+    ap.add_argument("--profile", default="uniform",
+                    choices=["uniform", "mixed"])
+    ap.add_argument("--preexisting", type=int, default=0)
+    ap.add_argument("--bench-matrix", action="store_true",
+                    help="run the BenchmarkScheduling matrix instead")
+    opts = ap.parse_args()
+    if opts.bench_matrix:
+        results = benchmark_scheduling()
+        print(json.dumps([r.__dict__ for r in results]))
+    else:
+        r = density(opts.nodes, opts.pods, profile=opts.profile,
+                    preexisting=opts.preexisting)
+        print(json.dumps(r.__dict__))
+
+
+if __name__ == "__main__":
+    main()

@@ -53,13 +53,13 @@ def make_nodes(n: int, seed: int = 0, profile: str = "uniform",
     return out
 
 
-def _pause_pod(i: int, namespace: str = "default",
+def _pause_pod(i, namespace: str = "default",
                labels: dict | None = None,
                milli_cpu: int = 100, memory: int = 500 * 1024 ** 2,
                **kw) -> api.Pod:
     """The perf rig's pause pod (util.go:113-130): 100m / 500Mi requests."""
     return api.Pod(
-        name=f"pod-{i}", namespace=namespace, labels=labels or {},
+        name=str(i), namespace=namespace, labels=labels or {},
         containers=[api.Container(
             name="pause", image="kubernetes/pause:go",
             requests={"cpu": f"{milli_cpu}m", "memory": str(memory)},
@@ -68,7 +68,8 @@ def _pause_pod(i: int, namespace: str = "default",
 
 
 def make_pods(n: int, seed: int = 1, profile: str = "uniform",
-              n_services: int = 0, namespace: str = "default") -> list[api.Pod]:
+              n_services: int = 0, namespace: str = "default",
+              name_prefix: str = "pod") -> list[api.Pod]:
     """N pending pods.  ``uniform`` = identical pause pods; ``mixed`` adds
     service-labeled spreading groups, node selectors, and affinity
     annotations in kubemark-like proportions."""
@@ -76,7 +77,7 @@ def make_pods(n: int, seed: int = 1, profile: str = "uniform",
     out = []
     for i in range(n):
         if profile == "uniform":
-            out.append(_pause_pod(i, namespace))
+            out.append(_pause_pod(f"{name_prefix}-{i}", namespace))
             continue
         r = rng.rand()
         labels: dict[str, str] = {}
@@ -97,7 +98,7 @@ def make_pods(n: int, seed: int = 1, profile: str = "uniform",
                             "key": api.ZONE_LABEL, "operator": "In",
                             "values": [f"zone-{int(rng.randint(4))}"]}]},
                     }]}})
-        out.append(_pause_pod(i, namespace, labels=labels, milli_cpu=cpu,
+        out.append(_pause_pod(f"{name_prefix}-{i}", namespace, labels=labels, milli_cpu=cpu,
                               memory=mem, node_selector=node_selector,
                               annotations=annotations))
     return out

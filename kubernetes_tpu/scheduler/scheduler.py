@@ -93,13 +93,29 @@ class Scheduler:
         algo_us = (time.perf_counter() - start) * 1e6 / len(pods)
         for _ in pods:
             self.config.metrics.scheduling_algorithm_latency.observe(algo_us)
+        placed = [(pod, dest) for pod, dest in zip(pods, placements)
+                  if dest is not None]
+        # Bulk assume (vectorized), then bind; failures forget + requeue.
+        # Already-cached pods are skipped, matching the single-pod loop's
+        # log-and-proceed on assume errors (scheduler.go:116-120).
+        skipped = set(self.config.algorithm.cache.assume_pods(
+            placed, strict=False))
+        placed = [(pod, dest) for pod, dest in placed
+                  if pod.key not in skipped]
         for pod, dest in zip(pods, placements):
             if dest is None:
                 self._handle_failure(
                     pod, "FailedScheduling",
                     f"pod ({pod.name}) failed to fit in any node")
-            else:
-                self._assume_and_bind(pod, dest, start)
+        def bind_all():
+            for pod, dest in placed:
+                self._bind_assumed(pod, dest, start)
+        if self.config.async_bind:
+            t = threading.Thread(target=bind_all, daemon=True)
+            t.start()
+            self._bind_threads.append(t)
+        else:
+            bind_all()
         return len(pods)
 
     # -- run loops --------------------------------------------------------
@@ -142,23 +158,7 @@ class Scheduler:
             assumed = False
 
         def bind():
-            bind_start = time.perf_counter()
-            try:
-                self.config.binder.bind(pod, dest)
-            except Exception as err:  # noqa: BLE001 — bind errors requeue
-                # ForgetPod + error handler (scheduler.go:139-148).
-                if assumed:
-                    cache.forget_pod(pod)
-                self._handle_failure(pod, "FailedScheduling",
-                                     f"Binding rejected: {err}")
-                return
-            us = (time.perf_counter() - bind_start) * 1e6
-            self.config.metrics.binding_latency.observe(us)
-            self.config.metrics.e2e_scheduling_latency.observe(
-                (time.perf_counter() - start) * 1e6)
-            self.config.recorder.eventf(
-                pod.key, "Normal", "Scheduled",
-                f"Successfully assigned {pod.name} to {dest}")
+            self._bind_assumed(pod, dest, start, assumed=assumed)
 
         if self.config.async_bind:
             t = threading.Thread(target=bind, daemon=True)
@@ -166,6 +166,27 @@ class Scheduler:
             self._bind_threads.append(t)
         else:
             bind()
+
+    def _bind_assumed(self, pod: api.Pod, dest: str, start: float,
+                      assumed: bool = True) -> None:
+        cache = self.config.algorithm.cache
+        bind_start = time.perf_counter()
+        try:
+            self.config.binder.bind(pod, dest)
+        except Exception as err:  # noqa: BLE001 — bind errors requeue
+            # ForgetPod + error handler (scheduler.go:139-148).
+            if assumed:
+                cache.forget_pod(pod)
+            self._handle_failure(pod, "FailedScheduling",
+                                 f"Binding rejected: {err}")
+            return
+        us = (time.perf_counter() - bind_start) * 1e6
+        self.config.metrics.binding_latency.observe(us)
+        self.config.metrics.e2e_scheduling_latency.observe(
+            (time.perf_counter() - start) * 1e6)
+        self.config.recorder.eventf(
+            pod.key, "Normal", "Scheduled",
+            f"Successfully assigned {pod.name} to {dest}")
 
     def _handle_failure(self, pod: api.Pod, reason: str, message: str) -> None:
         """Event + condition update + backoff requeue (factory.go:512-556)."""

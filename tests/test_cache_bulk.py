@@ -1,0 +1,80 @@
+"""Bulk assume equivalence: cache.assume_pods must leave the cache in the
+exact state repeated assume_pod would."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.cache.scheduler_cache import SchedulerCache
+
+from helpers import make_node, make_pod
+
+
+def _rand_pods(rng, n):
+    pods = []
+    for i in range(n):
+        kwargs: dict = {"cpu": f"{int(rng.choice([50, 100, 250]))}m",
+                        "memory": f"{int(rng.choice([64, 128]))}Mi"}
+        if rng.rand() < 0.5:
+            kwargs["labels"] = {"app": f"a{rng.randint(3)}",
+                                "tier": f"t{rng.randint(2)}"}
+        if rng.rand() < 0.2:
+            kwargs["host_ports"] = [int(8000 + rng.randint(4))]
+        if rng.rand() < 0.2:
+            kwargs["volumes"] = [api.Volume(name="v",
+                                            aws_ebs_id=f"vol{rng.randint(3)}")]
+        pods.append(make_pod(f"bulk-{i}", **kwargs))
+    return pods
+
+
+def test_bulk_assume_equals_sequential():
+    rng = np.random.RandomState(7)
+    nodes = [make_node(f"n{i}") for i in range(5)]
+    pods = _rand_pods(rng, 40)
+    dests = [f"n{rng.randint(5)}" for _ in pods]
+
+    seq = SchedulerCache()
+    bulk = SchedulerCache()
+    for nd in nodes:
+        seq.add_node(nd)
+        bulk.add_node(nd)
+    seq.snapshot()
+    bulk.snapshot()
+
+    import copy
+    for pod, dest in zip(pods, dests):
+        seq.assume_pod(copy.deepcopy(pod), dest)
+    bulk.assume_pods([(copy.deepcopy(p), d) for p, d in zip(pods, dests)])
+
+    nt_s, agg_s, ep_s, _ = seq.snapshot()
+    nt_b, agg_b, ep_b, _ = bulk.snapshot()
+    np.testing.assert_array_equal(agg_s.requested, agg_b.requested)
+    np.testing.assert_array_equal(agg_s.nonzero, agg_b.nonzero)
+    np.testing.assert_array_equal(agg_s.ports_used, agg_b.ports_used)
+    np.testing.assert_array_equal(agg_s.vol_any, agg_b.vol_any)
+    np.testing.assert_array_equal(agg_s.vol_rw, agg_b.vol_rw)
+    # Existing-pod tensors: compare per-key rows (slot order may differ).
+    assert set(ep_s.key_to_slot) == set(ep_b.key_to_slot)
+    for key, slot_s in ep_s.key_to_slot.items():
+        slot_b = ep_b.key_to_slot[key]
+        v = min(ep_s.labels.shape[1], ep_b.labels.shape[1])
+        np.testing.assert_array_equal(ep_s.labels[slot_s][:v],
+                                      ep_b.labels[slot_b][:v])
+        assert ep_s.ns_id[slot_s] == ep_b.ns_id[slot_b]
+        assert ep_s.node_idx[slot_s] == ep_b.node_idx[slot_b]
+    assert seq.pod_count() == bulk.pod_count()
+
+
+def test_bulk_assume_then_forget():
+    cache = SchedulerCache()
+    cache.add_node(make_node("n0"))
+    pods = [make_pod(f"fp-{i}", cpu="100m") for i in range(5)]
+    cache.assume_pods([(p, "n0") for p in pods])
+    assert cache.pod_count() == 5
+    for p in pods:
+        assert cache.is_assumed(p.key)
+        cache.forget_pod(p)
+    assert cache.pod_count() == 0
+    _, agg, _, _ = cache.snapshot()
+    assert (agg.requested == 0).all()

@@ -1,0 +1,25 @@
+"""Smoke tests for the perf harness (small shapes; the real shapes run via
+python -m kubernetes_tpu.perf.harness / bench.py on hardware)."""
+
+from __future__ import annotations
+
+from kubernetes_tpu.perf.harness import density
+
+
+def test_density_uniform_small():
+    r = density(20, 100, quiet=True)
+    # 20 nodes x 110 pods capacity >> 100 pods: everything schedules.
+    assert r.scheduled == 100
+    assert r.pods_per_second > 0
+
+
+def test_density_mixed_with_preexisting():
+    r = density(16, 60, profile="mixed", preexisting=30, quiet=True)
+    assert r.scheduled == 60
+
+
+def test_density_capacity_limit():
+    # 2 nodes x 5-pod... default pods cap is 110; rely on CPU: uniform pods
+    # request 100m, node 4000m -> 40 per node -> 2 nodes hold 80.
+    r = density(2, 100, quiet=True)
+    assert r.scheduled == 80
