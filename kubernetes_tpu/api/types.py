@@ -525,6 +525,74 @@ def _parse_volume(v: dict) -> Volume:
     return out
 
 
+def pod_to_json(pod: Pod) -> dict:
+    """Encode a Pod as v1 JSON (ExtenderArgs.Pod wire shape)."""
+    containers = []
+    for c in pod.containers:
+        entry: dict = {"name": c.name}
+        if c.image:
+            entry["image"] = c.image
+        res: dict = {}
+        if c.requests:
+            res["requests"] = {k: str(v) for k, v in c.requests.items()}
+        if c.limits:
+            res["limits"] = {k: str(v) for k, v in c.limits.items()}
+        if res:
+            entry["resources"] = res
+        if c.ports:
+            entry["ports"] = [
+                {"hostPort": p.host_port, "containerPort": p.container_port,
+                 "protocol": p.protocol} for p in c.ports]
+        containers.append(entry)
+    volumes = []
+    for v in pod.volumes:
+        if v.gce_pd_name:
+            volumes.append({"name": v.name, "gcePersistentDisk": {
+                "pdName": v.gce_pd_name, "readOnly": v.gce_read_only}})
+        elif v.aws_ebs_id:
+            volumes.append({"name": v.name, "awsElasticBlockStore": {
+                "volumeID": v.aws_ebs_id, "readOnly": v.aws_read_only}})
+        elif v.pvc_claim_name:
+            volumes.append({"name": v.name, "persistentVolumeClaim": {
+                "claimName": v.pvc_claim_name}})
+        else:
+            volumes.append({"name": v.name})
+    spec: dict = {"containers": containers}
+    if pod.node_name:
+        spec["nodeName"] = pod.node_name
+    if pod.node_selector:
+        spec["nodeSelector"] = dict(pod.node_selector)
+    if volumes:
+        spec["volumes"] = volumes
+    return {
+        "metadata": {"name": pod.name, "namespace": pod.namespace,
+                     "uid": pod.uid, "labels": dict(pod.labels),
+                     "annotations": dict(pod.annotations)},
+        "spec": spec,
+    }
+
+
+def node_to_json(node: Node) -> dict:
+    """Encode a Node as v1 JSON (ExtenderArgs.Nodes items)."""
+    return {
+        "metadata": {"name": node.name, "labels": dict(node.labels),
+                     "annotations": dict(node.annotations)},
+        "spec": {"unschedulable": node.unschedulable},
+        "status": {
+            "allocatable": {
+                "cpu": f"{node.allocatable_milli_cpu}m",
+                "memory": str(node.allocatable_memory),
+                "pods": str(node.allocatable_pods),
+                "alpha.kubernetes.io/nvidia-gpu": str(node.allocatable_gpu),
+            },
+            "conditions": [{"type": c.type, "status": c.status}
+                           for c in node.conditions],
+            "images": [{"names": list(i.names), "sizeBytes": i.size_bytes}
+                       for i in node.images],
+        },
+    }
+
+
 def pod_from_json(d: dict) -> Pod:
     """Decode a v1 api.Pod JSON object (as sent in ExtenderArgs.Pod)."""
     meta = d.get("metadata") or {}

@@ -1,0 +1,77 @@
+"""HTTP scheduler-extender client (the reference's HTTPExtender,
+extender.go:39-187): this engine can also *call out* to extenders configured
+in the policy, exactly as the stock scheduler does — filter after built-in
+predicates, prioritize added at the configured weight.
+
+Timeout semantics (api/types.go:128-130): a filter timeout fails the pod's
+scheduling; a prioritize timeout is ignored (zero scores)."""
+
+from __future__ import annotations
+
+import json
+import socket
+import urllib.error
+import urllib.request
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.policy import ExtenderConfig
+
+
+class ExtenderError(Exception):
+    pass
+
+
+class HTTPExtender:
+    def __init__(self, config: ExtenderConfig):
+        self.config = config
+
+    def _send(self, verb: str, args: dict):
+        url = (f"{self.config.url_prefix.rstrip('/')}/"
+               f"{self.config.api_version}/{verb}")
+        req = urllib.request.Request(
+            url, data=json.dumps(args).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(
+                req, timeout=self.config.http_timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def _args(self, pod: api.Pod, nodes: list[api.Node]) -> dict:
+        return {"pod": api.pod_to_json(pod),
+                "nodes": {"items": [api.node_to_json(n) for n in nodes]}}
+
+    def filter(self, pod: api.Pod, nodes: list[api.Node]
+               ) -> tuple[list[api.Node], dict[str, str]]:
+        """Subset + FailedNodesMap; raises ExtenderError on error/timeout
+        (extender.go:97-125)."""
+        if not self.config.filter_verb:
+            return nodes, {}
+        try:
+            result = self._send(self.config.filter_verb,
+                                self._args(pod, nodes))
+        except (urllib.error.URLError, socket.timeout, OSError,
+                ValueError) as err:
+            raise ExtenderError(f"extender filter failed: {err}") from err
+        if result.get("error"):
+            raise ExtenderError(result["error"])
+        keep_names = {(n.get("metadata") or {}).get("name", "")
+                      for n in (result.get("nodes") or {}).get("items") or []}
+        kept = [n for n in nodes if n.name in keep_names]
+        return kept, dict(result.get("failedNodes") or {})
+
+    def prioritize(self, pod: api.Pod, nodes: list[api.Node]
+                   ) -> dict[str, float]:
+        """Weighted score per host; errors/timeouts yield zeros
+        (generic_scheduler.go:287-305 ignores prioritize failures)."""
+        if not self.config.prioritize_verb:
+            return {}
+        try:
+            result = self._send(self.config.prioritize_verb,
+                                self._args(pod, nodes))
+        except (urllib.error.URLError, socket.timeout, OSError, ValueError):
+            return {}
+        out: dict[str, float] = {}
+        for entry in result or []:
+            host = entry.get("host", "")
+            if host:
+                out[host] = float(entry.get("score", 0)) * self.config.weight
+        return out

@@ -22,6 +22,7 @@ from kubernetes_tpu.api.policy import (Policy, default_provider,
                                        service_anti_affinity_labels)
 from kubernetes_tpu.cache.scheduler_cache import SchedulerCache
 from kubernetes_tpu.engine import solver as sv
+from kubernetes_tpu.engine.extender_client import ExtenderError, HTTPExtender
 from kubernetes_tpu.features import batch as fb
 from kubernetes_tpu.features.volumes import compile_volsvc
 from kubernetes_tpu.utils.trace import Trace
@@ -116,6 +117,7 @@ class GenericScheduler:
         self.cache = cache or SchedulerCache()
         self.listers = listers or Listers()
         self.solver = sv.Solver(self.policy)
+        self.extenders = [HTTPExtender(cfg) for cfg in self.policy.extenders]
         self.last_node_index = np.uint32(0)
 
     # -- compilation helpers --------------------------------------------
@@ -163,11 +165,44 @@ class GenericScheduler:
                     failed[name] = [p for p, m in masks.items() if not m[i]]
             trace.log_if_long()
             raise FitError(pod, failed)
+        if self.extenders:
+            host = self._schedule_with_extenders(
+                pod, nt, feasible_np, np.asarray(scores[0]))
+            trace.log_if_long()
+            return host
         choice, new_last = sv.combine.select_hosts(
             scores, feasible, jnp.uint32(self.last_node_index))
         self.last_node_index = np.uint32(new_last)
         trace.log_if_long()
         return nt.names[int(choice[0])]
+
+    def _schedule_with_extenders(self, pod: api.Pod, nt,
+                                 feasible_np: np.ndarray,
+                                 scores_np: np.ndarray) -> str:
+        """Extender filter after built-in predicates
+        (generic_scheduler.go:189-207) and prioritize summed at weight
+        (:287-305), then selectHost (:124-141) host-side."""
+        nodes = self.cache.nodes()
+        candidates = [nodes[i] for i in range(len(nodes)) if feasible_np[i]]
+        failed_ext: dict[str, list[str]] = {}
+        for ext in self.extenders:
+            candidates, failed = ext.filter(pod, candidates)
+            for name, msg in failed.items():
+                failed_ext.setdefault(name, []).append(msg or "extender")
+            if not candidates:
+                raise FitError(pod, failed_ext)
+        name_to_idx = nt.name_to_idx
+        combined = {n.name: float(scores_np[name_to_idx[n.name]])
+                    for n in candidates}
+        for ext in self.extenders:
+            for host, score in ext.prioritize(pod, candidates).items():
+                if host in combined:
+                    combined[host] += score
+        best = max(combined.values())
+        ties = [n.name for n in candidates if combined[n.name] == best]
+        choice = ties[int(self.last_node_index) % len(ties)]
+        self.last_node_index = np.uint32(int(self.last_node_index) + 1)
+        return choice
 
     # -- batched path ----------------------------------------------------
 

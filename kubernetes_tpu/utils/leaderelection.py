@@ -1,0 +1,167 @@
+"""Leader election by annotation-CAS lease (pkg/client/leaderelection/
+leaderelection.go:99-340): candidates race to CAS a LeaderElectionRecord
+into an object annotation (``control-plane.alpha.kubernetes.io/leader``);
+the holder renews within RenewDeadline or standbys take over after
+LeaseDuration.  The scheduler defaults LeaderElect=true
+(options/options.go:46) and runs its loop only while leading
+(app/server.go:142-159).
+
+The lock backend is pluggable; ``InMemoryLock`` stands in for the Endpoints
+object (tests/HA-in-one-process), an HTTP apiserver-backed lock drops in for
+a real control plane."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Protocol
+
+LEADER_ANNOTATION_KEY = "control-plane.alpha.kubernetes.io/leader"
+DEFAULT_LEASE_DURATION = 15.0   # leaderelection.go:75
+DEFAULT_RENEW_DEADLINE = 10.0   # :76
+DEFAULT_RETRY_PERIOD = 2.0      # :77
+
+
+@dataclass
+class LeaderElectionRecord:
+    """leaderelection.go:151-158."""
+
+    holder_identity: str = ""
+    lease_duration_seconds: float = DEFAULT_LEASE_DURATION
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    leader_transitions: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "holderIdentity": self.holder_identity,
+            "leaseDurationSeconds": self.lease_duration_seconds,
+            "acquireTime": self.acquire_time,
+            "renewTime": self.renew_time,
+            "leaderTransitions": self.leader_transitions})
+
+    @classmethod
+    def from_json(cls, text: str) -> "LeaderElectionRecord":
+        d = json.loads(text)
+        return cls(holder_identity=d.get("holderIdentity", ""),
+                   lease_duration_seconds=d.get("leaseDurationSeconds",
+                                                DEFAULT_LEASE_DURATION),
+                   acquire_time=d.get("acquireTime", 0.0),
+                   renew_time=d.get("renewTime", 0.0),
+                   leader_transitions=d.get("leaderTransitions", 0))
+
+
+class ResourceLock(Protocol):
+    """Annotation-CAS object access (the Endpoints object stand-in)."""
+
+    def get(self) -> tuple[Optional[str], int]:
+        """(annotation value or None, resource version)."""
+
+    def update(self, value: str, expected_version: int) -> bool:
+        """CAS write; False on version conflict."""
+
+
+class InMemoryLock:
+    def __init__(self) -> None:
+        self._value: Optional[str] = None
+        self._version = 0
+        self._mu = threading.Lock()
+
+    def get(self) -> tuple[Optional[str], int]:
+        with self._mu:
+            return self._value, self._version
+
+    def update(self, value: str, expected_version: int) -> bool:
+        with self._mu:
+            if self._version != expected_version:
+                return False
+            self._value = value
+            self._version += 1
+            return True
+
+
+@dataclass
+class LeaderElector:
+    """leaderelection.go:174-340: acquire -> renew loop; on_started_leading
+    runs in a thread while the lease holds; on_stopped_leading fires when
+    the lease is lost."""
+
+    lock: ResourceLock
+    identity: str
+    lease_duration: float = DEFAULT_LEASE_DURATION
+    renew_deadline: float = DEFAULT_RENEW_DEADLINE
+    retry_period: float = DEFAULT_RETRY_PERIOD
+    on_started_leading: Optional[Callable[[], None]] = None
+    on_stopped_leading: Optional[Callable[[], None]] = None
+    now: Callable[[], float] = time.monotonic
+    _observed: Optional[LeaderElectionRecord] = None
+    _observed_at: float = 0.0
+    _stop: threading.Event = field(default_factory=threading.Event)
+
+    def is_leader(self) -> bool:
+        return self._observed is not None and \
+            self._observed.holder_identity == self.identity
+
+    def try_acquire_or_renew(self) -> bool:
+        """One CAS round (leaderelection.go:244-330)."""
+        now = self.now()
+        raw, version = self.lock.get()
+        old = LeaderElectionRecord.from_json(raw) if raw else None
+        if old is not None:
+            if self._observed is None or \
+                    self._observed.to_json() != old.to_json():
+                self._observed = old
+                self._observed_at = now
+            lease_alive = self._observed_at + old.lease_duration_seconds > now
+            if old.holder_identity != self.identity and lease_alive:
+                return False  # someone else holds an unexpired lease
+        record = LeaderElectionRecord(
+            holder_identity=self.identity,
+            lease_duration_seconds=self.lease_duration,
+            acquire_time=(old.acquire_time
+                          if old and old.holder_identity == self.identity
+                          else now),
+            renew_time=now,
+            leader_transitions=(old.leader_transitions + 1
+                                if old and old.holder_identity != self.identity
+                                else (old.leader_transitions if old else 0)))
+        if not self.lock.update(record.to_json(), version):
+            return False
+        self._observed = record
+        self._observed_at = now
+        return True
+
+    def run(self) -> threading.Thread:
+        """Acquire, then renew until the lease is lost or stop() is called."""
+        def loop():
+            while not self._stop.is_set():
+                # Acquire phase.
+                while not self._stop.is_set() and \
+                        not self.try_acquire_or_renew():
+                    self._stop.wait(self.retry_period)
+                if self._stop.is_set():
+                    return
+                if self.on_started_leading is not None:
+                    self.on_started_leading()
+                # Renew phase.
+                while not self._stop.is_set():
+                    deadline = self.now() + self.renew_deadline
+                    renewed = False
+                    while self.now() < deadline and not self._stop.is_set():
+                        if self.try_acquire_or_renew():
+                            renewed = True
+                            break
+                        self._stop.wait(self.retry_period)
+                    if not renewed:
+                        break
+                    self._stop.wait(self.retry_period)
+                if self.on_stopped_leading is not None:
+                    self.on_stopped_leading()
+        t = threading.Thread(target=loop, daemon=True, name="leader-elector")
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
