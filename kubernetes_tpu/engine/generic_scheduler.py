@@ -206,15 +206,22 @@ class GenericScheduler:
 
     # -- batched path ----------------------------------------------------
 
-    def schedule_batch(self, pods: list[api.Pod]) -> list[str | None]:
-        """Place a pending queue in order with full sequential visibility
-        (each placement is seen by all later pods).  Returns node names,
-        None where unschedulable."""
+    def schedule_batch(self, pods: list[api.Pod],
+                       joint: bool = False) -> list[str | None]:
+        """Place a pending queue in one device solve.  Returns node names,
+        None where unschedulable.
+
+        Default mode is sequential-greedy in queue order with full in-batch
+        visibility (decision parity with the reference's one-at-a-time
+        loop).  ``joint=True`` runs the LP-relaxed global assignment
+        (price iteration + regret-ordered repair) — better aggregate
+        placement quality, no per-pod order parity."""
         if not pods:
             return []
         batch, db, dc, nt = self._compile(pods)
-        choices, new_last, _ = self.solver.solve_sequential(
-            db, dc, jnp.uint32(self.last_node_index))
+        solve = self.solver.solve_joint if joint else \
+            self.solver.solve_sequential
+        choices, new_last, _ = solve(db, dc, jnp.uint32(self.last_node_index))
         self.last_node_index = np.uint32(new_last)
         out: list[str | None] = []
         for c in np.asarray(choices):
