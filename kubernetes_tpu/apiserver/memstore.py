@@ -1,0 +1,185 @@
+"""Versioned in-memory object store with watch — the apiserver analogue.
+
+Collapses the reference's storage stack (``storage.Interface`` over etcd,
+``pkg/storage/etcd_helper.go``; the ``Cacher`` watch window,
+``pkg/storage/cacher.go:129``; and the registry REST semantics) into one
+in-process component with the same observable contract the scheduler
+depends on:
+
+* monotonically increasing cluster-wide resourceVersion on every write;
+* List returns (items, rv) — the snapshot a Reflector lists at;
+* Watch(from_rv) replays buffered events after from_rv, then streams live
+  events; a from_rv older than the buffer window raises ``TooOldError``
+  (410 Gone), forcing the client to relist — exactly the reflector's
+  relist-on-staleness path (reflector.go ListAndWatch);
+* CAS binding: ``bind`` sets ``spec.nodeName`` only while empty
+  (BindingREST.Create -> setPodHostAndAnnotations,
+  pkg/registry/pod/etcd/etcd.go:286-330) — the scheduler's optimistic
+  concurrency backstop;
+* ``GuaranteedUpdate``-style CAS on resourceVersion for generic updates.
+
+Objects are stored as plain dicts keyed by "namespace/name" (or name for
+nodes); copies go in and out so callers can't mutate store state.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional
+
+WATCH_WINDOW = 1024  # Cacher event window (cacher.go's watchCache capacity)
+
+
+class TooOldError(Exception):
+    """HTTP 410 Gone: requested watch RV fell out of the event window."""
+
+
+class ConflictError(Exception):
+    """CAS failure (resourceVersion conflict or bind on a bound pod)."""
+
+
+@dataclass(frozen=True)
+class Event:
+    type: str       # ADDED | MODIFIED | DELETED
+    kind: str       # pods | nodes | services | ...
+    key: str
+    object: dict
+    rv: int
+
+
+class Watcher:
+    def __init__(self, store: "MemStore", kinds: tuple[str, ...]):
+        self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
+        self._store = store
+        self.kinds = kinds
+
+    def _deliver(self, ev: Event) -> None:
+        self._q.put(ev)
+
+    def next(self, timeout: Optional[float] = None) -> Optional[Event]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self._store._drop_watcher(self)
+        self._q.put(None)
+
+
+class MemStore:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._objects: dict[str, dict[str, dict]] = {}   # kind -> key -> obj
+        self._rv = 0
+        self._events: list[Event] = []                   # ring window
+        self._watchers: list[Watcher] = []
+
+    # -- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def object_key(obj: dict) -> str:
+        meta = obj.get("metadata") or {}
+        ns = meta.get("namespace")
+        return f"{ns}/{meta['name']}" if ns else meta["name"]
+
+    def _emit(self, etype: str, kind: str, key: str, obj: dict) -> None:
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        ev = Event(etype, kind, key, copy.deepcopy(obj), self._rv)
+        self._events.append(ev)
+        if len(self._events) > WATCH_WINDOW:
+            self._events = self._events[-WATCH_WINDOW:]
+        for w in self._watchers:
+            if kind in w.kinds:
+                w._deliver(ev)
+
+    # -- REST verbs ------------------------------------------------------
+
+    def create(self, kind: str, obj: dict) -> dict:
+        with self._lock:
+            key = self.object_key(obj)
+            bucket = self._objects.setdefault(kind, {})
+            if key in bucket:
+                raise ConflictError(f"{kind} {key} already exists")
+            obj = copy.deepcopy(obj)
+            bucket[key] = obj
+            self._emit("ADDED", kind, key, obj)
+            return copy.deepcopy(obj)
+
+    def update(self, kind: str, obj: dict,
+               expected_rv: Optional[str] = None) -> dict:
+        with self._lock:
+            key = self.object_key(obj)
+            bucket = self._objects.setdefault(kind, {})
+            current = bucket.get(key)
+            if current is None:
+                raise KeyError(f"{kind} {key} not found")
+            if expected_rv is not None and \
+                    current["metadata"].get("resourceVersion") != expected_rv:
+                raise ConflictError(f"{kind} {key} resourceVersion conflict")
+            obj = copy.deepcopy(obj)
+            bucket[key] = obj
+            self._emit("MODIFIED", kind, key, obj)
+            return copy.deepcopy(obj)
+
+    def delete(self, kind: str, key: str) -> None:
+        with self._lock:
+            bucket = self._objects.setdefault(kind, {})
+            obj = bucket.pop(key, None)
+            if obj is None:
+                raise KeyError(f"{kind} {key} not found")
+            self._emit("DELETED", kind, key, obj)
+
+    def get(self, kind: str, key: str) -> Optional[dict]:
+        with self._lock:
+            obj = self._objects.get(kind, {}).get(key)
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def list(self, kind: str,
+             selector: Optional[Callable[[dict], bool]] = None
+             ) -> tuple[list[dict], int]:
+        with self._lock:
+            items = [copy.deepcopy(o) for o in
+                     self._objects.get(kind, {}).values()
+                     if selector is None or selector(o)]
+            return items, self._rv
+
+    # -- watch -----------------------------------------------------------
+
+    def watch(self, kinds: Iterable[str], from_rv: int) -> Watcher:
+        with self._lock:
+            if self._events and from_rv < self._events[0].rv - 1 and \
+                    from_rv < self._rv - len(self._events):
+                raise TooOldError(f"rv {from_rv} too old")
+            w = Watcher(self, tuple(kinds))
+            for ev in self._events:
+                if ev.rv > from_rv and ev.kind in w.kinds:
+                    w._deliver(ev)
+            self._watchers.append(w)
+            return w
+
+    def _drop_watcher(self, w: Watcher) -> None:
+        with self._lock:
+            if w in self._watchers:
+                self._watchers.remove(w)
+
+    # -- the binding subresource ----------------------------------------
+
+    def bind(self, namespace: str, pod_name: str, node_name: str) -> None:
+        """BindingREST.Create (etcd.go:286-330): CAS spec.nodeName while
+        empty; MODIFIED event on success, ConflictError otherwise."""
+        with self._lock:
+            key = f"{namespace}/{pod_name}"
+            pod = self._objects.get("pods", {}).get(key)
+            if pod is None:
+                raise KeyError(f"pod {key} not found")
+            if pod.setdefault("spec", {}).get("nodeName"):
+                raise ConflictError(
+                    f"pod {key} is already assigned to node "
+                    f"{pod['spec']['nodeName']}")
+            pod["spec"]["nodeName"] = node_name
+            self._emit("MODIFIED", "pods", key, pod)

@@ -1,0 +1,69 @@
+"""Reflector: list+watch mirroring into handlers (pkg/client/cache/
+reflector.go:56 ListAndWatch).
+
+The contract the scheduler's factory relies on (factory.go:128-149,
+387-416): list at a resourceVersion, deliver every object as an ADDED
+handler call, then stream watch events from that version; on a 410-Gone
+(window fell behind) or watch error, relist from scratch.  Handlers receive
+(event_type, object_dict)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from kubernetes_tpu.apiserver.memstore import MemStore, TooOldError
+
+Handler = Callable[[str, dict], None]
+
+
+class Reflector:
+    def __init__(self, store: MemStore, kind: str, handler: Handler,
+                 selector: Optional[Callable[[dict], bool]] = None):
+        self.store = store
+        self.kind = kind
+        self.handler = handler
+        self.selector = selector
+        self._stop = threading.Event()
+        self._synced = threading.Event()
+
+    def _list(self) -> int:
+        items, rv = self.store.list(self.kind, self.selector)
+        for obj in items:
+            self.handler("ADDED", obj)
+        self._synced.set()
+        return rv
+
+    def run(self) -> threading.Thread:
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    rv = self._list()
+                    watcher = self.store.watch([self.kind], rv)
+                except TooOldError:
+                    continue
+                try:
+                    while not self._stop.is_set():
+                        ev = watcher.next(timeout=0.1)
+                        if ev is None:
+                            continue
+                        if self.selector is not None and \
+                                not self.selector(ev.object):
+                            # Object left the selected set: surface as a
+                            # delete so stores drop it (the fielded watch
+                            # the reference gets server-side).
+                            self.handler("DELETED", ev.object)
+                            continue
+                        self.handler(ev.type, ev.object)
+                finally:
+                    watcher.stop()
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"reflector-{self.kind}")
+        t.start()
+        return t
+
+    def wait_for_sync(self, timeout: float = 10.0) -> bool:
+        return self._synced.wait(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,162 @@
+"""Config factory: wire the scheduler daemon to an apiserver
+(factory.go:100-227, 387-469) — the standalone watch -> solve -> bind loop.
+
+Three reflectors feed the daemon exactly as the reference's informers do:
+
+* unassigned, non-terminated pods (field selector ``spec.nodeName == ""``,
+  factory.go:466-469) -> the scheduling FIFO;
+* assigned pods -> the scheduler cache (confirming assumed pods);
+* nodes -> the scheduler cache;
+
+plus services/PV/PVC listers kept fresh from the same store, the memstore
+CAS binder, and the 1s assumed-pod TTL sweep (cache.go:31).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.policy import Policy
+from kubernetes_tpu.apiserver.memstore import ConflictError, MemStore
+from kubernetes_tpu.cache.scheduler_cache import CLEANUP_PERIOD
+from kubernetes_tpu.client.reflector import Reflector
+from kubernetes_tpu.engine.generic_scheduler import GenericScheduler, Listers
+from kubernetes_tpu.scheduler.scheduler import Scheduler, SchedulerConfig
+
+
+class MemStoreBinder:
+    """Binder against the in-memory apiserver's binding subresource."""
+
+    def __init__(self, store: MemStore):
+        self.store = store
+
+    def bind(self, pod: api.Pod, node_name: str) -> None:
+        self.store.bind(pod.namespace, pod.name, node_name)
+
+
+def _is_terminated(obj: dict) -> bool:
+    phase = (obj.get("status") or {}).get("phase", "")
+    return phase in ("Succeeded", "Failed")
+
+
+def _unassigned(obj: dict) -> bool:
+    return not (obj.get("spec") or {}).get("nodeName") and \
+        not _is_terminated(obj)
+
+
+def _assigned(obj: dict) -> bool:
+    return bool((obj.get("spec") or {}).get("nodeName")) and \
+        not _is_terminated(obj)
+
+
+class ConfigFactory:
+    """NewConfigFactory + CreateFromProvider/CreateFromConfig
+    (factory.go:100, :251-344)."""
+
+    def __init__(self, store: MemStore, policy: Optional[Policy] = None,
+                 scheduler_name: str = api.DEFAULT_SCHEDULER_NAME,
+                 batched: bool = True):
+        self.store = store
+        self.listers = Listers()
+        self.algorithm = GenericScheduler(policy=policy, listers=self.listers)
+        self.daemon = Scheduler(SchedulerConfig(
+            algorithm=self.algorithm, binder=MemStoreBinder(store),
+            scheduler_name=scheduler_name, async_bind=False,
+            condition_updater=self._update_pod_condition))
+        self.batched = batched
+        self._reflectors: list[Reflector] = []
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- reflector handlers (factory.go:128-227) -------------------------
+
+    def _on_pending_pod(self, etype: str, obj: dict) -> None:
+        pod = api.pod_from_json(obj)
+        if etype == "DELETED" or pod.node_name:
+            self.daemon.queue.delete(pod.key)
+            return
+        self.daemon.enqueue(pod)
+
+    def _on_assigned_pod(self, etype: str, obj: dict) -> None:
+        """addPodToCache / updatePodInCache / deletePodFromCache
+        (factory.go:154-200); ADDED confirms an assumed pod."""
+        pod = api.pod_from_json(obj)
+        cache = self.algorithm.cache
+        if etype == "DELETED":
+            cache.remove_pod(pod)
+        elif etype == "ADDED":
+            cache.add_pod(pod)
+        else:
+            cache.update_pod(pod, pod)
+
+    def _on_node(self, etype: str, obj: dict) -> None:
+        node = api.node_from_json(obj)
+        cache = self.algorithm.cache
+        if etype == "DELETED":
+            cache.remove_node(node.name)
+        else:
+            cache.add_node(node) if etype == "ADDED" else \
+                cache.update_node(node)
+
+    def _on_service(self, etype: str, obj: dict) -> None:
+        meta = obj.get("metadata") or {}
+        svc = api.Service(name=meta.get("name", ""),
+                          namespace=meta.get("namespace", "default"),
+                          selector=dict((obj.get("spec") or {})
+                                        .get("selector") or {}))
+        self.listers.services = [
+            s for s in self.listers.services
+            if (s.namespace, s.name) != (svc.namespace, svc.name)]
+        if etype != "DELETED":
+            self.listers.services.append(svc)
+
+    def _update_pod_condition(self, pod: api.Pod, reason: str,
+                              message: str) -> None:
+        """podConditionUpdater (factory.go:589-600): PodScheduled=False."""
+        key = pod.key
+        obj = self.store.get("pods", key)
+        if obj is None:
+            return
+        conds = obj.setdefault("status", {}).setdefault("conditions", [])
+        conds[:] = [c for c in conds if c.get("type") != "PodScheduled"]
+        conds.append({"type": "PodScheduled", "status": "False",
+                      "reason": reason, "message": message})
+        try:
+            self.store.update("pods", obj)
+        except (KeyError, ConflictError):
+            pass
+
+    # -- lifecycle -------------------------------------------------------
+
+    def run(self) -> "ConfigFactory":
+        """f.Run (factory.go:387-416) + scheduler.Run."""
+        specs = [
+            ("pods", self._on_pending_pod, _unassigned),
+            ("pods", self._on_assigned_pod, _assigned),
+            ("nodes", self._on_node, None),
+            ("services", self._on_service, None),
+        ]
+        for kind, handler, selector in specs:
+            r = Reflector(self.store, kind, handler, selector)
+            self._reflectors.append(r)
+            self._threads.append(r.run())
+        for r in self._reflectors:
+            r.wait_for_sync()
+        self._threads.append(self.daemon.run(batched=self.batched))
+
+        def ttl_sweep():  # cleanupAssumedPods (cache.go:309-330)
+            while not self._stop.wait(CLEANUP_PERIOD):
+                self.algorithm.cache.cleanup_expired()
+        t = threading.Thread(target=ttl_sweep, daemon=True,
+                             name="assume-ttl-sweep")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for r in self._reflectors:
+            r.stop()
+        self.daemon.stop()

@@ -1,0 +1,171 @@
+"""Integration tests: full standalone loop against the in-memory apiserver —
+the test/integration/scheduler analogues (scheduler_test.go:52
+TestUnschedulableNodes, :295 TestMultiScheduler) plus stateless-restart and
+assumed-pod TTL recovery."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver.memstore import ConflictError, MemStore
+from kubernetes_tpu.scheduler.factory import ConfigFactory
+
+from helpers import make_node, make_pod
+
+
+def _node_obj(name, ready=True, unschedulable=False, cpu_m=4000):
+    return {
+        "metadata": {"name": name,
+                     "labels": {api.HOSTNAME_LABEL: name}},
+        "spec": {"unschedulable": unschedulable},
+        "status": {
+            "allocatable": {"cpu": f"{cpu_m}m", "memory": "8Gi",
+                            "pods": "110"},
+            "conditions": [{"type": "Ready",
+                            "status": "True" if ready else "False"}],
+        },
+    }
+
+
+def _pod_obj(name, cpu="100m", scheduler=None, ns="default"):
+    ann = {}
+    if scheduler:
+        ann[api.SCHEDULER_NAME_ANNOTATION_KEY] = scheduler
+    return {
+        "metadata": {"name": name, "namespace": ns, "annotations": ann},
+        "spec": {"containers": [{
+            "name": "c", "resources": {"requests": {"cpu": cpu,
+                                                    "memory": "64Mi"}}}]},
+    }
+
+
+def _wait_bound(store, key, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        obj = store.get("pods", key)
+        if obj and (obj.get("spec") or {}).get("nodeName"):
+            return obj["spec"]["nodeName"]
+        time.sleep(0.05)
+    return None
+
+
+def _never_bound(store, key, wait=0.8):
+    time.sleep(wait)
+    obj = store.get("pods", key)
+    return not (obj.get("spec") or {}).get("nodeName")
+
+
+@pytest.fixture
+def rig():
+    store = MemStore()
+    factory = ConfigFactory(store)
+    yield store, factory
+    factory.stop()
+
+
+class TestStandaloneLoop:
+    def test_watch_solve_bind(self, rig):
+        store, factory = rig
+        for i in range(3):
+            store.create("nodes", _node_obj(f"n{i}"))
+        factory.run()
+        for i in range(6):
+            store.create("pods", _pod_obj(f"p{i}"))
+        for i in range(6):
+            assert _wait_bound(store, f"default/p{i}") is not None
+        # Spread over all nodes by LeastRequested.
+        bound = {store.get("pods", f"default/p{i}")["spec"]["nodeName"]
+                 for i in range(6)}
+        assert bound == {"n0", "n1", "n2"}
+
+    def test_unschedulable_node_flip(self, rig):
+        # TestUnschedulableNodes (scheduler_test.go:52): a cordoned node
+        # leaves the pod pending; uncordoning lets it bind.
+        store, factory = rig
+        store.create("nodes", _node_obj("only", unschedulable=True))
+        factory.run()
+        store.create("pods", _pod_obj("stuck"))
+        assert _never_bound(store, "default/stuck")
+        node = store.get("nodes", "only")
+        node["spec"]["unschedulable"] = False
+        store.update("nodes", node)
+        assert _wait_bound(store, "default/stuck") == "only"
+
+    def test_multi_scheduler_annotation(self, rig):
+        # TestMultiScheduler (scheduler_test.go:295): the default scheduler
+        # must ignore pods annotated for another scheduler.
+        store, factory = rig
+        store.create("nodes", _node_obj("n0"))
+        factory.run()
+        store.create("pods", _pod_obj("mine"))
+        store.create("pods", _pod_obj("other", scheduler="custom-sched"))
+        assert _wait_bound(store, "default/mine") == "n0"
+        assert _never_bound(store, "default/other")
+
+    def test_capacity_backoff_and_requeue(self, rig):
+        # An unschedulable pod retries with backoff and binds once capacity
+        # frees (factory.go:512-556 error handler path).
+        store, factory = rig
+        store.create("nodes", _node_obj("small", cpu_m=150))
+        factory.run()
+        store.create("pods", _pod_obj("first", cpu="100m"))
+        assert _wait_bound(store, "default/first") == "small"
+        store.create("pods", _pod_obj("second", cpu="100m"))
+        assert _never_bound(store, "default/second")
+        store.delete("pods", "default/first")
+        assert _wait_bound(store, "default/second", timeout=20) == "small"
+
+    def test_bind_conflict_detected(self, rig):
+        store, factory = rig
+        store.create("nodes", _node_obj("n0"))
+        store.create("pods", _pod_obj("taken"))
+        store.bind("default", "taken", "elsewhere")
+        with pytest.raises(ConflictError):
+            store.bind("default", "taken", "n0")
+
+
+class TestStatelessRestart:
+    def test_cold_start_rebuilds_from_list(self):
+        # Checkpoint/resume property (SURVEY §5): no in-process durable
+        # state; a fresh factory reconstructs everything from list+watch.
+        store = MemStore()
+        for i in range(3):
+            store.create("nodes", _node_obj(f"n{i}"))
+        f1 = ConfigFactory(store).run()
+        for i in range(5):
+            store.create("pods", _pod_obj(f"p{i}"))
+        for i in range(5):
+            assert _wait_bound(store, f"default/p{i}")
+        f1.stop()
+
+        f2 = ConfigFactory(store).run()
+        # The restarted scheduler sees all bound pods and keeps scheduling.
+        assert f2.algorithm.cache.pod_count() == 5
+        store.create("pods", _pod_obj("after-restart"))
+        assert _wait_bound(store, "default/after-restart")
+        f2.stop()
+
+
+class TestAssumedPodTTL:
+    def test_expired_assume_self_heals(self):
+        # If a bind never lands (binder black-holes), the assumed pod
+        # expires after the TTL and stops occupying capacity
+        # (cache.go:309-330).
+        store = MemStore()
+        store.create("nodes", _node_obj("n0", cpu_m=150))
+        factory = ConfigFactory(store)
+        factory.algorithm.cache.ttl = 0.3  # compress the 30s default
+
+        class BlackholeBinder:
+            def bind(self, pod, node_name):
+                raise ConflictError("apiserver unreachable")
+        factory.daemon.config.binder = BlackholeBinder()
+        factory.run()
+        store.create("pods", _pod_obj("ghost", cpu="100m"))
+        time.sleep(1.0)
+        # Bind failed; ForgetPod ran (or TTL expired): capacity is free.
+        assert factory.algorithm.cache.pod_count() == 0
+        factory.stop()
