@@ -149,32 +149,57 @@ def policy_from_json(text: str) -> Policy:
     return Policy(predicates=preds, priorities=prios, extenders=exts)
 
 
+def canonical_predicate_name(spec: PredicateSpec) -> str:
+    """RegisterCustomFitPredicate (plugins.go:96-142) keys policy entries by
+    ARGUMENT, not by the user-chosen name: any entry carrying a
+    serviceAffinity argument IS the ServiceAffinity predicate, and a
+    labelsPresence argument IS CheckNodeLabelPresence."""
+    if spec.affinity_labels:
+        return "ServiceAffinity"
+    if spec.labels:
+        return "NewNodeLabelPredicate"
+    return spec.name
+
+
+def canonical_priority_name(spec: PrioritySpec) -> str:
+    """RegisterCustomPriorityFunction (plugins.go:149-186): argument-keyed."""
+    if spec.anti_affinity_label:
+        return "ServiceAntiAffinityPriority"
+    if spec.label:
+        return "NodeLabelPriority"
+    return spec.name
+
+
 def service_affinity_labels(policy: Policy) -> tuple[str, ...]:
     """Labels of the (single supported) ServiceAffinity predicate instance."""
     for p in policy.predicates:
-        if p.name == "ServiceAffinity" and p.affinity_labels:
+        if canonical_predicate_name(p) == "ServiceAffinity" and \
+                p.affinity_labels:
             return p.affinity_labels
     return ()
 
 
 def service_anti_affinity_labels(policy: Policy) -> tuple[str, ...]:
-    """Per-instance labels of ServiceAntiAffinityPriority entries, in policy
-    order (matches the solver's aux index assignment)."""
-    return tuple(s.anti_affinity_label for s in policy.priorities
-                 if s.name == "ServiceAntiAffinityPriority" and s.weight != 0)
+    """Per-instance labels of ServiceAntiAffinity entries, in policy order
+    (matches the solver's aux index assignment)."""
+    return tuple(
+        s.anti_affinity_label for s in policy.priorities
+        if canonical_priority_name(s) == "ServiceAntiAffinityPriority"
+        and s.weight != 0)
 
 
 def node_label_args(policy: Policy):
     """(labels, presence) of the CheckNodeLabelPresence predicate, or None."""
     for p in policy.predicates:
-        if p.name == "NewNodeLabelPredicate" and p.labels:
+        if canonical_predicate_name(p) == "NewNodeLabelPredicate" and p.labels:
             return (p.labels, p.presence)
     return None
 
 
 def node_label_prio_args(policy: Policy) -> tuple[tuple[str, bool], ...]:
     return tuple((s.label, s.presence) for s in policy.priorities
-                 if s.name == "NodeLabelPriority" and s.weight != 0)
+                 if canonical_priority_name(s) == "NodeLabelPriority"
+                 and s.weight != 0)
 
 
 def expand_predicates(policy: Policy) -> list[PredicateSpec]:

@@ -26,11 +26,20 @@ class Reflector:
         self.selector = selector
         self._stop = threading.Event()
         self._synced = threading.Event()
+        self._known: dict[str, dict] = {}  # key -> last delivered object
 
     def _list(self) -> int:
+        """Replace semantics (cache.Store.Replace): objects that vanished
+        while the watch was down are surfaced as DELETED on relist."""
         items, rv = self.store.list(self.kind, self.selector)
-        for obj in items:
+        fresh = {MemStore.object_key(obj): obj for obj in items}
+        for key, obj in list(self._known.items()):
+            if key not in fresh:
+                self.handler("DELETED", obj)
+                del self._known[key]
+        for key, obj in fresh.items():
             self.handler("ADDED", obj)
+            self._known[key] = obj
         self._synced.set()
         return rv
 
@@ -47,13 +56,16 @@ class Reflector:
                         ev = watcher.next(timeout=0.1)
                         if ev is None:
                             continue
-                        if self.selector is not None and \
-                                not self.selector(ev.object):
-                            # Object left the selected set: surface as a
-                            # delete so stores drop it (the fielded watch
+                        if ev.type == "DELETED" or (
+                                self.selector is not None
+                                and not self.selector(ev.object)):
+                            # Deleted, or left the selected set: surface as
+                            # a delete so stores drop it (the fielded watch
                             # the reference gets server-side).
+                            self._known.pop(ev.key, None)
                             self.handler("DELETED", ev.object)
                             continue
+                        self._known[ev.key] = ev.object
                         self.handler(ev.type, ev.object)
                 finally:
                     watcher.stop()

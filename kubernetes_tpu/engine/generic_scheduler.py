@@ -218,6 +218,11 @@ class GenericScheduler:
         placement quality, no per-pod order parity."""
         if not pods:
             return []
+        if self.extenders:
+            # Extenders are a per-pod HTTP protocol; run the exact one-pod
+            # path with temporary assumes for in-batch visibility, then
+            # restore (callers re-assume through the daemon).
+            return self._schedule_batch_via_extenders(pods)
         batch, db, dc, nt = self._compile(pods)
         solve = self.solver.solve_joint if joint else \
             self.solver.solve_sequential
@@ -226,4 +231,24 @@ class GenericScheduler:
         out: list[str | None] = []
         for c in np.asarray(choices):
             out.append(nt.names[int(c)] if c >= 0 else None)
+        return out
+
+    def _schedule_batch_via_extenders(self, pods: list[api.Pod]
+                                      ) -> list[str | None]:
+        out: list[str | None] = []
+        assumed: list[api.Pod] = []
+        try:
+            for pod in pods:
+                try:
+                    dest = self.schedule(pod)
+                except FitError:
+                    out.append(None)
+                    continue
+                self.cache.assume_pod(pod, dest)
+                assumed.append(pod)
+                out.append(dest)
+        finally:
+            for pod in assumed:
+                self.cache.forget_pod(pod)
+                pod.node_name = ""
         return out

@@ -34,6 +34,8 @@ import numpy as np
 
 from kubernetes_tpu.api.policy import (DEFAULT_MAX_EBS_VOLUMES,
                                        DEFAULT_MAX_GCE_PD_VOLUMES, Policy,
+                                       canonical_predicate_name,
+                                       canonical_priority_name,
                                        expand_predicates)
 from kubernetes_tpu.features.affinity import AffinityTensors
 from kubernetes_tpu.features.batch import PodBatch
@@ -96,9 +98,13 @@ class DeviceVolSvc(NamedTuple):
     pd_pod_ebs: jnp.ndarray
     pd_node_ebs: jnp.ndarray
     pd_extra_ebs: jnp.ndarray
+    pd_node_extra_ebs: jnp.ndarray
+    pd_node_err_ebs: jnp.ndarray
     pd_pod_gce: jnp.ndarray
     pd_node_gce: jnp.ndarray
     pd_extra_gce: jnp.ndarray
+    pd_node_extra_gce: jnp.ndarray
+    pd_node_err_gce: jnp.ndarray
     vz_group: jnp.ndarray
     vz_mask: jnp.ndarray
     sa_group: jnp.ndarray
@@ -217,11 +223,15 @@ def _predicate_mask(name: str, b: DeviceBatch, c: DeviceCluster,
         return pr.max_pd_volume_count(b.volsvc.pd_pod_ebs,
                                       b.volsvc.pd_extra_ebs,
                                       b.volsvc.pd_node_ebs,
+                                      b.volsvc.pd_node_extra_ebs,
+                                      b.volsvc.pd_node_err_ebs,
                                       extra["max_ebs"])
     if name == "MaxGCEPDVolumeCount":
         return pr.max_pd_volume_count(b.volsvc.pd_pod_gce,
                                       b.volsvc.pd_extra_gce,
                                       b.volsvc.pd_node_gce,
+                                      b.volsvc.pd_node_extra_gce,
+                                      b.volsvc.pd_node_err_gce,
                                       extra["max_gce"])
     if name == "PodFitsResources":
         return pr.pod_fits_resources(b.request, b.zero_request, c.alloc,
@@ -282,7 +292,10 @@ class Solver:
 
     def __init__(self, policy: Policy):
         self.policy = policy
-        self.predicate_names = tuple(p.name for p in expand_predicates(policy))
+        # Canonical names: argument-carrying entries resolve to their
+        # builtin regardless of the user-chosen policy name (plugins.go).
+        self.predicate_names = tuple(canonical_predicate_name(p)
+                                     for p in expand_predicates(policy))
         # (name, weight, aux) — aux indexes per-instance policy-arg tables
         # (ServiceAntiAffinityPriority / NodeLabelPriority rows).
         specs = []
@@ -290,14 +303,15 @@ class Solver:
         for s in policy.priorities:
             if s.weight == 0:
                 continue
-            if s.name == "ServiceAntiAffinityPriority":
-                specs.append((s.name, s.weight, saa_i))
+            name = canonical_priority_name(s)
+            if name == "ServiceAntiAffinityPriority":
+                specs.append((name, s.weight, saa_i))
                 saa_i += 1
-            elif s.name == "NodeLabelPriority":
-                specs.append((s.name, s.weight, nl_i))
+            elif name == "NodeLabelPriority":
+                specs.append((name, s.weight, nl_i))
                 nl_i += 1
             else:
-                specs.append((s.name, s.weight, 0))
+                specs.append((name, s.weight, 0))
         self.priority_specs = tuple(specs)
         self.passthrough = tuple(n for n in self.predicate_names
                                  if n in PASSTHROUGH_PREDICATES)
@@ -426,9 +440,12 @@ class Solver:
                 pod_row = xs[f"pd_pod_{fam}"].astype(f32)
                 overlap = jnp.einsum("w,nw->n", pod_row, pd_node.astype(f32))
                 new = jnp.sum(pod_row) + xs[f"pd_extra_{fam}"].astype(f32)
-                total = jnp.sum(pd_node.astype(f32), axis=1) + new - overlap
-                feasible &= (new == 0) | \
-                    (total <= f32(self.extra[f"max_{fam}"]))
+                node_extra = getattr(b.volsvc, f"pd_node_extra_{fam}")
+                node_err = getattr(b.volsvc, f"pd_node_err_{fam}")
+                total = jnp.sum(pd_node.astype(f32), axis=1) + \
+                    node_extra.astype(f32) + new - overlap
+                ok = (total <= f32(self.extra[f"max_{fam}"])) & ~node_err
+                feasible &= (new == 0) | ok
             if track_affinity:
                 reach = state["match_cnt"] > 0.0  # [Sm, N]
             if use_interpod:

@@ -48,9 +48,13 @@ class VolSvcTensors(NamedTuple):
     pd_pod_ebs: np.ndarray    # [P, We] bool
     pd_node_ebs: np.ndarray   # [N, We] bool
     pd_extra_ebs: np.ndarray  # [P] int32 — un-dedupable new volumes
+    pd_node_extra_ebs: np.ndarray  # [N] int32 — existing un-dedupable
+    pd_node_err_ebs: np.ndarray    # [N] bool — existing unbound PVC
     pd_pod_gce: np.ndarray    # [P, Wg] bool
     pd_node_gce: np.ndarray   # [N, Wg] bool
     pd_extra_gce: np.ndarray  # [P] int32
+    pd_node_extra_gce: np.ndarray  # [N] int32
+    pd_node_err_gce: np.ndarray    # [N] bool
     # NoVolumeZoneConflict groups.
     vz_group: np.ndarray      # [P] int32
     vz_mask: np.ndarray       # [G, N] bool
@@ -101,7 +105,13 @@ def _compile_pd_family(pods: Sequence[api.Pod],
                        volume_pods: Sequence[tuple[api.Pod, int]],
                        n_nodes: int, family: str,
                        listers: Optional[VolumeListers]
-                       ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+                       ) -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                                  np.ndarray, np.ndarray]:
+    """Returns (pod_ids [P,W], node_ids [N,W], pod_extra [P],
+    node_extra [N], node_err [N]).  Existing pods' missing-PVC/PV volumes
+    count toward the node total (predicates.go:265-268 runs filterVolumes
+    on existing pods too); an existing pod's unbound PVC is a hard error
+    failing the node for any volume-carrying candidate."""
     vocab: dict[str, int] = {}
     pod_ids: list[set[str]] = []
     extra = np.zeros(len(pods), np.int32)
@@ -115,10 +125,16 @@ def _compile_pd_family(pods: Sequence[api.Pod],
         for vid in ids:
             vocab.setdefault(vid, len(vocab))
     node_ids: list[tuple[int, set[str]]] = []
+    node_extra = np.zeros(n_nodes, np.int32)
+    node_err = np.zeros(n_nodes, bool)
     for epod, nidx in volume_pods:
         if nidx < 0 or nidx >= n_nodes:
             continue
-        ids, _ = _pd_ids(epod, family, listers)
+        ids, ex = _pd_ids(epod, family, listers)
+        if ex >= INFEASIBLE_EXTRA:
+            node_err[nidx] = True
+        else:
+            node_extra[nidx] += ex
         if ids:
             node_ids.append((nidx, ids))
             for vid in ids:
@@ -132,7 +148,7 @@ def _compile_pd_family(pods: Sequence[api.Pod],
     for nidx, ids in node_ids:
         for vid in ids:
             node_m[nidx, vocab[vid]] = True
-    return pod_m, node_m, extra
+    return pod_m, node_m, extra, node_extra, node_err
 
 
 def _vz_constraints(pod: api.Pod, listers: Optional[VolumeListers]
@@ -311,8 +327,12 @@ def empty_volsvc(p: int, n: int) -> VolSvcTensors:
     return VolSvcTensors(
         pd_pod_ebs=np.zeros((p, 1), bool), pd_node_ebs=np.zeros((n, 1), bool),
         pd_extra_ebs=np.zeros(p, np.int32),
+        pd_node_extra_ebs=np.zeros(n, np.int32),
+        pd_node_err_ebs=np.zeros(n, bool),
         pd_pod_gce=np.zeros((p, 1), bool), pd_node_gce=np.zeros((n, 1), bool),
         pd_extra_gce=np.zeros(p, np.int32),
+        pd_node_extra_gce=np.zeros(n, np.int32),
+        pd_node_err_gce=np.zeros(n, bool),
         vz_group=np.zeros(p, np.int32), vz_mask=np.ones((1, n), bool),
         sa_group=np.zeros(p, np.int32), sa_mask=np.ones((1, n), bool),
         saa_group=np.zeros(p, np.int32),
@@ -340,13 +360,18 @@ def compile_volsvc(pods: Sequence[api.Pod],
     p = len(pods)
     any_vols = any(pod.volumes for pod in pods)
     if any_vols or volume_pods:
-        pe, ne, xe = _compile_pd_family(pods, volume_pods, n, "ebs", listers)
-        pg, ng, xg = _compile_pd_family(pods, volume_pods, n, "gce", listers)
+        pe, ne, xe, nxe, nee = _compile_pd_family(
+            pods, volume_pods, n, "ebs", listers)
+        pg, ng, xg, nxg, neg = _compile_pd_family(
+            pods, volume_pods, n, "gce", listers)
     else:
         pe = np.zeros((p, 1), bool)
         ne = np.zeros((n, 1), bool)
         xe = np.zeros(p, np.int32)
+        nxe = np.zeros(n, np.int32)
+        nee = np.zeros(n, bool)
         pg, ng, xg = pe.copy(), ne.copy(), xe.copy()
+        nxg, neg = nxe.copy(), nee.copy()
 
     if any_vols:
         vz_group, vz_mask = _compile_volume_zone(pods, nodes, listers)
@@ -384,7 +409,9 @@ def compile_volsvc(pods: Sequence[api.Pod],
 
     return VolSvcTensors(
         pd_pod_ebs=pe, pd_node_ebs=ne, pd_extra_ebs=xe,
+        pd_node_extra_ebs=nxe, pd_node_err_ebs=nee,
         pd_pod_gce=pg, pd_node_gce=ng, pd_extra_gce=xg,
+        pd_node_extra_gce=nxg, pd_node_err_gce=neg,
         vz_group=vz_group, vz_mask=vz_mask,
         sa_group=sa_group, sa_mask=sa_mask,
         saa_group=saa_group, saa_score=saa_score,

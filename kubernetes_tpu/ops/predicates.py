@@ -113,21 +113,26 @@ def check_node_disk_pressure(n_pods: int,
 
 
 def max_pd_volume_count(pod_pd: jnp.ndarray, pod_extra: jnp.ndarray,
-                        node_pd: jnp.ndarray,
+                        node_pd: jnp.ndarray, node_extra: jnp.ndarray,
+                        node_err: jnp.ndarray,
                         max_volumes: int) -> jnp.ndarray:
     """MaxPDVolumeCountChecker (predicates.go:243-282) for one volume family.
 
     pod_pd [P,W]: the pod's unique relevant volume ids; pod_extra [P]:
     un-dedupable ids (missing PVC/PV; huge = unbound-PVC hard error);
-    node_pd [N,W]: ids already mounted per node.  Pods contributing no
-    relevant volumes pass unconditionally (the quick return at :245-247,
-    :262-264), even on an over-cap node."""
+    node_pd [N,W]: ids already mounted per node; node_extra [N]: existing
+    pods' un-dedupable ids; node_err [N]: an existing pod's unbound PVC
+    errors the whole node check (:265-268).  Pods contributing no relevant
+    volumes pass unconditionally (the quick return at :245-247, :262-264),
+    even on an over-cap node."""
     f32 = jnp.float32
     overlap = jnp.einsum("pw,nw->pn", pod_pd.astype(f32), node_pd.astype(f32))
-    existing = jnp.sum(node_pd.astype(f32), axis=1)          # [N]
+    existing = jnp.sum(node_pd.astype(f32), axis=1) + \
+        node_extra.astype(f32)                               # [N]
     new = jnp.sum(pod_pd.astype(f32), axis=1) + pod_extra.astype(f32)  # [P]
     total = existing[None, :] + new[:, None] - overlap
-    return (new[:, None] == 0) | (total <= f32(max_volumes))
+    ok = (total <= f32(max_volumes)) & ~node_err[None, :]
+    return (new[:, None] == 0) | ok
 
 
 def node_label_presence(n_pods: int, node_row: jnp.ndarray) -> jnp.ndarray:

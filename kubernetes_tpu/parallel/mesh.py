@@ -86,7 +86,9 @@ def _shard_affinity(a: DeviceAffinity, mesh: Mesh,
 
 
 # DeviceVolSvc: node-axis tables shard over nodes; per-pod rows over batch.
-_VS_NODE_FIELDS = {"pd_node_ebs", "pd_node_gce", "nl_pred_row"}
+_VS_NODE_FIELDS = {"pd_node_ebs", "pd_node_gce", "nl_pred_row",
+                   "pd_node_extra_ebs", "pd_node_err_ebs",
+                   "pd_node_extra_gce", "pd_node_err_gce"}
 _VS_NODE_LAST_FIELDS = {"vz_mask", "sa_mask", "nl_prio_rows"}
 _VS_POD_FIELDS = {"pd_pod_ebs", "pd_pod_gce", "pd_extra_ebs", "pd_extra_gce",
                   "vz_group", "sa_group", "saa_group"}

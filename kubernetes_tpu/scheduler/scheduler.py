@@ -12,12 +12,14 @@ device round-trips.
 
 from __future__ import annotations
 
+import heapq
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.engine.extender_client import ExtenderError
 from kubernetes_tpu.engine.generic_scheduler import FitError, GenericScheduler
 from kubernetes_tpu.scheduler.backoff import PodBackoff
 from kubernetes_tpu.scheduler.binder import Binder, InMemoryBinder
@@ -50,6 +52,12 @@ class Scheduler:
         self.backoff = PodBackoff()
         self._stop = threading.Event()
         self._bind_threads: list[threading.Thread] = []
+        # Single requeue worker over a timer heap (a thread per failed pod
+        # would explode on a large unschedulable batch).
+        self._requeue_heap: list[tuple[float, int, api.Pod]] = []
+        self._requeue_cv = threading.Condition()
+        self._requeue_seq = 0
+        self._requeue_thread: Optional[threading.Thread] = None
 
     # -- queue feed (the reflector-handler analogue) ---------------------
 
@@ -71,7 +79,7 @@ class Scheduler:
         start = time.perf_counter()
         try:
             dest = self.config.algorithm.schedule(pod)
-        except FitError as err:
+        except (FitError, ExtenderError) as err:
             self._handle_failure(pod, "FailedScheduling", str(err))
             return True
         algo_us = (time.perf_counter() - start) * 1e6
@@ -194,9 +202,31 @@ class Scheduler:
         if self.config.condition_updater is not None:
             self.config.condition_updater(pod, "Unschedulable", message)
         backoff_s = self.backoff.get_backoff(pod.key)
+        with self._requeue_cv:
+            self._requeue_seq += 1
+            heapq.heappush(self._requeue_heap,
+                           (time.monotonic() + backoff_s,
+                            self._requeue_seq, pod))
+            if self._requeue_thread is None or \
+                    not self._requeue_thread.is_alive():
+                self._requeue_thread = threading.Thread(
+                    target=self._requeue_worker, daemon=True,
+                    name="backoff-requeue")
+                self._requeue_thread.start()
+            self._requeue_cv.notify()
 
-        def requeue():
-            if not self._stop.wait(backoff_s):
-                pod.node_name = ""
-                self.queue.add(pod)
-        threading.Thread(target=requeue, daemon=True).start()
+    def _requeue_worker(self) -> None:
+        while not self._stop.is_set():
+            with self._requeue_cv:
+                while not self._requeue_heap and not self._stop.is_set():
+                    self._requeue_cv.wait(timeout=0.5)
+                if self._stop.is_set():
+                    return
+                due, _, pod = self._requeue_heap[0]
+                delay = due - time.monotonic()
+                if delay > 0:
+                    self._requeue_cv.wait(timeout=min(delay, 0.5))
+                    continue
+                heapq.heappop(self._requeue_heap)
+            pod.node_name = ""
+            self.queue.add(pod)

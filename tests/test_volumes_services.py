@@ -215,3 +215,60 @@ class TestDefaultProviderEndToEnd:
             [_ebs_pod("e1", "vol-1"), make_pod("plain"),
              make_pod(volumes=[api.Volume(name="g", gce_pd_name="pd-1")])])
         assert all(g is not None for g in got)
+
+class TestMaxPDExistingExtras:
+    def test_existing_missing_pvc_counts_toward_cap(self):
+        # An existing pod's missing-PVC volumes count toward the node total
+        # (predicates.go:265-268 runs filterVolumes on existing pods too).
+        s = GenericScheduler(policy=_max_ebs_policy(2))
+        s.cache.add_node(make_node("n0"))
+        holder = _ebs_pod("holder", "vol-a", pvc="ghost-claim")  # 1 id + 1 extra
+        holder.node_name = "n0"
+        s.cache.add_pod(holder)
+        with pytest.raises(FitError):
+            s.schedule(_ebs_pod("p2", "vol-b"))  # 2 existing + 1 new > 2
+
+    def test_existing_unbound_pvc_errors_node(self):
+        listers = Listers(pvcs=[api.PersistentVolumeClaim(
+            name="unbound", volume_name="")])
+        s = GenericScheduler(policy=_max_ebs_policy(39), listers=listers)
+        s.cache.add_node(make_node("n0"))
+        s.cache.add_node(make_node("n1"))
+        holder = _ebs_pod("holder", pvc="unbound")
+        holder.node_name = "n0"
+        s.cache.add_pod(holder)
+        # Volume-carrying candidate fails n0 (hard error), lands on n1.
+        assert s.schedule(_ebs_pod("p2", "vol-x")) == "n1"
+        # Volume-free candidate quick-returns and may use either node.
+        assert s.schedule(make_pod("plain")) in ("n0", "n1")
+
+
+class TestCustomNamedPolicyArgs:
+    def test_argument_keyed_custom_names_schedule(self):
+        # The reference keys argument-carrying policy entries by argument,
+        # not name (plugins.go:96-186): a custom-named serviceAffinity
+        # entry must behave as ServiceAffinity.
+        from kubernetes_tpu.api.policy import policy_from_json
+        policy = policy_from_json("""
+        {"predicates": [
+            {"name": "MyAffinity",
+             "argument": {"serviceAffinity": {"labels": ["region"]}}},
+            {"name": "MyLabels",
+             "argument": {"labelsPresence": {"labels": ["region"],
+                                             "presence": true}}},
+            {"name": "PodFitsResources"}],
+         "priorities": [
+            {"name": "MySpread", "weight": 3,
+             "argument": {"serviceAntiAffinity": {"label": "region"}}},
+            {"name": "MyLabelPref", "weight": 1,
+             "argument": {"labelPreference": {"label": "fast",
+                                              "presence": true}}}]}
+        """)
+        s = GenericScheduler(policy=policy)
+        s.cache.add_node(make_node("labeled", labels={"region": "r1",
+                                                      "fast": "yes"}))
+        s.cache.add_node(make_node("bare"))
+        # labelsPresence(presence=true) excludes the bare node; the pod
+        # pins region via nodeSelector through ServiceAffinity.
+        got = s.schedule(make_pod("p", node_selector={"region": "r1"}))
+        assert got == "labeled"
