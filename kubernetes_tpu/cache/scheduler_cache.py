@@ -291,6 +291,6 @@ class SchedulerCache:
         mutate them."""
         self._ensure_tensors()
         # Existing-pod label matrix may lag vocab growth from newly seen pods.
-        self._ep.labels = fc._grow_cols(self._ep.labels, self.space.labels.capacity)
+        self._ep.labels = fc._grow_cols(self._ep.labels, self.space.pod_labels.capacity)
         return self._nt, self._agg, self._ep, \
             [self._nodes[n] for n in self._node_order]

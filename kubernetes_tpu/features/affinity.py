@@ -147,12 +147,12 @@ def _sig_match_existing(sig: Sig, ep: fc.ExistingPodTensors,
     ml, mexpr = sig.selector
     mask = cand
     for k, v in ml:
-        kv = space.labels.kv_get(k, v)
+        kv = space.pod_labels.kv_get(k, v)
         mask = mask & (ep.labels[:, kv] if kv >= 0 else False)
     for k, op, vals in mexpr:
-        kid = space.labels.key_get(k)
+        kid = space.pod_labels.key_get(k)
         has = ep.labels[:, kid] if kid >= 0 else np.zeros(m, bool)
-        ids = [space.labels.kv_get(k, v) for v in vals]
+        ids = [space.pod_labels.kv_get(k, v) for v in vals]
         ids = [i for i in ids if i >= 0]
         inset = ep.labels[:, ids].any(1) if ids else np.zeros(m, bool)
         if op == "In":

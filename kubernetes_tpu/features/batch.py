@@ -156,27 +156,27 @@ def preferred_count_row(pod: api.Pod, nt: fc.NodeTensors, space: fc.FeatureSpace
 
 def _label_selector_match_mask(sel: api.LabelSelector, labels_mh: np.ndarray,
                                space: fc.FeatureSpace) -> np.ndarray:
-    """[M] bool — LabelSelector vs each existing pod's label multi-hot."""
+    """[M] bool — LabelSelector vs each existing pod's label multi-hot (pod-label vocab)."""
     m = labels_mh.shape[0]
     mask = np.ones(m, bool)
     for k, v in sel.match_labels:
-        kv = space.labels.kv_get(k, v)
+        kv = space.pod_labels.kv_get(k, v)
         mask &= labels_mh[:, kv] if kv >= 0 else np.zeros(m, bool)
     for e in sel.match_expressions:
         if e.operator == "In":
-            ids = [space.labels.kv_get(e.key, v) for v in e.values]
+            ids = [space.pod_labels.kv_get(e.key, v) for v in e.values]
             ids = [i for i in ids if i >= 0]
             mask &= labels_mh[:, ids].any(1) if ids else np.zeros(m, bool)
         elif e.operator == "NotIn":
-            ids = [space.labels.kv_get(e.key, v) for v in e.values]
+            ids = [space.pod_labels.kv_get(e.key, v) for v in e.values]
             ids = [i for i in ids if i >= 0]
             if ids:
                 mask &= ~labels_mh[:, ids].any(1)
         elif e.operator == "Exists":
-            kid = space.labels.key_get(e.key)
+            kid = space.pod_labels.key_get(e.key)
             mask &= labels_mh[:, kid] if kid >= 0 else np.zeros(m, bool)
         elif e.operator == "DoesNotExist":
-            kid = space.labels.key_get(e.key)
+            kid = space.pod_labels.key_get(e.key)
             if kid >= 0:
                 mask &= ~labels_mh[:, kid]
         else:
@@ -454,7 +454,7 @@ def _spread_counts(namespace: str, selectors: list,
                 continue  # empty map selector selects nothing
             m = np.ones(len(cand), bool)
             for k, v in sel.items():
-                kv = space.labels.kv_get(k, v)
+                kv = space.pod_labels.kv_get(k, v)
                 m &= ep.labels[:, kv] if kv >= 0 else False
             match |= m
         elif isinstance(sel, api.LabelSelector):

@@ -46,7 +46,12 @@ def _mib_floor(b: int) -> int:
 class FeatureSpace:
     """All interning vocabularies; the single source of id assignment."""
 
-    labels: LabelVocab = field(default_factory=LabelVocab)
+    labels: LabelVocab = field(default_factory=LabelVocab)       # node labels
+    # Pod labels get their own vocabulary: selector matching against
+    # existing pods only ever reads POD labels, and node vocabularies carry
+    # per-node uniques (hostname) that would blow the [pods, V] matrix up
+    # by orders of magnitude.
+    pod_labels: LabelVocab = field(default_factory=LabelVocab)
     taints: Vocab = field(default_factory=Vocab)       # "key=value:effect"
     ports: Vocab = field(default_factory=Vocab)        # "tcp:port" etc
     volumes: Vocab = field(default_factory=Vocab)      # conflict keys
@@ -328,7 +333,7 @@ def _grow_aggregate_columns(agg: NodeAggregates, space: FeatureSpace) -> NodeAgg
 # ---------------------------------------------------------------------------
 
 def empty_existing_pods(space: FeatureSpace, cap: int = 256) -> ExistingPodTensors:
-    V = space.labels.capacity
+    V = space.pod_labels.capacity
     return ExistingPodTensors(
         labels=np.zeros((cap, V), bool),
         ns_id=np.zeros(cap, np.int32),
@@ -343,9 +348,9 @@ def empty_existing_pods(space: FeatureSpace, cap: int = 256) -> ExistingPodTenso
 def existing_pods_add(ep: ExistingPodTensors, pod: api.Pod, node_idx: int,
                       space: FeatureSpace) -> ExistingPodTensors:
     for k, v in pod.labels.items():
-        space.labels.kv_id(k, v)
-        space.labels.key_id(k)
-    ep.labels = _grow_cols(ep.labels, space.labels.capacity)
+        space.pod_labels.kv_id(k, v)
+        space.pod_labels.key_id(k)
+    ep.labels = _grow_cols(ep.labels, space.pod_labels.capacity)
     slot = ep.key_to_slot.get(pod.key)
     if slot is None:
         if not ep.free_slots:
@@ -362,8 +367,8 @@ def existing_pods_add(ep: ExistingPodTensors, pod: api.Pod, node_idx: int,
         ep.keys[slot] = pod.key
     ep.labels[slot] = False
     for k, v in pod.labels.items():
-        ep.labels[slot, space.labels.kv_id(k, v)] = True
-        ep.labels[slot, space.labels.key_id(k)] = True
+        ep.labels[slot, space.pod_labels.kv_id(k, v)] = True
+        ep.labels[slot, space.pod_labels.key_id(k)] = True
     ep.ns_id[slot] = space.namespaces.id(pod.namespace)
     ep.node_idx[slot] = node_idx
     ep.alive[slot] = True
@@ -377,9 +382,9 @@ def existing_pods_add_bulk(ep: ExistingPodTensors, pods: Sequence[api.Pod],
     """Bulk existing_pods_add: one growth pass + vectorized row writes."""
     for pod in pods:
         for k, v in pod.labels.items():
-            space.labels.kv_id(k, v)
-            space.labels.key_id(k)
-    ep.labels = _grow_cols(ep.labels, space.labels.capacity)
+            space.pod_labels.kv_id(k, v)
+            space.pod_labels.key_id(k)
+    ep.labels = _grow_cols(ep.labels, space.pod_labels.capacity)
     need = sum(1 for p in pods if p.key not in ep.key_to_slot)
     while len(ep.free_slots) < need:
         m = len(ep.keys)
@@ -403,9 +408,9 @@ def existing_pods_add_bulk(ep: ExistingPodTensors, pods: Sequence[api.Pod],
     for i, pod in enumerate(pods):
         for k, v in pod.labels.items():
             rows.append(slots[i])
-            cols.append(space.labels.kv_id(k, v))
+            cols.append(space.pod_labels.kv_id(k, v))
             rows.append(slots[i])
-            cols.append(space.labels.key_id(k))
+            cols.append(space.pod_labels.key_id(k))
     if rows:
         ep.labels[rows, cols] = True
     ep.ns_id[slots] = [space.namespaces.id(p.namespace) for p in pods]
