@@ -1,0 +1,198 @@
+"""HTTP front end over the in-memory store — the apiserver surface the
+scheduler stack speaks (pkg/apiserver handler chain, scheduler-relevant
+subset):
+
+    GET    /api/v1/{kind}                      list (+ ?watch=1&resourceVersion=N)
+    GET    /api/v1/namespaces/{ns}/{kind}/{name}
+    POST   /api/v1/{kind}                      create
+    PUT    /api/v1/namespaces/{ns}/{kind}/{name}   update (CAS on resourceVersion)
+    DELETE /api/v1/namespaces/{ns}/{kind}/{name}
+    POST   /api/v1/namespaces/{ns}/bindings    the binding subresource
+    GET    /healthz, /metrics
+
+Watches stream newline-delimited JSON events ({"type": ..., "object": ...})
+over a chunked response, the reference's watch wire shape; a stale
+resourceVersion returns 410 Gone, telling the client to relist.  Nodes are
+cluster-scoped (no namespace segment), pods/services namespaced.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from kubernetes_tpu.apiserver.memstore import (ConflictError, MemStore,
+                                               TooOldError)
+
+_NAMESPACED = {"pods", "services"}
+
+
+def make_handler(store: MemStore):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _send_json(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_body(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(length) or b"{}")
+
+        def _parts(self):
+            parsed = urlparse(self.path)
+            return [p for p in parsed.path.split("/") if p], \
+                parse_qs(parsed.query)
+
+        def do_GET(self):
+            parts, query = self._parts()
+            if parts == ["healthz"]:
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+                return
+            if len(parts) == 3 and parts[:2] == ["api", "v1"]:
+                kind = parts[2]
+                if query.get("watch", ["0"])[0] in ("1", "true"):
+                    self._serve_watch(kind, query)
+                    return
+                items, rv = store.list(kind)
+                self._send_json(200, {"kind": kind.capitalize() + "List",
+                                      "items": items,
+                                      "metadata": {"resourceVersion": str(rv)}})
+                return
+            if len(parts) == 6 and parts[2] == "namespaces":
+                # /api/v1/namespaces/{ns}/{kind}/{name}
+                _, _, _, ns, kind, name = parts
+                obj = store.get(kind, f"{ns}/{name}")
+                if obj is None:
+                    self._send_json(404, {"error": "not found"})
+                else:
+                    self._send_json(200, obj)
+                return
+            if len(parts) == 4 and parts[:2] == ["api", "v1"]:
+                obj = store.get(parts[2], parts[3])
+                if obj is None:
+                    self._send_json(404, {"error": "not found"})
+                else:
+                    self._send_json(200, obj)
+                return
+            self._send_json(404, {"error": "unknown path"})
+
+        def _serve_watch(self, kind: str, query) -> None:
+            rv = int(query.get("resourceVersion", ["0"])[0])
+            try:
+                watcher = store.watch([kind], rv)
+            except TooOldError:
+                self._send_json(410, {"error": "too old resource version"})
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                while True:
+                    ev = watcher.next(timeout=0.5)
+                    if ev is None:
+                        # Keep-alive heartbeat chunk boundary check.
+                        continue
+                    line = json.dumps({"type": ev.type,
+                                       "object": ev.object}) + "\n"
+                    data = line.encode()
+                    self.wfile.write(f"{len(data):x}\r\n".encode())
+                    self.wfile.write(data + b"\r\n")
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            finally:
+                watcher.stop()
+
+        def do_POST(self):
+            parts, _ = self._parts()
+            try:
+                body = self._read_body()
+            except ValueError:
+                self._send_json(400, {"error": "bad json"})
+                return
+            try:
+                if len(parts) == 5 and parts[2] == "namespaces" and \
+                        parts[4] == "bindings":
+                    ns = parts[3]
+                    name = (body.get("metadata") or {}).get("name", "")
+                    target = (body.get("target") or {}).get("name", "")
+                    store.bind(ns, name, target)
+                    self._send_json(201, {"status": "Success"})
+                    return
+                if len(parts) == 3 and parts[:2] == ["api", "v1"]:
+                    kind = parts[2]
+                    if kind in _NAMESPACED:
+                        body.setdefault("metadata", {}).setdefault(
+                            "namespace", "default")
+                    created = store.create(kind, body)
+                    self._send_json(201, created)
+                    return
+            except ConflictError as err:
+                self._send_json(409, {"error": str(err)})
+                return
+            except KeyError as err:
+                self._send_json(404, {"error": str(err)})
+                return
+            self._send_json(404, {"error": "unknown path"})
+
+        def do_PUT(self):
+            parts, _ = self._parts()
+            try:
+                body = self._read_body()
+            except ValueError:
+                self._send_json(400, {"error": "bad json"})
+                return
+            try:
+                if len(parts) == 6 and parts[2] == "namespaces":
+                    kind = parts[4]
+                elif len(parts) == 4 and parts[:2] == ["api", "v1"]:
+                    kind = parts[2]
+                else:
+                    self._send_json(404, {"error": "unknown path"})
+                    return
+                updated = store.update(kind, body)
+                self._send_json(200, updated)
+            except ConflictError as err:
+                self._send_json(409, {"error": str(err)})
+            except KeyError as err:
+                self._send_json(404, {"error": str(err)})
+
+        def do_DELETE(self):
+            parts, _ = self._parts()
+            try:
+                if len(parts) == 6 and parts[2] == "namespaces":
+                    store.delete(parts[4], f"{parts[3]}/{parts[5]}")
+                elif len(parts) == 4 and parts[:2] == ["api", "v1"]:
+                    store.delete(parts[2], parts[3])
+                else:
+                    self._send_json(404, {"error": "unknown path"})
+                    return
+                self._send_json(200, {"status": "Success"})
+            except KeyError as err:
+                self._send_json(404, {"error": str(err)})
+
+    return Handler
+
+
+def serve(store: MemStore, port: int = 0,
+          host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    server = ThreadingHTTPServer((host, port), make_handler(store))
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="apiserver-http")
+    t.start()
+    return server
