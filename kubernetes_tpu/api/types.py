@@ -249,10 +249,14 @@ class Pod:
     # Parsed-from-annotation caches (set lazily).
     _affinity: Optional[Affinity] = field(default=None, repr=False)
     _affinity_parsed: bool = field(default=False, repr=False)
+    _key: Optional[str] = field(default=None, repr=False)
 
     @property
     def key(self) -> str:
-        return f"{self.namespace}/{self.name}"
+        k = self._key
+        if k is None:
+            k = self._key = f"{self.namespace}/{self.name}"
+        return k
 
     @property
     def scheduler_name(self) -> str:

@@ -16,12 +16,24 @@ snapshotting of UpdateNodeNameToInfoMap (cache.go:77-91).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.features import compiler as fc
+
+def _locked(fn):
+    """Serialize public cache methods on self.lock (cache.go mutex)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self.lock:
+            return fn(self, *args, **kwargs)
+    return wrapper
+
 
 DEFAULT_ASSUMED_POD_TTL = 30.0  # factory.go:102
 CLEANUP_PERIOD = 1.0            # cache.go:31
@@ -43,6 +55,9 @@ class SchedulerCache:
         self.space = space or fc.FeatureSpace()
         self.ttl = ttl
         self._now = now
+        # schedulerCache.mu (cache.go:60): the daemon's async bind threads
+        # forget failed binds while the scheduling loop assumes new batches.
+        self.lock = threading.RLock()
         self._nodes: dict[str, api.Node] = {}
         self._node_order: list[str] = []
         self._pod_states: dict[str, _PodState] = {}
@@ -62,18 +77,21 @@ class SchedulerCache:
 
     # ---- node lifecycle (cache.go:263-307) ----------------------------
 
+    @_locked
     def add_node(self, node: api.Node) -> None:
         self._nodes[node.name] = node
         if node.name not in self._node_pods:
             self._node_pods[node.name] = {}
         self._mark_nodes_dirty()
 
+    @_locked
     def update_node(self, node: api.Node) -> None:
         self._nodes[node.name] = node
         if node.name not in self._node_pods:
             self._node_pods[node.name] = {}
         self._mark_nodes_dirty()
 
+    @_locked
     def remove_node(self, name: str) -> None:
         self._nodes.pop(name, None)
         # Pods on the node stay tracked (the reference keeps them until their
@@ -86,6 +104,7 @@ class SchedulerCache:
 
     # ---- pod state machine --------------------------------------------
 
+    @_locked
     def assume_pod(self, pod: api.Pod, node_name: str) -> None:
         """AssumePod (cache.go:107-133): optimistic placement with TTL."""
         key = pod.key
@@ -96,15 +115,23 @@ class SchedulerCache:
             pod=pod, assumed=True, deadline=self._now() + self.ttl)
         self._attach(pod, node_name)
 
+    @_locked
     def assume_pods(self, assignments: list[tuple[api.Pod, str]],
-                    strict: bool = True) -> list[str]:
+                    strict: bool = True, agg_handoff=None) -> list[str]:
         """Bulk AssumePod for a solved batch: same state machine as
         assume_pod, with the tensor updates vectorized (the per-pod path is
         O(pods x numpy-call overhead) at 30k-pod batches).
 
         With ``strict=False`` already-cached pods are skipped and their keys
-        returned (the daemon logs and proceeds, scheduler.go:116-120)."""
+        returned (the daemon logs and proceeds, scheduler.go:116-120).
+
+        ``agg_handoff``: optional (generation, requested, nonzero) from the
+        device solve (GenericScheduler.take_agg_handoff).  When the
+        generation still matches and every assignment attached cleanly, the
+        device-final aggregates are ingested directly instead of
+        re-aggregating the rows host-side."""
         self._ensure_tensors()
+        gen_at_entry = self.generation
         deadline = self._now() + self.ttl
         pods, idxs = [], []
         skipped: list[str] = []
@@ -130,13 +157,23 @@ class SchedulerCache:
                 pods.append(pod)
                 idxs.append(idx)
         if not self._dirty_nodes and pods:
-            self._agg = fc.add_pods_to_aggregates_bulk(
-                self._agg, idxs, pods, self.space)
+            import numpy as np
+            if (agg_handoff is not None
+                    and agg_handoff[0] == gen_at_entry
+                    and not skipped and len(pods) == len(assignments)):
+                # copy(): jax->numpy views are read-only, later incremental
+                # updates write in place.
+                self._agg.requested = np.asarray(agg_handoff[1]).copy()
+                self._agg.nonzero = np.asarray(agg_handoff[2]).copy()
+            else:
+                self._agg = fc.add_pods_to_aggregates_bulk(
+                    self._agg, idxs, pods, self.space)
             self._ep = fc.existing_pods_add_bulk(
                 self._ep, pods, idxs, self.space)
         self.generation += len(assignments)
         return skipped
 
+    @_locked
     def forget_pod(self, pod: api.Pod) -> None:
         """ForgetPod (cache.go:135-158): only assumed pods may be forgotten."""
         key = pod.key
@@ -146,6 +183,7 @@ class SchedulerCache:
         self._detach(st.pod)
         del self._pod_states[key]
 
+    @_locked
     def add_pod(self, pod: api.Pod) -> None:
         """AddPod (cache.go:160-186): confirm an assumed pod (clearing its
         TTL) or ingest an already-bound pod seen via watch."""
@@ -158,6 +196,7 @@ class SchedulerCache:
         self._attach(pod, pod.node_name)
         self._pod_states[key] = _PodState(pod=pod, assumed=False, deadline=None)
 
+    @_locked
     def update_pod(self, old: api.Pod, new: api.Pod) -> None:
         """UpdatePod (cache.go:188-206)."""
         st = self._pod_states.get(old.key)
@@ -166,12 +205,14 @@ class SchedulerCache:
         self._attach(new, new.node_name)
         self._pod_states[new.key] = _PodState(pod=new, assumed=False, deadline=None)
 
+    @_locked
     def remove_pod(self, pod: api.Pod) -> None:
         """RemovePod (cache.go:208-230)."""
         st = self._pod_states.pop(pod.key, None)
         if st is not None:
             self._detach(st.pod)
 
+    @_locked
     def cleanup_expired(self, now: Optional[float] = None) -> list[str]:
         """cleanupAssumedPods (cache.go:309-330): expire stale assumed pods."""
         now = self._now() if now is None else now
@@ -182,20 +223,25 @@ class SchedulerCache:
             del self._pod_states[k]
         return expired
 
+    @_locked
     def is_assumed(self, key: str) -> bool:
         st = self._pod_states.get(key)
         return st is not None and st.assumed
 
+    @_locked
     def pod_count(self) -> int:
         return len(self._pod_states)
 
+    @_locked
     def nodes(self) -> list[api.Node]:
         self._ensure_tensors()
         return [self._nodes[n] for n in self._node_order]
 
+    @_locked
     def node_pods(self, node_name: str) -> list[api.Pod]:
         return list(self._node_pods.get(node_name, {}).values())
 
+    @_locked
     def service_peer_nodes(self, namespace: str,
                            selector: dict[str, str]) -> list[str]:
         """Node names hosting assigned pods matching a service selector in
@@ -217,12 +263,14 @@ class SchedulerCache:
         peers = self.service_peer_nodes(namespace, selector)
         return peers[0] if peers else None
 
+    @_locked
     def volume_pods(self) -> list[tuple[api.Pod, int]]:
         """(pod, node index) for attached pods with volumes (incl. assumed)."""
         self._ensure_tensors()
         return [(p, self._nt.name_to_idx.get(p.node_name, -1))
                 for p in self._volume_pods.values()]
 
+    @_locked
     def affinity_pods(self) -> list[tuple[api.Pod, int]]:
         """(pod, node index) for every attached pod with affinity annotations
         (incl. assumed pods — matching the reference's assumed-pod
@@ -284,6 +332,7 @@ class SchedulerCache:
                 self._ep = fc.existing_pods_add(self._ep, pod, idx, self.space)
         self._dirty_nodes = False
 
+    @_locked
     def snapshot(self) -> tuple[fc.NodeTensors, fc.NodeAggregates,
                                 fc.ExistingPodTensors, list[api.Node]]:
         """Current tensor view (UpdateNodeNameToInfoMap analogue).  The

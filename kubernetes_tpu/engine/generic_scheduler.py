@@ -124,27 +124,36 @@ class GenericScheduler:
 
     def _compile(self, pods: list[api.Pod]) -> tuple[fb.PodBatch, sv.DeviceBatch,
                                                      sv.DeviceCluster, list[str]]:
-        nt, agg, ep, nodes = self.cache.snapshot()
-        volsvc = compile_volsvc(
-            pods, nodes, nt.schedulable,
-            volume_pods=self.cache.volume_pods(), listers=self.listers,
-            service_affinity_labels=service_affinity_labels(self.policy),
-            service_anti_affinity_labels=service_anti_affinity_labels(
-                self.policy),
-            node_label_args=node_label_args(self.policy),
-            node_label_prio_args=node_label_prio_args(self.policy),
-            service_peers=self.cache.service_peer_nodes,
-            first_peer=self.cache.first_peer_node)
-        batch = fb.compile_batch(
-            pods, nt, self.cache.space, ep=ep, nodes=nodes,
-            spread_selectors=self.listers.spread_selectors,
-            controller_refs=self.listers.controller_refs,
-            affinity_pods=self.cache.affinity_pods(),
-            hard_pod_affinity_weight=(
-                self.policy.hard_pod_affinity_symmetric_weight),
-            volsvc=volsvc)
-        db = sv.device_batch(batch)
-        dc = sv.device_cluster(nt, agg, self.cache.space)
+        # The whole compile runs under the cache lock: cache mutators
+        # (reflector handlers, async-bind forget_pod) update the aggregate
+        # and existing-pod arrays IN PLACE, so every read — snapshot,
+        # volume/affinity pod lists, feature compilation, and the device
+        # transfer itself — must see one consistent generation.
+        with self.cache.lock:
+            nt, agg, ep, nodes = self.cache.snapshot()
+            # Tag for the device-aggregate handoff: the snapshot the solve
+            # starts from (assume_pods validates nothing changed since).
+            self._snapshot_generation = self.cache.generation
+            volsvc = compile_volsvc(
+                pods, nodes, nt.schedulable,
+                volume_pods=self.cache.volume_pods(), listers=self.listers,
+                service_affinity_labels=service_affinity_labels(self.policy),
+                service_anti_affinity_labels=service_anti_affinity_labels(
+                    self.policy),
+                node_label_args=node_label_args(self.policy),
+                node_label_prio_args=node_label_prio_args(self.policy),
+                service_peers=self.cache.service_peer_nodes,
+                first_peer=self.cache.first_peer_node)
+            batch = fb.compile_batch(
+                pods, nt, self.cache.space, ep=ep, nodes=nodes,
+                spread_selectors=self.listers.spread_selectors,
+                controller_refs=self.listers.controller_refs,
+                affinity_pods=self.cache.affinity_pods(),
+                hard_pod_affinity_weight=(
+                    self.policy.hard_pod_affinity_symmetric_weight),
+                volsvc=volsvc)
+            db = sv.device_batch(batch)
+            dc = sv.device_cluster(nt, agg, self.cache.space)
         return batch, db, dc, nt
 
     # -- single-pod path (Schedule, generic_scheduler.go:78) -------------
@@ -224,14 +233,94 @@ class GenericScheduler:
             # restore (callers re-assume through the daemon).
             return self._schedule_batch_via_extenders(pods)
         batch, db, dc, nt = self._compile(pods)
-        solve = self.solver.solve_joint if joint else \
-            self.solver.solve_sequential
-        choices, new_last, _ = solve(db, dc, jnp.uint32(self.last_node_index))
-        self.last_node_index = np.uint32(new_last)
-        out: list[str | None] = []
-        for c in np.asarray(choices):
-            out.append(nt.names[int(c)] if c >= 0 else None)
-        return out
+        flags = sv.batch_flags(batch)
+        self._agg_handoff = None
+        if joint:
+            choices, new_last, _ = self.solver.solve_joint(
+                db, dc, jnp.uint32(self.last_node_index), flags=flags)
+            self.last_node_index = np.uint32(new_last)
+            rows = np.asarray(choices).tolist()
+        else:
+            # One packed device->host fetch for the whole drain (each fetch
+            # is a full RTT on a tunneled chip): choices + tie counter +
+            # final aggregates.
+            p, n = len(pods), dc.alloc.shape[0]
+            host = np.asarray(self.solver.solve_sequential_packed(
+                db, dc, jnp.uint32(self.last_node_index), flags))
+            rows = host[:p].tolist()
+            self.last_node_index = np.uint32(host[p])
+            # Device-aggregate handoff: the scan's final requested/nonzero
+            # equal the snapshot plus every in-batch placement, so
+            # assume_pods can ingest them instead of re-aggregating — valid
+            # only when the batch carries no port/volume state (host-only
+            # counters) and the cache hasn't moved since the snapshot.
+            if not (flags.any_ports or flags.any_volumes or flags.any_ebs
+                    or flags.any_gce):
+                self._agg_handoff = (
+                    self._snapshot_generation,
+                    host[p + 1:p + 1 + 4 * n].reshape(n, 4),
+                    host[p + 1 + 4 * n:].reshape(n, 2))
+        names = nt.names
+        return [names[c] if c >= 0 else None for c in rows]
+
+    def take_agg_handoff(self):
+        """One-shot: the (generation, requested, nonzero) handoff from the
+        last schedule_batch, if any (see assume_pods)."""
+        h = getattr(self, "_agg_handoff", None)
+        self._agg_handoff = None
+        return h
+
+    def schedule_batch_stream(self, pods: list[api.Pod],
+                              chunk_size: int = 2048):
+        """Pipelined batched drain: one host compile, then the scan runs in
+        equal-shaped chunks with device-carried state (identical choices to
+        ``schedule_batch`` — each chunk continues the previous chunk's
+        aggregates).  Yields ``(chunk_pods, chunk_placements)`` as each
+        chunk's results land, while the device is already scanning the next
+        chunk — the double-buffered decide/commit pipeline the reference
+        gets from its async-bind goroutine (scheduler.go:122-153), stretched
+        over the whole queue.
+
+        The last chunk is padded with inert pods (live=False rows are
+        infeasible everywhere and bump no tie counter) so every chunk hits
+        the same compiled executable."""
+        p = len(pods)
+        if p == 0:
+            return
+        n_chunks = (p + chunk_size - 1) // chunk_size
+        padded = n_chunks * chunk_size
+        all_pods = list(pods)
+        if padded > p:
+            all_pods += [api.Pod(name=f"__pad-{i}", namespace="__pad__")
+                         for i in range(padded - p)]
+        batch, db, dc, nt = self._compile(all_pods)
+        flags = sv.batch_flags(batch)
+        n = dc.alloc.shape[0]
+        counter = jnp.uint32(self.last_node_index)
+        carry = None
+        live_np = np.zeros(padded, bool)
+        live_np[:p] = True
+        pending: list[tuple[int, jnp.ndarray]] = []
+
+        def emit(start: int, choices) -> tuple[list, list]:
+            rows = np.asarray(choices)  # blocks only on this chunk
+            stop = min(start + chunk_size, p)
+            chunk_pods = pods[start:stop]
+            placements = [nt.names[int(c)] if c >= 0 else None
+                          for c in rows[: stop - start]]
+            return chunk_pods, placements
+
+        for start in range(0, padded, chunk_size):
+            db_k = sv.slice_pod_axis(db, start, start + chunk_size)
+            live = jnp.asarray(live_np[start:start + chunk_size])
+            choices_k, counter, carry = self.solver._solve_scan(
+                db_k, dc, counter, None, flags, carry, live)
+            pending.append((start, choices_k))
+            if len(pending) > 1:
+                yield emit(*pending.pop(0))
+        for start, choices_k in pending:
+            yield emit(start, choices_k)
+        self.last_node_index = np.uint32(counter)
 
     def _schedule_batch_via_extenders(self, pods: list[api.Pod]
                                       ) -> list[str | None]:

@@ -256,7 +256,9 @@ def compile_affinity(pods: Sequence[api.Pod],
                      nodes: Optional[Sequence[api.Node]],
                      n_nodes: int,
                      space: fc.FeatureSpace,
-                     hard_pod_affinity_weight: int = 1) -> AffinityTensors:
+                     hard_pod_affinity_weight: int = 1,
+                     reps: Optional[Sequence[api.Pod]] = None,
+                     tpl_idx: Optional[np.ndarray] = None) -> AffinityTensors:
     """Build the batch's affinity tables.
 
     ``affinity_pods``: (existing pod, node index) for every assigned pod with
@@ -264,8 +266,16 @@ def compile_affinity(pods: Sequence[api.Pod],
     ``ep``: existing-pod label tensors for vectorized own-term matching.
     ``nodes`` may be None (no label access): every topology domain is then
     empty, matching nodes without the label.
+    ``reps``/``tpl_idx``: template dedup from compile_batch — per-pod
+    incidence rows are built once per spec-identical template and gathered
+    back to the full pod axis.
     """
-    p = len(pods)
+    if reps is not None and tpl_idx is not None:
+        cand = reps
+    else:
+        cand = pods
+        tpl_idx = None
+    p = len(cand)
     n = n_nodes
     dt = _DomainTable(nodes or [], n)
 
@@ -275,7 +285,7 @@ def compile_affinity(pods: Sequence[api.Pod],
     pod_m: list[list[tuple[int, str]]] = []  # per pod: (sig idx, kind)
     pod_pref: list[list[tuple[int, int]]] = []  # per pod: (sig idx, ±weight)
     any_affinity = False
-    for pod in pods:
+    for pod in cand:
         req_a, req_aa, pref_a, pref_aa = _pod_terms(pod)
         entries: list[tuple[int, str]] = []
         prefs: list[tuple[int, int]] = []
@@ -346,7 +356,7 @@ def compile_affinity(pods: Sequence[api.Pod],
     # Register their sigs too so the scan state has rows for them.
     pod_decl: list[list[int]] = []
     pod_sym: list[list[int]] = []
-    for pod in pods:
+    for pod in cand:
         req_a, req_aa, pref_a, pref_aa = _pod_terms(pod)
         dsigs: list[int] = []
         ysigs: list[int] = []
@@ -429,7 +439,7 @@ def compile_affinity(pods: Sequence[api.Pod],
     # pods stamped from one controller share labels, so each template is
     # matched against each sig family once.
     tmpl_cache: dict = {}
-    for i, pod in enumerate(pods):
+    for i, pod in enumerate(cand):
         for si, kind in pod_m[i]:
             if kind == "aff":
                 aff_need[i, si] = True
@@ -459,6 +469,14 @@ def compile_affinity(pods: Sequence[api.Pod],
         for si, kind in pod_m[i]:
             if kind == "aff" and match_src[i, si]:
                 aff_self[i, si] = True
+
+    if tpl_idx is not None:
+        # Expand template rows back to the full pod axis.
+        aff_need, aff_self, anti_need, pref_w, match_src = (
+            a[tpl_idx] for a in (aff_need, aff_self, anti_need, pref_w,
+                                 match_src))
+        decl_match, decl_src = decl_match[tpl_idx], decl_src[tpl_idx]
+        sym_match, sym_src = sym_match[tpl_idx], sym_src[tpl_idx]
 
     return AffinityTensors(
         node_dom=node_dom,

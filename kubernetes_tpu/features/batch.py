@@ -192,6 +192,40 @@ def _selector_matches_pod_labels(sel, labels: dict[str, str]) -> bool:
     return False
 
 
+def pod_template_key(pod: api.Pod) -> tuple:
+    """Equivalence-class key: every field compile_batch/compile_affinity
+    reads, except the pod's identity (name/uid).  Controller-stamped pods
+    share one key, so per-pod feature rows compile once per template — the
+    batched analogue of the reference's per-pod predicateMetadata memo
+    (predicates.go:71-98) extended across pods, exploiting that a
+    controller's pods are spec-identical.  Cached on the pod (specs are
+    immutable once submitted)."""
+    k = getattr(pod, "_tpl_key", None)
+    if k is not None:
+        return k
+    ann = pod.annotations
+    lab = pod.labels
+    nsel = pod.node_selector
+    k = (
+        pod.namespace, pod.node_name, pod.deletion_timestamp is not None,
+        tuple(sorted(lab.items())) if len(lab) > 1 else tuple(lab.items()),
+        tuple(sorted(nsel.items())) if len(nsel) > 1 else tuple(nsel.items()),
+        (ann.get(api.AFFINITY_ANNOTATION_KEY, ""),
+         ann.get(api.TOLERATIONS_ANNOTATION_KEY, "")) if ann else ("", ""),
+        tuple((c.image,
+               tuple(sorted((k_, str(v)) for k_, v in c.requests.items())),
+               tuple(sorted(c.limits)),
+               tuple(p.host_port for p in c.ports if p.host_port))
+              for c in pod.containers),
+        tuple((v.gce_pd_name, v.gce_read_only, v.aws_ebs_id, v.aws_read_only,
+               v.rbd_key, v.rbd_read_only, v.iscsi_key, v.iscsi_read_only,
+               v.nfs_key, v.nfs_read_only, v.pvc_claim_name)
+              for v in pod.volumes) if pod.volumes else (),
+    )
+    pod._tpl_key = k
+    return k
+
+
 # Lister signature: pod -> list of selector objects (dict for services/RCs,
 # LabelSelector for ReplicaSets) matching it.
 SpreadSelectors = Callable[[api.Pod], list]
@@ -232,8 +266,23 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
     p = len(pods)
     n = nt.n
 
+    # Group the batch into spec-identical templates; all per-pod rows are
+    # compiled once per template and gathered back to [P, ...] at the end.
+    tpl_of: dict[tuple, int] = {}
+    reps: list[api.Pod] = []
+    tpl_idx = np.empty(p, np.int64)
+    for i, pod in enumerate(pods):
+        k = pod_template_key(pod)
+        ti = tpl_of.get(k)
+        if ti is None:
+            ti = len(reps)
+            tpl_of[k] = ti
+            reps.append(pod)
+        tpl_idx[i] = ti
+    t = len(reps)
+
     # Intern everything first so capacities are final.
-    for pod in pods:
+    for pod in reps:
         for port in pod.used_host_ports():
             space.ports.id(str(port))
         for v in pod.volumes:
@@ -243,19 +292,19 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
             if c.image:
                 space.images.id(c.image)
 
-    request = np.zeros((p, 4), np.int32)
-    nonzero = np.zeros((p, 2), np.int32)
-    zero_req = np.zeros(p, bool)
-    best_effort = np.zeros(p, bool)
-    host_idx = np.full(p, -1, np.int32)
-    ports = np.zeros((p, space.ports.capacity), bool)
-    vol_ro = np.zeros((p, space.volumes.capacity), bool)
-    vol_rw = np.zeros((p, space.volumes.capacity), bool)
-    tol_ns = np.zeros((p, space.taints.capacity), bool)
-    tol_pref = np.zeros((p, space.taints.capacity), bool)
-    has_tols = np.zeros(p, bool)
-    images = np.zeros((p, space.images.capacity), np.int32)
-    avoid_group = np.zeros(p, np.int32)
+    request = np.zeros((t, 4), np.int32)
+    nonzero = np.zeros((t, 2), np.int32)
+    zero_req = np.zeros(t, bool)
+    best_effort = np.zeros(t, bool)
+    host_idx = np.full(t, -1, np.int32)
+    ports = np.zeros((t, space.ports.capacity), bool)
+    vol_ro = np.zeros((t, space.volumes.capacity), bool)
+    vol_rw = np.zeros((t, space.volumes.capacity), bool)
+    tol_ns = np.zeros((t, space.taints.capacity), bool)
+    tol_pref = np.zeros((t, space.taints.capacity), bool)
+    has_tols = np.zeros(t, bool)
+    images = np.zeros((t, space.images.capacity), np.int32)
+    avoid_group = np.zeros(t, np.int32)
     avoid_rows_map: dict = {(): 0}
     avoid_rows: list[np.ndarray] = [np.zeros(n, bool)]
 
@@ -289,7 +338,7 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
     sel_sig_to_group: dict = {}
     sel_rows: list[np.ndarray] = []
     pref_rows: list[np.ndarray] = []
-    sel_group = np.zeros(p, np.int32)
+    sel_group = np.zeros(t, np.int32)
     # Lister lookups memoized by (namespace, labels): controller-stamped
     # pods share both, and the listers answer from labels alone.
     _sel_memo: dict = {}
@@ -306,9 +355,9 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
     spread_node_rows: list[np.ndarray] = []
     spread_zone_rows: list[np.ndarray] = []
     spread_has_zone: list[bool] = []
-    spread_group = np.zeros(p, np.int32)
+    spread_group = np.zeros(t, np.int32)
 
-    for i, pod in enumerate(pods):
+    for i, pod in enumerate(reps):
         request[i] = fc.pod_resource_row(pod)
         nonzero[i] = fc.pod_nonzero_row(pod)
         zero_req[i] = not (request[i, 0] or request[i, 1] or request[i, 2])
@@ -395,26 +444,31 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
     # In-batch increments: once pod i is placed it becomes an "existing pod"
     # for every later pod in the batch (the reference sees it via the assumed-
     # pod cache, cache.go:107).
-    spread_incr = np.zeros((p, S), bool)
+    spread_incr = np.zeros((t, S), bool)
     if spread_groups_meta:
-        incr_memo: dict = {}
-        for i, pod in enumerate(pods):
+        for i, pod in enumerate(reps):
             if pod.deletion_timestamp is not None:
                 continue
-            lkey = (pod.namespace, tuple(sorted(pod.labels.items())))
-            row = incr_memo.get(lkey)
-            if row is None:
-                row = np.zeros(S, bool)
-                for s, (ns, sels) in enumerate(spread_groups_meta):
-                    if ns == pod.namespace and any(
-                            _selector_matches_pod_labels(sel, pod.labels)
-                            for sel in sels):
-                        row[s] = True
-                incr_memo[lkey] = row
-            spread_incr[i] = row
+            for s, (ns, sels) in enumerate(spread_groups_meta):
+                if ns == pod.namespace and any(
+                        _selector_matches_pod_labels(sel, pod.labels)
+                        for sel in sels):
+                    spread_incr[i, s] = True
+
+    # Stamp the parsed/compiled per-pod caches from each pod's template rep
+    # so the assume path (cache.assume_pods -> aggregate updates) never
+    # re-parses quantities or affinity JSON for controller-stamped pods.
+    for pod, ti in zip(pods, tpl_idx.tolist()):
+        rep = reps[ti]
+        if rep is not pod:
+            pod._res_row = rep._res_row
+            pod._nz_row = rep._nz_row
+            pod._affinity = rep._affinity
+            pod._affinity_parsed = True
 
     aff = compile_affinity(pods, affinity_pods, ep, nodes, n, space,
-                           hard_pod_affinity_weight)
+                           hard_pod_affinity_weight,
+                           reps=reps, tpl_idx=tpl_idx)
     if volsvc is None:
         if nodes is not None:
             volsvc = compile_volsvc(pods, nodes, nt.schedulable)
@@ -422,15 +476,19 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
             volsvc = empty_volsvc(p, n)
 
     return PodBatch(
-        pods=list(pods), request=request, zero_request=zero_req, nonzero=nonzero,
-        best_effort=best_effort, host_idx=host_idx, ports=ports,
-        vol_ro=vol_ro, vol_rw=vol_rw, tol_nosched=tol_ns, tol_prefer=tol_pref,
-        has_tolerations=has_tols,
-        images=images, sel_group=sel_group, sel_required=sel_required,
-        sel_pref_counts=sel_pref, spread_group=spread_group,
+        pods=list(pods), request=request[tpl_idx],
+        zero_request=zero_req[tpl_idx], nonzero=nonzero[tpl_idx],
+        best_effort=best_effort[tpl_idx], host_idx=host_idx[tpl_idx],
+        ports=ports[tpl_idx],
+        vol_ro=vol_ro[tpl_idx], vol_rw=vol_rw[tpl_idx],
+        tol_nosched=tol_ns[tpl_idx], tol_prefer=tol_pref[tpl_idx],
+        has_tolerations=has_tols[tpl_idx],
+        images=images[tpl_idx], sel_group=sel_group[tpl_idx],
+        sel_required=sel_required, sel_pref_counts=sel_pref,
+        spread_group=spread_group[tpl_idx],
         spread_node_counts=sp_n, spread_zone_counts=sp_z,
-        spread_has_zones=sp_hz, spread_incr=spread_incr,
-        node_zone_id=node_zone_id, avoid_group=avoid_group,
+        spread_has_zones=sp_hz, spread_incr=spread_incr[tpl_idx],
+        node_zone_id=node_zone_id, avoid_group=avoid_group[tpl_idx],
         avoid_rows=np.stack(avoid_rows), aff=aff, volsvc=volsvc)
 
 

@@ -202,14 +202,27 @@ def compile_nodes(nodes: Sequence[api.Node], space: FeatureSpace) -> NodeTensors
 
 
 def pod_resource_row(pod: api.Pod) -> np.ndarray:
-    """[4] int32 (cpu, mem_mib ceil, gpu, 1) — getResourceRequest."""
-    r = pod.resource_request()
-    return np.array([r.milli_cpu, _mib_ceil(r.memory), r.nvidia_gpu, 1], np.int32)
+    """[4] int32 (cpu, mem_mib ceil, gpu, 1) — getResourceRequest.
+
+    Cached on the pod: quantity-string parsing dominates at 30k-pod batches
+    and pod specs are immutable once submitted (the reference's
+    predicateMetadata makes the same assumption, predicates.go:71-98)."""
+    row = getattr(pod, "_res_row", None)
+    if row is None:
+        r = pod.resource_request()
+        row = np.array([r.milli_cpu, _mib_ceil(r.memory), r.nvidia_gpu, 1],
+                       np.int32)
+        pod._res_row = row
+    return row
 
 
 def pod_nonzero_row(pod: api.Pod) -> np.ndarray:
-    cpu, mem = pod.non_zero_request()
-    return np.array([cpu, _mib_ceil(mem)], np.int32)
+    row = getattr(pod, "_nz_row", None)
+    if row is None:
+        cpu, mem = pod.non_zero_request()
+        row = np.array([cpu, _mib_ceil(mem)], np.int32)
+        pod._nz_row = row
+    return row
 
 
 def empty_aggregates(n: int, space: FeatureSpace) -> NodeAggregates:
@@ -379,11 +392,26 @@ def existing_pods_add(ep: ExistingPodTensors, pod: api.Pod, node_idx: int,
 def existing_pods_add_bulk(ep: ExistingPodTensors, pods: Sequence[api.Pod],
                            node_idxs: Sequence[int],
                            space: FeatureSpace) -> ExistingPodTensors:
-    """Bulk existing_pods_add: one growth pass + vectorized row writes."""
+    """Bulk existing_pods_add: one growth pass + vectorized row writes.
+    Label-column ids are memoized per pod template (controller-stamped pods
+    share labels)."""
+    col_memo: dict = {}
+
+    def label_cols(pod: api.Pod) -> list[int]:
+        mk = getattr(pod, "_tpl_key", None) \
+            or (pod.namespace, tuple(sorted(pod.labels.items())))
+        cl = col_memo.get(mk)
+        if cl is None:
+            cl = []
+            for k, v in pod.labels.items():
+                cl.append(space.pod_labels.kv_id(k, v))
+                cl.append(space.pod_labels.key_id(k))
+            col_memo[mk] = cl
+        return cl
+
     for pod in pods:
-        for k, v in pod.labels.items():
-            space.pod_labels.kv_id(k, v)
-            space.pod_labels.key_id(k)
+        if pod.labels:
+            label_cols(pod)  # intern before growth
     ep.labels = _grow_cols(ep.labels, space.pod_labels.capacity)
     need = sum(1 for p in pods if p.key not in ep.key_to_slot)
     while len(ep.free_slots) < need:
@@ -406,11 +434,10 @@ def existing_pods_add_bulk(ep: ExistingPodTensors, pods: Sequence[api.Pod],
     ep.labels[slots] = False
     rows, cols = [], []
     for i, pod in enumerate(pods):
-        for k, v in pod.labels.items():
-            rows.append(slots[i])
-            cols.append(space.pod_labels.kv_id(k, v))
-            rows.append(slots[i])
-            cols.append(space.pod_labels.key_id(k))
+        if pod.labels:
+            cl = label_cols(pod)
+            cols.extend(cl)
+            rows.extend([slots[i]] * len(cl))
     if rows:
         ep.labels[rows, cols] = True
     ep.ns_id[slots] = [space.namespaces.id(p.namespace) for p in pods]

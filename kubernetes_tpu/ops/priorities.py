@@ -155,6 +155,21 @@ def selector_spread(spread_group: jnp.ndarray, spread_node_counts: jnp.ndarray,
     return _trunc(f)
 
 
+def selector_spread_node_only(spread_group: jnp.ndarray,
+                              spread_node_counts: jnp.ndarray,
+                              schedulable: jnp.ndarray) -> jnp.ndarray:
+    """selector_spread when no group is zone-aware (has_zones all False and
+    zone counts all zero): the zone-blended arm is never taken, so only the
+    node-count term remains (selector_spreading.go:137-156)."""
+    counts = spread_node_counts[spread_group]  # [P,N] f32
+    max_count = jnp.max(jnp.where(schedulable[None, :], counts, 0.0),
+                        axis=1, keepdims=True)
+    f = jnp.where(max_count > 0,
+                  10.0 * ((max_count - counts) / jnp.maximum(max_count, 1e-9)),
+                  10.0)
+    return _trunc(f)
+
+
 # image_locality.go constants in KiB (priorities.go:199-203: 23 MB / 1000 MB
 # with mb = 1024*1024 bytes).
 _MIN_IMG_KIB = 23 * 1024

@@ -56,7 +56,13 @@ def density(num_nodes: int, num_pods: int, profile: str = "uniform",
     if warm:
         # Pre-trace the device program at the batch shape (first XLA compile
         # is excluded like the reference excludes apiserver warmup).
-        daemon.config.algorithm.schedule_batch(pods[:num_pods])
+        alg = daemon.config.algorithm
+        if num_pods >= daemon.STREAM_THRESHOLD and not alg.extenders:
+            for _ in alg.schedule_batch_stream(
+                    pods, chunk_size=daemon.stream_chunk_size()):
+                pass
+        else:
+            alg.schedule_batch(pods)
     for pod in pods:
         daemon.enqueue(pod)
     start = time.perf_counter()

@@ -43,6 +43,21 @@ class InMemoryBinder:
                     f"pod {pod.key} is already assigned to node {current}")
             self._bound[pod.key] = node_name
 
+    def bind_many(self, bindings: list[tuple[api.Pod, str]]
+                  ) -> list[tuple[api.Pod, str]]:
+        """Per-pod CAS under one lock acquisition.  Returns the conflicts as
+        (pod, current_node) — same semantics as bind() raising per pod."""
+        conflicts = []
+        with self._lock:
+            bound = self._bound
+            for pod, node_name in bindings:
+                current = bound.get(pod.key, "")
+                if current:
+                    conflicts.append((pod, current))
+                else:
+                    bound[pod.key] = node_name
+        return conflicts
+
     def bound_node(self, pod_key: str) -> Optional[str]:
         with self._lock:
             return self._bound.get(pod_key)

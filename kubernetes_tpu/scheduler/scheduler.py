@@ -13,6 +13,7 @@ device round-trips.
 from __future__ import annotations
 
 import heapq
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -89,6 +90,15 @@ class Scheduler:
 
     # -- batched path (the TPU drain) ------------------------------------
 
+    # Queue sizes past this drain through the chunked device pipeline
+    # (assume/bind of chunk k overlaps the device scan of chunk k+1).
+    # Off by default: measured on the tunneled v5e, each executable launch
+    # costs ~250 ms, so one big scan beats any multi-launch pipeline; on
+    # locally-attached chips (launch ~1 ms) set KT_STREAM_CHUNK to e.g.
+    # 4096 and the pipeline wins.
+    STREAM_THRESHOLD = int(os.environ.get("KT_STREAM_CHUNK", "0") or "0") \
+        or (1 << 62)
+
     def schedule_pending(self, wait_first: bool = True,
                          timeout: Optional[float] = None) -> int:
         """Drain the queue and solve it as one device batch.  Returns the
@@ -96,34 +106,65 @@ class Scheduler:
         pods = self.queue.pop_all(wait_first=wait_first, timeout=timeout)
         if not pods:
             return 0
+        if len(pods) >= self.STREAM_THRESHOLD and \
+                not self.config.algorithm.extenders:
+            return self._schedule_pending_stream(pods)
         start = time.perf_counter()
         placements = self.config.algorithm.schedule_batch(pods)
         algo_us = (time.perf_counter() - start) * 1e6 / len(pods)
-        for _ in pods:
-            self.config.metrics.scheduling_algorithm_latency.observe(algo_us)
+        self.config.metrics.scheduling_algorithm_latency.observe_many(
+            algo_us, len(pods))
+        self._assume_and_bind_batch(pods, placements, start)
+        return len(pods)
+
+    def _assume_and_bind_batch(self, pods: list[api.Pod],
+                               placements: list, start: float) -> None:
+        """Bulk assume (vectorized), then bind; failures forget + requeue.
+        Already-cached pods are skipped, matching the single-pod loop's
+        log-and-proceed on assume errors (scheduler.go:116-120)."""
         placed = [(pod, dest) for pod, dest in zip(pods, placements)
                   if dest is not None]
-        # Bulk assume (vectorized), then bind; failures forget + requeue.
-        # Already-cached pods are skipped, matching the single-pod loop's
-        # log-and-proceed on assume errors (scheduler.go:116-120).
         skipped = set(self.config.algorithm.cache.assume_pods(
-            placed, strict=False))
-        placed = [(pod, dest) for pod, dest in placed
-                  if pod.key not in skipped]
+            placed, strict=False,
+            agg_handoff=self.config.algorithm.take_agg_handoff()))
+        if skipped:
+            placed = [(pod, dest) for pod, dest in placed
+                      if pod.key not in skipped]
         for pod, dest in zip(pods, placements):
             if dest is None:
                 self._handle_failure(
                     pod, "FailedScheduling",
                     f"pod ({pod.name}) failed to fit in any node")
-        def bind_all():
-            for pod, dest in placed:
-                self._bind_assumed(pod, dest, start)
         if self.config.async_bind:
-            t = threading.Thread(target=bind_all, daemon=True)
+            t = threading.Thread(target=self._bind_assumed_batch,
+                                 args=(placed, start), daemon=True)
             t.start()
             self._bind_threads.append(t)
         else:
-            bind_all()
+            self._bind_assumed_batch(placed, start)
+
+    def stream_chunk_size(self) -> int:
+        """Chunk size the streamed drain compiles at (harness warmup must
+        pre-trace the same shape)."""
+        return min(self.STREAM_THRESHOLD, 8192)
+
+    def _schedule_pending_stream(self, pods: list[api.Pod]) -> int:
+        """The pipelined drain: as each device chunk lands, bulk-assume it
+        and hand it to an async binder thread while the device scans the
+        next chunk.  Same observable state machine as the one-shot path."""
+        start = time.perf_counter()
+        solve_done = start
+        for chunk_pods, placements in \
+                self.config.algorithm.schedule_batch_stream(
+                    pods, chunk_size=self.stream_chunk_size()):
+            solve_done = time.perf_counter()
+            self._assume_and_bind_batch(chunk_pods, placements, start)
+        # Algorithm latency spans until the LAST chunk's results landed
+        # (interleaved assume/bind of earlier chunks overlaps the device
+        # and is deliberately excluded, matching the one-shot path).
+        algo_us = (solve_done - start) * 1e6 / len(pods)
+        self.config.metrics.scheduling_algorithm_latency.observe_many(
+            algo_us, len(pods))
         return len(pods)
 
     # -- run loops --------------------------------------------------------
@@ -195,6 +236,50 @@ class Scheduler:
         self.config.recorder.eventf(
             pod.key, "Normal", "Scheduled",
             f"Successfully assigned {pod.name} to {dest}")
+
+    def _bind_assumed_batch(self, placed: list[tuple[api.Pod, str]],
+                            start: float) -> None:
+        """Bind a solved batch: per-pod CAS binds (conflicts forget +
+        requeue exactly like _bind_assumed), with the per-pod metric
+        observations amortized into one bucket pass each."""
+        cache = self.config.algorithm.cache
+        recorder = self.config.recorder
+        bind_start = time.perf_counter()
+        bind_many = getattr(self.config.binder, "bind_many", None)
+        if bind_many is not None:
+            conflicted = {pod.key for pod, _ in bind_many(placed)}
+            ok = 0
+            items = []
+            for pod, dest in placed:
+                if pod.key in conflicted:
+                    cache.forget_pod(pod)
+                    self._handle_failure(
+                        pod, "FailedScheduling",
+                        f"Binding rejected: pod {pod.key} already bound")
+                else:
+                    ok += 1
+                    items.append((pod.key, "Normal", "Scheduled",
+                                  f"Successfully assigned {pod.name} to {dest}"))
+            recorder.eventf_many(items)
+        else:
+            ok = 0
+            for pod, dest in placed:
+                try:
+                    self.config.binder.bind(pod, dest)
+                except Exception as err:  # noqa: BLE001 — bind errors requeue
+                    cache.forget_pod(pod)
+                    self._handle_failure(pod, "FailedScheduling",
+                                         f"Binding rejected: {err}")
+                    continue
+                ok += 1
+                recorder.eventf(
+                    pod.key, "Normal", "Scheduled",
+                    f"Successfully assigned {pod.name} to {dest}")
+        done = time.perf_counter()
+        self.config.metrics.binding_latency.observe_many(
+            (done - bind_start) * 1e6 / max(len(placed), 1), ok)
+        self.config.metrics.e2e_scheduling_latency.observe_many(
+            (done - start) * 1e6, ok)
 
     def _handle_failure(self, pod: api.Pod, reason: str, message: str) -> None:
         """Event + condition update + backoff requeue (factory.go:512-556)."""

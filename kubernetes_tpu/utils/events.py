@@ -34,6 +34,21 @@ class EventRecorder:
         if self._sink is not None:
             self._sink(ev)
 
+    def eventf_many(self, items: list[tuple[str, str, str, str]]) -> None:
+        """Bulk eventf: one timestamp + one lock acquisition for a solved
+        batch.  With no sink attached, only the ring's capacity worth of
+        events is materialized (the ring would drop the rest anyway — the
+        reference's broadcaster also drops under load, record/event.go)."""
+        if self._sink is None and len(items) > self._events.maxlen:
+            items = items[-self._events.maxlen:]
+        now = time.time()
+        evs = [Event(k, t, r, m, now) for k, t, r, m in items]
+        with self._lock:
+            self._events.extend(evs)
+        if self._sink is not None:
+            for ev in evs:
+                self._sink(ev)
+
     def events(self, object_key: str | None = None) -> list[Event]:
         with self._lock:
             evs = list(self._events)

@@ -32,6 +32,19 @@ class Histogram:
                 if value <= upper:
                     self._counts[i] += 1
 
+    def observe_many(self, value: float, count: int) -> None:
+        """``count`` observations of the same value in one bucket pass —
+        the batched drain amortizes one solve across the whole batch, so
+        every pod records the same per-pod latency."""
+        if count <= 0:
+            return
+        with self._lock:
+            self._sum += value * count
+            self._count += count
+            for i, upper in enumerate(self.uppers):
+                if value <= upper:
+                    self._counts[i] += count
+
     def expose(self) -> str:
         with self._lock:
             lines = [f"# HELP {self.name} {self.help}",
