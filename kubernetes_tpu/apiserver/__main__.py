@@ -1,0 +1,34 @@
+"""Standalone apiserver: ``python -m kubernetes_tpu.apiserver --port 8080``
+serves the MemStore-backed HTTP surface (the in-process master the perf rig
+uses, run as its own process — test/integration/framework/master_utils.go
+RunAMaster's role)."""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.apiserver.server import serve
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kube-apiserver (kubernetes_tpu)")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--host", default="127.0.0.1")
+    opts = p.parse_args(argv)
+    server = serve(MemStore(), port=opts.port, host=opts.host)
+    print(f"apiserver listening on {server.server_address[0]}:"
+          f"{server.server_address[1]}", file=sys.stderr, flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

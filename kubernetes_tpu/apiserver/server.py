@@ -165,7 +165,10 @@ def make_handler(store: MemStore):
                 else:
                     self._send_json(404, {"error": "unknown path"})
                     return
-                updated = store.update(kind, body)
+                # GuaranteedUpdate semantics: a submitted resourceVersion is
+                # a CAS precondition (pkg/storage/etcd/etcd_helper.go).
+                rv = (body.get("metadata") or {}).get("resourceVersion")
+                updated = store.update(kind, body, expected_rv=rv)
                 self._send_json(200, updated)
             except ConflictError as err:
                 self._send_json(409, {"error": str(err)})

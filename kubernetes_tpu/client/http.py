@@ -1,0 +1,177 @@
+"""HTTP apiserver client: rate-limited REST verbs + chunked watch streams.
+
+The reference's client stack is ``pkg/client/restclient`` (QPS/Burst
+rate-limited REST) under ``pkg/client/cache/listwatch.go`` (ListFunc/
+WatchFunc against ``/api/v1/...``).  This module is that stack for the
+kubernetes_tpu apiserver surface (apiserver/server.py): JSON verbs, list at
+a resourceVersion, and a newline-delimited-JSON chunked watch that raises
+``TooOldError`` on 410 Gone so the reflector relists — reflector.go's
+ListAndWatch contract.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+from kubernetes_tpu.apiserver.memstore import (ConflictError, Event,
+                                               TooOldError)
+from kubernetes_tpu.utils.flowcontrol import TokenBucketRateLimiter
+
+DEFAULT_QPS = 5.0     # restclient/config.go:186 (perf rigs raise to 5000)
+DEFAULT_BURST = 10    # restclient/config.go:190
+
+
+class APIError(Exception):
+    def __init__(self, status: int, message: str = ""):
+        self.status = status
+        super().__init__(f"HTTP {status}: {message}")
+
+
+class APIClient:
+    """Rate-limited JSON client for the apiserver HTTP surface."""
+
+    _NAMESPACED = {"pods", "services"}
+
+    def __init__(self, base_url: str, qps: float = DEFAULT_QPS,
+                 burst: int = DEFAULT_BURST, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self.limiter = TokenBucketRateLimiter(qps, burst)
+
+    # -- verbs -----------------------------------------------------------
+
+    def _request(self, method: str, path: str,
+                 obj: Optional[dict] = None) -> dict:
+        self.limiter.accept()
+        data = json.dumps(obj).encode() if obj is not None else None
+        req = urllib.request.Request(
+            f"{self.base_url}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"} if data else {})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as err:
+            body = err.read().decode(errors="replace")
+            if err.code == 409:
+                raise ConflictError(body) from err
+            if err.code == 410:
+                raise TooOldError(body) from err
+            raise APIError(err.code, body) from err
+
+    def _object_path(self, kind: str, key: str) -> str:
+        if kind in self._NAMESPACED or "/" in key:
+            ns, _, name = key.partition("/")
+            return f"/api/v1/namespaces/{ns}/{kind}/{name}"
+        return f"/api/v1/{kind}/{key}"
+
+    def get(self, kind: str, key: str) -> Optional[dict]:
+        try:
+            return self._request("GET", self._object_path(kind, key))
+        except APIError as err:
+            if err.status == 404:
+                return None
+            raise
+
+    def create(self, kind: str, obj: dict) -> dict:
+        return self._request("POST", f"/api/v1/{kind}", obj)
+
+    def update(self, kind: str, obj: dict) -> dict:
+        key = (obj.get("metadata") or {}).get("namespace", "")
+        name = (obj.get("metadata") or {}).get("name", "")
+        key = f"{key}/{name}" if key else name
+        return self._request("PUT", self._object_path(kind, key), obj)
+
+    def delete(self, kind: str, key: str) -> None:
+        self._request("DELETE", self._object_path(kind, key))
+
+    def bind(self, namespace: str, pod_name: str, node_name: str) -> None:
+        """POST the Binding subresource (factory.go:576-587)."""
+        self._request("POST", f"/api/v1/namespaces/{namespace}/bindings", {
+            "apiVersion": "v1", "kind": "Binding",
+            "metadata": {"name": pod_name, "namespace": namespace},
+            "target": {"apiVersion": "v1", "kind": "Node",
+                       "name": node_name}})
+
+    # -- list + watch ----------------------------------------------------
+
+    def list(self, kind: str,
+             selector: Optional[Callable[[dict], bool]] = None
+             ) -> tuple[list[dict], int]:
+        obj = self._request("GET", f"/api/v1/{kind}")
+        items = obj.get("items") or []
+        if selector is not None:
+            items = [o for o in items if selector(o)]
+        rv = int((obj.get("metadata") or {}).get("resourceVersion", "0"))
+        return items, rv
+
+    def watch(self, kind: str, from_rv: int) -> "HTTPWatcher":
+        """Open a chunked watch stream; TooOldError on 410 forces relist."""
+        self.limiter.accept()
+        return HTTPWatcher(
+            f"{self.base_url}/api/v1/{kind}?watch=1&resourceVersion={from_rv}",
+            kind)
+
+
+class HTTPWatcher:
+    """Reads newline-delimited JSON events off a chunked watch response in a
+    thread; ``next(timeout)``/``stop()`` mirror the memstore Watcher so the
+    Reflector is transport-agnostic."""
+
+    def __init__(self, url: str, kind: str):
+        self.kind = kind
+        self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
+        self._stopped = threading.Event()
+        req = urllib.request.Request(url)
+        try:
+            self._resp = urllib.request.urlopen(req)  # streams; no timeout
+        except urllib.error.HTTPError as err:
+            if err.code == 410:
+                raise TooOldError(err.read().decode(errors="replace")) from err
+            raise APIError(err.code, err.read().decode(errors="replace")) \
+                from err
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name=f"watch-{kind}")
+        self._thread.start()
+
+    def _pump(self) -> None:
+        try:
+            for line in self._resp:
+                if self._stopped.is_set():
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                obj = d.get("object") or {}
+                meta = obj.get("metadata") or {}
+                ns = meta.get("namespace")
+                key = f"{ns}/{meta.get('name')}" if ns else meta.get("name")
+                self._q.put(Event(
+                    type=d.get("type", ""), kind=self.kind, key=key or "",
+                    object=obj,
+                    rv=int(meta.get("resourceVersion", "0") or "0")))
+        except Exception:  # noqa: BLE001 — stream died: deliver EOF
+            pass
+        finally:
+            # EOF: a typed ERROR event (not None, which next() also returns
+            # on timeout) tells the reflector to drop the stream and relist.
+            self._q.put(Event(type="ERROR", kind=self.kind, key="",
+                              object={}, rv=0))
+
+    def next(self, timeout: Optional[float] = None) -> Optional[Event]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._resp.close()
+        except Exception:  # noqa: BLE001
+            pass

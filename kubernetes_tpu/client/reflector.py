@@ -4,8 +4,13 @@ reflector.go:56 ListAndWatch).
 The contract the scheduler's factory relies on (factory.go:128-149,
 387-416): list at a resourceVersion, deliver every object as an ADDED
 handler call, then stream watch events from that version; on a 410-Gone
-(window fell behind) or watch error, relist from scratch.  Handlers receive
-(event_type, object_dict)."""
+(window fell behind), a watch error, or stream EOF, relist from scratch.
+Handlers receive (event_type, object_dict).
+
+Transport-agnostic: ``source`` may be the in-process MemStore or an HTTP
+``client.http.APIClient`` — both expose list(kind, selector) and a watcher
+with next()/stop(); the HTTP watcher additionally emits a typed ERROR event
+when the chunked stream dies, which triggers the relist path."""
 
 from __future__ import annotations
 
@@ -18,9 +23,9 @@ Handler = Callable[[str, dict], None]
 
 
 class Reflector:
-    def __init__(self, store: MemStore, kind: str, handler: Handler,
+    def __init__(self, source, kind: str, handler: Handler,
                  selector: Optional[Callable[[dict], bool]] = None):
-        self.store = store
+        self.source = source
         self.kind = kind
         self.handler = handler
         self.selector = selector
@@ -28,10 +33,20 @@ class Reflector:
         self._synced = threading.Event()
         self._known: dict[str, dict] = {}  # key -> last delivered object
 
+    # Back-compat alias (round-1 callers constructed with store=).
+    @property
+    def store(self):
+        return self.source
+
+    def _open_watch(self, rv: int):
+        if isinstance(self.source, MemStore):
+            return self.source.watch([self.kind], rv)
+        return self.source.watch(self.kind, rv)
+
     def _list(self) -> int:
         """Replace semantics (cache.Store.Replace): objects that vanished
         while the watch was down are surfaced as DELETED on relist."""
-        items, rv = self.store.list(self.kind, self.selector)
+        items, rv = self.source.list(self.kind, self.selector)
         fresh = {MemStore.object_key(obj): obj for obj in items}
         for key, obj in list(self._known.items()):
             if key not in fresh:
@@ -48,14 +63,19 @@ class Reflector:
             while not self._stop.is_set():
                 try:
                     rv = self._list()
-                    watcher = self.store.watch([self.kind], rv)
+                    watcher = self._open_watch(rv)
                 except TooOldError:
+                    continue
+                except Exception:  # noqa: BLE001 — apiserver down: retry
+                    self._stop.wait(1.0)
                     continue
                 try:
                     while not self._stop.is_set():
                         ev = watcher.next(timeout=0.1)
                         if ev is None:
                             continue
+                        if ev.type == "ERROR":
+                            break  # stream died: relist (reflector.go:232)
                         if ev.type == "DELETED" or (
                                 self.selector is not None
                                 and not self.selector(ev.object)):

@@ -25,7 +25,10 @@ from kubernetes_tpu.engine import solver as sv
 from kubernetes_tpu.engine.extender_client import ExtenderError, HTTPExtender
 from kubernetes_tpu.features import batch as fb
 from kubernetes_tpu.features.volumes import compile_volsvc
+from kubernetes_tpu.utils.logging import get_logger
 from kubernetes_tpu.utils.trace import Trace
+
+log = get_logger("engine")
 
 
 class FitError(Exception):
@@ -162,7 +165,8 @@ class GenericScheduler:
         trace = Trace(f"Scheduling {pod.namespace}/{pod.name}")
         batch, db, dc, nt = self._compile([pod])
         trace.step("Computing predicates & priorities")
-        feasible, scores = self.solver.evaluate(db, dc)
+        feasible, scores = self.solver.evaluate(db, dc,
+                                                sv.batch_flags(batch))
         trace.step("Selecting host")
         feasible_np = np.asarray(feasible[0])
         if not feasible_np.any():
@@ -234,6 +238,11 @@ class GenericScheduler:
             return self._schedule_batch_via_extenders(pods)
         batch, db, dc, nt = self._compile(pods)
         flags = sv.batch_flags(batch)
+        if log.isEnabledFor(10):
+            log.debug("schedule_batch: %d pods (%d templates) x %d nodes, "
+                      "joint=%s flags=%s", len(pods),
+                      len({getattr(p, "_tpl_key", None) for p in pods}),
+                      dc.alloc.shape[0], joint, flags)
         self._agg_handoff = None
         if joint:
             choices, new_last, _ = self.solver.solve_joint(

@@ -226,33 +226,35 @@ def _pad_cols(a: np.ndarray, width: int) -> np.ndarray:
 
 
 def device_batch(b: PodBatch) -> DeviceBatch:
-    parts = [jnp.asarray(getattr(b, f)) for f in DeviceBatch._fields
+    parts = [getattr(b, f) for f in DeviceBatch._fields
              if f not in ("aff", "volsvc")]
-    aff = DeviceAffinity(*[jnp.asarray(getattr(b.aff, f))
+    aff = DeviceAffinity(*[getattr(b.aff, f)
                            for f in DeviceAffinity._fields])
-    volsvc = DeviceVolSvc(*[jnp.asarray(getattr(b.volsvc, f))
+    volsvc = DeviceVolSvc(*[getattr(b.volsvc, f)
                             for f in DeviceVolSvc._fields])
-    return DeviceBatch(*parts, aff=aff, volsvc=volsvc)
+    # One batched device_put for the whole pytree (~70 arrays): per-array
+    # transfer calls dominate small-batch compiles otherwise.
+    return jax.device_put(DeviceBatch(*parts, aff=aff, volsvc=volsvc))
 
 
 def device_cluster(nt: NodeTensors, agg: NodeAggregates,
                    space: FeatureSpace) -> DeviceCluster:
     """Assemble device cluster state, padding aggregate columns to current
     vocabulary capacities (pods may have interned new ports/volumes)."""
-    return DeviceCluster(
-        schedulable=jnp.asarray(nt.schedulable),
-        alloc=jnp.asarray(nt.alloc),
-        requested=jnp.asarray(agg.requested),
-        nonzero=jnp.asarray(agg.nonzero),
-        ports_used=jnp.asarray(_pad_cols(agg.ports_used, space.ports.capacity)),
-        vol_any=jnp.asarray(_pad_cols(agg.vol_any, space.volumes.capacity)),
-        vol_rw=jnp.asarray(_pad_cols(agg.vol_rw, space.volumes.capacity)),
-        taints_nosched=jnp.asarray(nt.taints_nosched),
-        taints_prefer=jnp.asarray(nt.taints_prefer),
-        has_taints=jnp.asarray(nt.taints_nosched.any(1) | nt.taints_prefer.any(1)),
-        mem_pressure=jnp.asarray(nt.mem_pressure),
-        disk_pressure=jnp.asarray(nt.disk_pressure),
-        image_kib=jnp.asarray(_pad_cols(nt.image_kib, space.images.capacity)))
+    return jax.device_put(DeviceCluster(
+        schedulable=nt.schedulable,
+        alloc=nt.alloc,
+        requested=agg.requested,
+        nonzero=agg.nonzero,
+        ports_used=_pad_cols(agg.ports_used, space.ports.capacity),
+        vol_any=_pad_cols(agg.vol_any, space.volumes.capacity),
+        vol_rw=_pad_cols(agg.vol_rw, space.volumes.capacity),
+        taints_nosched=nt.taints_nosched,
+        taints_prefer=nt.taints_prefer,
+        has_taints=nt.taints_nosched.any(1) | nt.taints_prefer.any(1),
+        mem_pressure=nt.mem_pressure,
+        disk_pressure=nt.disk_pressure,
+        image_kib=_pad_cols(nt.image_kib, space.images.capacity)))
 
 
 def _predicate_mask(name: str, b: DeviceBatch, c: DeviceCluster,
@@ -392,18 +394,39 @@ class Solver:
         return {name: _predicate_mask(name, b, c, n, self.extra)
                 for name in self.predicate_names}
 
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def evaluate(self, b: DeviceBatch, c: DeviceCluster
+    @functools.partial(jax.jit, static_argnums=(0, 3))
+    def evaluate(self, b: DeviceBatch, c: DeviceCluster,
+                 flags: BatchFlags = ALL_ON_FLAGS
                  ) -> tuple[jnp.ndarray, jnp.ndarray]:
-        """(feasible [P,N] bool, scores [P,N] f32) against current state."""
+        """(feasible [P,N] bool, scores [P,N] f32) against current state.
+
+        ``flags`` (content-derived, see batch_flags) skips planes the batch
+        provably cannot trigger — an all-pass mask or all-zero plane — which
+        matters because per-kernel dispatch overhead, not FLOPs, dominates
+        small-batch evaluation."""
         n = c.alloc.shape[0]
+        skip_preds = set()
+        if not flags.any_ports:
+            skip_preds |= {"PodFitsHostPorts", "PodFitsPorts"}
+        if not flags.any_volumes:
+            skip_preds.add("NoDiskConflict")
+        if not flags.any_ebs:
+            skip_preds.add("MaxEBSVolumeCount")
+        if not flags.any_gce:
+            skip_preds.add("MaxGCEPDVolumeCount")
+        if not flags.any_affinity_pred:
+            skip_preds.add("MatchInterPodAffinity")
         # Unready nodes are filtered before scheduling (factory.go:436-462).
         feasible = jnp.broadcast_to(c.schedulable[None, :],
                                     (b.request.shape[0], n))
         for name in self.predicate_names:
-            feasible &= _predicate_mask(name, b, c, n, self.extra)
+            if name not in skip_preds:
+                feasible &= _predicate_mask(name, b, c, n, self.extra)
         scores = jnp.zeros((b.request.shape[0], n), jnp.float32)
         for name, weight, aux in self.priority_specs:
+            if name == "InterPodAffinityPriority" and \
+                    not flags.any_affinity_prio:
+                continue  # all counts provably zero -> score plane is zero
             scores += jnp.float32(weight) * \
                 _priority_plane(name, b, c, n, {"aux": aux})
         return feasible, scores

@@ -1,0 +1,193 @@
+"""The standalone scheduler daemon binary.
+
+``python -m kubernetes_tpu.scheduler --api-server http://... `` is the
+analogue of plugin/cmd/kube-scheduler (app/server.go:71-183): flag surface
+(options/options.go:55-77), policy-file load (server.go:165-183), an HTTP
+mux serving /healthz /metrics /configz (server.go:93-109), and an optional
+leader-election-wrapped run on an Endpoints annotation lease
+(server.go:142-159).  Without --api-server it runs against a fresh
+in-process MemStore + HTTP apiserver (--serve-apiserver), the all-in-one
+dev mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.api.policy import (cluster_autoscaler_provider,
+                                       default_provider, policy_from_json)
+from kubernetes_tpu.scheduler.factory import ConfigFactory
+from kubernetes_tpu.utils.leaderelection import (APIResourceLock,
+                                                 LeaderElector)
+from kubernetes_tpu.utils.logging import configure, get_logger
+
+log = get_logger("scheduler")
+
+DEFAULT_PORT = 10251  # options/options.go:49 SchedulerDefaultPort
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kube-scheduler (kubernetes_tpu)",
+        description="TPU-batched scheduler daemon; watches an apiserver and "
+                    "binds pods (plugin/cmd/kube-scheduler analogue)")
+    p.add_argument("--api-server", default="",
+                   help="apiserver base URL; empty runs an in-process "
+                        "MemStore control plane")
+    p.add_argument("--serve-apiserver", type=int, default=0, metavar="PORT",
+                   help="with no --api-server: also expose the in-process "
+                        "store over HTTP on this port (0 = off)")
+    p.add_argument("--port", type=int, default=DEFAULT_PORT,
+                   help="healthz/metrics/configz port (0 = ephemeral)")
+    p.add_argument("--algorithm-provider", default="DefaultProvider",
+                   choices=["DefaultProvider", "ClusterAutoscalerProvider"])
+    p.add_argument("--policy-config-file", default="",
+                   help="scheduler policy JSON (overrides the provider)")
+    p.add_argument("--scheduler-name", default=api.DEFAULT_SCHEDULER_NAME)
+    p.add_argument("--kube-api-qps", type=float, default=50.0)
+    p.add_argument("--kube-api-burst", type=int, default=100)
+    p.add_argument("--hard-pod-affinity-symmetric-weight", type=int,
+                   default=None)
+    p.add_argument("--leader-elect", action="store_true", default=False)
+    p.add_argument("--leader-elect-lease-duration", type=float, default=15.0)
+    p.add_argument("--leader-elect-renew-deadline", type=float, default=10.0)
+    p.add_argument("--leader-elect-retry-period", type=float, default=2.0)
+    p.add_argument("--v", type=int, default=None,
+                   help="log verbosity (glog-style; also KT_LOG_V)")
+    return p
+
+
+def load_policy(opts):
+    """createConfig (server.go:165-183): policy file beats provider; file
+    policies are validated (CreateFromConfig -> validation.ValidatePolicy)."""
+    if opts.policy_config_file:
+        from kubernetes_tpu.api.validation import validate_policy
+        with open(opts.policy_config_file) as f:
+            policy = policy_from_json(f.read())
+        validate_policy(policy)
+    elif opts.algorithm_provider == "ClusterAutoscalerProvider":
+        policy = cluster_autoscaler_provider()
+    else:
+        policy = default_provider()
+    if opts.hard_pod_affinity_symmetric_weight is not None:
+        policy.hard_pod_affinity_symmetric_weight = \
+            opts.hard_pod_affinity_symmetric_weight
+    return policy
+
+
+def _status_mux(factory: ConfigFactory, configz: dict, port: int
+                ) -> ThreadingHTTPServer:
+    """The daemon's own HTTP surface (server.go:93-109)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code: int, body: bytes,
+                  ctype: str = "text/plain") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, b"ok")
+            elif self.path == "/metrics":
+                self._send(200,
+                           factory.daemon.config.metrics.expose().encode())
+            elif self.path == "/configz":
+                self._send(200, json.dumps(configz).encode(),
+                           "application/json")
+            else:
+                self._send(404, b"not found")
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="scheduler-status-http").start()
+    return server
+
+
+def main(argv=None) -> int:
+    opts = build_parser().parse_args(argv)
+    configure(v=opts.v)
+    policy = load_policy(opts)
+    configz = {
+        "apiServer": opts.api_server or "(in-process)",
+        "algorithmProvider": opts.algorithm_provider,
+        "policyConfigFile": opts.policy_config_file,
+        "schedulerName": opts.scheduler_name,
+        "kubeAPIQPS": opts.kube_api_qps,
+        "kubeAPIBurst": opts.kube_api_burst,
+        "leaderElect": opts.leader_elect,
+        "predicates": [s.name for s in policy.predicates],
+        "priorities": [[s.name, s.weight] for s in policy.priorities],
+    }
+
+    if opts.api_server:
+        source = opts.api_server
+    else:
+        from kubernetes_tpu.apiserver.memstore import MemStore
+        source = MemStore()
+        if opts.serve_apiserver:
+            from kubernetes_tpu.apiserver.server import serve
+            serve(source, port=opts.serve_apiserver)
+            log.info("in-process apiserver on :%d", opts.serve_apiserver)
+
+    factory = ConfigFactory(source, policy=policy,
+                            scheduler_name=opts.scheduler_name,
+                            qps=opts.kube_api_qps, burst=opts.kube_api_burst)
+    mux = _status_mux(factory, configz, opts.port)
+    log.info("status http on :%d (healthz, metrics, configz)",
+             mux.server_address[1])
+
+    stop = threading.Event()
+
+    def shutdown(*_):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+
+    if opts.leader_elect:
+        identity = f"{socket.gethostname()}-{os.getpid()}"
+        lock = APIResourceLock(factory.store) if opts.api_server else None
+        if lock is None:
+            log.warning("--leader-elect without --api-server: using an "
+                        "in-process lock (single candidate)")
+            from kubernetes_tpu.utils.leaderelection import InMemoryLock
+            lock = InMemoryLock()
+        elector = LeaderElector(
+            lock=lock, identity=identity,
+            lease_duration=opts.leader_elect_lease_duration,
+            renew_deadline=opts.leader_elect_renew_deadline,
+            retry_period=opts.leader_elect_retry_period,
+            on_started_leading=lambda: (log.info("leading as %s", identity),
+                                        factory.run()),
+            on_stopped_leading=lambda: (log.warning("lost lease; exiting"),
+                                        stop.set()))
+        elector.run()
+        log.info("leader election: candidate %s", identity)
+    else:
+        factory.run()
+        log.info("scheduler loop running (no leader election)")
+
+    stop.wait()
+    factory.stop()
+    mux.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

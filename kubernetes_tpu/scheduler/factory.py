@@ -8,22 +8,31 @@ Three reflectors feed the daemon exactly as the reference's informers do:
 * assigned pods -> the scheduler cache (confirming assumed pods);
 * nodes -> the scheduler cache;
 
-plus services/PV/PVC listers kept fresh from the same store, the memstore
-CAS binder, and the 1s assumed-pod TTL sweep (cache.go:31).
-"""
+plus services/PV/PVC listers kept fresh from the same source, the CAS
+binder, and the 1s assumed-pod TTL sweep (cache.go:31).
+
+The apiserver source is either an in-process ``MemStore`` (integration/perf
+rigs, the reference's in-process master) or an HTTP base URL — the real
+process boundary: every list/watch/bind/status write then goes over the
+wire through a QPS/Burst rate-limited client (factory.go:77-91)."""
 
 from __future__ import annotations
 
 import threading
-from typing import Optional
+from typing import Optional, Union
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.policy import Policy
 from kubernetes_tpu.apiserver.memstore import ConflictError, MemStore
 from kubernetes_tpu.cache.scheduler_cache import CLEANUP_PERIOD
+from kubernetes_tpu.client.http import APIClient
 from kubernetes_tpu.client.reflector import Reflector
 from kubernetes_tpu.engine.generic_scheduler import GenericScheduler, Listers
 from kubernetes_tpu.scheduler.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.utils.events import EventRecorder
+from kubernetes_tpu.utils.logging import get_logger
+
+log = get_logger("factory")
 
 
 class MemStoreBinder:
@@ -34,6 +43,49 @@ class MemStoreBinder:
 
     def bind(self, pod: api.Pod, node_name: str) -> None:
         self.store.bind(pod.namespace, pod.name, node_name)
+
+
+class APIClientBinder:
+    """Binder over the wire (factory.go:576-587 POST bindings)."""
+
+    def __init__(self, client: APIClient):
+        self.client = client
+
+    def bind(self, pod: api.Pod, node_name: str) -> None:
+        self.client.bind(pod.namespace, pod.name, node_name)
+
+
+def _throttled_sink(sink, qps: float, burst: int):
+    """Drop events when the bucket is dry — the broadcaster's behavior
+    under pressure rather than blocking the bind path."""
+    from kubernetes_tpu.utils.flowcontrol import TokenBucketRateLimiter
+    bucket = TokenBucketRateLimiter(qps, burst)
+
+    def throttled(ev) -> None:
+        if bucket.try_accept():
+            sink(ev)
+    return throttled
+
+
+def make_event_sink(source: Union[MemStore, APIClient]):
+    """An EventRecorder sink that posts Events as API objects
+    (pkg/client/record event.go: events are created on the apiserver)."""
+    counter = [0]
+
+    def sink(ev) -> None:
+        counter[0] += 1
+        ns, _, name = ev.object_key.partition("/")
+        try:
+            source.create("events", {
+                "metadata": {"name": f"{name or ns}.{counter[0]}",
+                             "namespace": ns if name else "default"},
+                "involvedObject": {"kind": "Pod", "namespace": ns,
+                                   "name": name or ns},
+                "type": ev.event_type, "reason": ev.reason,
+                "message": ev.message})
+        except Exception:  # noqa: BLE001 — event loss is non-fatal
+            pass
+    return sink
 
 
 def _is_terminated(obj: dict) -> bool:
@@ -53,17 +105,36 @@ def _assigned(obj: dict) -> bool:
 
 class ConfigFactory:
     """NewConfigFactory + CreateFromProvider/CreateFromConfig
-    (factory.go:100, :251-344)."""
+    (factory.go:100, :251-344).
 
-    def __init__(self, store: MemStore, policy: Optional[Policy] = None,
+    ``store`` is the apiserver source: a MemStore (in-process) or an HTTP
+    base URL string / APIClient (separate-process control plane).  QPS and
+    burst rate-limit the main client's verbs; events ride a second,
+    unthrottled client gated by a drop-on-saturation bucket, the
+    broadcaster's behavior under pressure (record/event.go)."""
+
+    def __init__(self, store: Union[MemStore, APIClient, str],
+                 policy: Optional[Policy] = None,
                  scheduler_name: str = api.DEFAULT_SCHEDULER_NAME,
-                 batched: bool = True):
+                 batched: bool = True,
+                 qps: float = 50.0, burst: int = 100):
+        if isinstance(store, str):
+            store = APIClient(store, qps=qps, burst=burst)
         self.store = store
         self.listers = Listers()
         self.algorithm = GenericScheduler(policy=policy, listers=self.listers)
+        if isinstance(store, APIClient):
+            binder = APIClientBinder(store)
+            events_client = APIClient(store.base_url, qps=0)
+            recorder = EventRecorder(sink=_throttled_sink(
+                make_event_sink(events_client), qps, burst))
+        else:
+            binder = MemStoreBinder(store)
+            recorder = EventRecorder(sink=None)
         self.daemon = Scheduler(SchedulerConfig(
-            algorithm=self.algorithm, binder=MemStoreBinder(store),
+            algorithm=self.algorithm, binder=binder,
             scheduler_name=scheduler_name, async_bind=False,
+            recorder=recorder,
             condition_updater=self._update_pod_condition))
         self.batched = batched
         self._reflectors: list[Reflector] = []
@@ -125,7 +196,7 @@ class ConfigFactory:
                       "reason": reason, "message": message})
         try:
             self.store.update("pods", obj)
-        except (KeyError, ConflictError):
+        except Exception:  # noqa: BLE001 — condition update is best-effort
             pass
 
     # -- lifecycle -------------------------------------------------------
@@ -144,6 +215,8 @@ class ConfigFactory:
             self._threads.append(r.run())
         for r in self._reflectors:
             r.wait_for_sync()
+        log.info("reflectors synced (%d nodes cached); starting loop",
+                 len(self.algorithm.cache.nodes()))
         self._threads.append(self.daemon.run(batched=self.batched))
 
         def ttl_sweep():  # cleanupAssumedPods (cache.go:309-330)

@@ -26,7 +26,10 @@ from kubernetes_tpu.scheduler.backoff import PodBackoff
 from kubernetes_tpu.scheduler.binder import Binder, InMemoryBinder
 from kubernetes_tpu.scheduler.queue import FIFO
 from kubernetes_tpu.utils.events import EventRecorder
+from kubernetes_tpu.utils.logging import get_logger
 from kubernetes_tpu.utils.metrics import SchedulerMetrics
+
+log = get_logger("daemon")
 
 DEFAULT_SCHEDULER_NAME = api.DEFAULT_SCHEDULER_NAME
 
@@ -114,6 +117,10 @@ class Scheduler:
         algo_us = (time.perf_counter() - start) * 1e6 / len(pods)
         self.config.metrics.scheduling_algorithm_latency.observe_many(
             algo_us, len(pods))
+        if log.isEnabledFor(10):  # V(2)-style guard (predicates.go:478)
+            placed_n = sum(1 for d in placements if d is not None)
+            log.debug("drained %d pods: %d placed, %.0f us/pod algorithm",
+                      len(pods), placed_n, algo_us)
         self._assume_and_bind_batch(pods, placements, start)
         return len(pods)
 
@@ -283,6 +290,7 @@ class Scheduler:
 
     def _handle_failure(self, pod: api.Pod, reason: str, message: str) -> None:
         """Event + condition update + backoff requeue (factory.go:512-556)."""
+        log.debug("scheduling failure for %s: %s", pod.key, message)
         self.config.recorder.eventf(pod.key, "Warning", reason, message)
         if self.config.condition_updater is not None:
             self.config.condition_updater(pod, "Unschedulable", message)

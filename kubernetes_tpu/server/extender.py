@@ -20,6 +20,7 @@ Run: ``python -m kubernetes_tpu.server.extender --port 12346``.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -34,20 +35,54 @@ from kubernetes_tpu.utils.metrics import SchedulerMetrics
 
 
 class ExtenderCore:
-    """Stateless per-request engine: each Filter/Prioritize call carries its
-    own node list, so a fresh cache is compiled per request (the extender
-    protocol's contract; state, if any, belongs to the calling scheduler)."""
+    """Per-request engine with persistent cluster state: the extender wire
+    protocol carries the node list on every call (extender.go:157-187), but
+    a scheduler's node list is stable between calls — so compiled node
+    tensors are cached keyed on the node list's identity (names +
+    resourceVersions when present, else a content digest) and only rebuilt
+    when the cluster actually changed.  The Solver (jit executables) is
+    shared across all cached engines."""
+
+    _MAX_ENGINES = 4
 
     def __init__(self, policy: Policy | None = None):
         self.policy = policy or default_provider()
         self.metrics = SchedulerMetrics()
         self._lock = threading.Lock()
         self._solver_holder: GenericScheduler | None = None
+        self._engines: dict = {}   # node-list key -> GenericScheduler (LRU)
+        # The scheduler calls filter then prioritize for the SAME pod
+        # back-to-back (generic_scheduler.go:189-207, :287-305): memoize the
+        # last evaluation so the pair costs one solve.
+        self._eval_memo: tuple | None = None
 
-    def _engine(self, nodes: list[api.Node]) -> GenericScheduler:
+    @staticmethod
+    def _node_list_key(node_items: list[dict]):
+        key = []
+        for it in node_items:
+            meta = it.get("metadata") or {}
+            rv = meta.get("resourceVersion", "")
+            if not rv:
+                # No versions on the wire: digest the whole list.
+                return hashlib.sha256(
+                    json.dumps(node_items, sort_keys=True).encode()
+                ).hexdigest()
+            key.append((meta.get("name", ""), rv))
+        return tuple(key)
+
+    def _engine(self, node_items: list[dict],
+                key=None) -> GenericScheduler:
+        if key is None:
+            key = self._node_list_key(node_items)
+        with self._lock:
+            eng = self._engines.pop(key, None)
+            if eng is not None:
+                self._engines[key] = eng  # refresh LRU position
+                return eng
+        # Miss: parse + compile the node list once for its lifetime.
         cache = SchedulerCache()
-        for nd in nodes:
-            cache.add_node(nd)
+        for it in node_items:
+            cache.add_node(api.node_from_json(it))
         eng = GenericScheduler(policy=self.policy, cache=cache,
                                listers=Listers())
         with self._lock:
@@ -56,20 +91,32 @@ class ExtenderCore:
                 eng.solver = self._solver_holder.solver
             else:
                 self._solver_holder = eng
+            self._engines[key] = eng
+            while len(self._engines) > self._MAX_ENGINES:
+                self._engines.pop(next(iter(self._engines)))
         return eng
 
     def _evaluate(self, args: dict):
         # Accept both v1 lowercase keys and internal-type capitalized keys
         # (clients serialize either depending on codec).
-        pod = api.pod_from_json(args.get("pod") or args.get("Pod") or {})
+        pod_raw = args.get("pod") or args.get("Pod") or {}
         nodes_obj = args.get("nodes") or args.get("Nodes") or {}
         node_items = nodes_obj.get("items") or nodes_obj.get("Items") or []
-        nodes = [api.node_from_json(n) for n in node_items]
-        eng = self._engine(nodes)
-        _, db, dc, nt = eng._compile([pod])
-        feasible, scores = eng.solver.evaluate(db, dc)
-        return pod, nodes, node_items, np.asarray(feasible[0]), \
-            np.asarray(scores[0]), eng, db, dc, nt
+        nkey = self._node_list_key(node_items)
+        mkey = (nkey, json.dumps(pod_raw, sort_keys=True))
+        memo = self._eval_memo
+        if memo is not None and memo[0] == mkey:
+            return memo[1]
+        pod = api.pod_from_json(pod_raw)
+        eng = self._engine(node_items, nkey)
+        nodes = eng.cache.nodes()
+        batch, db, dc, nt = eng._compile([pod])
+        from kubernetes_tpu.engine.solver import batch_flags
+        feasible, scores = eng.solver.evaluate(db, dc, batch_flags(batch))
+        result = (pod, nodes, node_items, np.asarray(feasible[0]),
+                  np.asarray(scores[0]), eng, db, dc, nt)
+        self._eval_memo = (mkey, result)
+        return result
 
     def filter(self, args: dict) -> dict:
         """ExtenderArgs -> ExtenderFilterResult (extender.go:97-125)."""
@@ -172,6 +219,14 @@ def serve(port: int = 12346, policy: Policy | None = None,
     return server
 
 
+def serve_in_thread(port: int = 0, policy: Policy | None = None,
+                    host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    server = serve(port, policy, host)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="extender-http").start()
+    return server
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--port", type=int, default=12346)
@@ -181,8 +236,10 @@ def main() -> None:
     opts = ap.parse_args()
     policy = None
     if opts.policy_config_file:
+        from kubernetes_tpu.api.validation import validate_policy
         with open(opts.policy_config_file) as f:
             policy = policy_from_json(f.read())
+        validate_policy(policy)
     server = serve(opts.port, policy, opts.host)
     print(f"tpu-scheduler extender listening on {opts.host}:{opts.port}")
     server.serve_forever()

@@ -1,0 +1,68 @@
+"""Client-side flow control: a token-bucket rate limiter.
+
+The reference rate-limits every apiserver client at QPS with a Burst bucket
+(``pkg/util/flowcontrol/throttle.go`` tokenBucketRateLimiter, wired through
+``pkg/client/restclient/config.go``; the scheduler passes --kube-api-qps /
+--kube-api-burst, options/options.go:66-67, and the perf rig raises them to
+5000, test/component/scheduler/perf/util.go:63-64).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class TokenBucketRateLimiter:
+    """flowcontrol.NewTokenBucketRateLimiter(qps, burst).
+
+    ``accept()`` blocks until a token is available (throttle.go Accept);
+    ``try_accept()`` is the non-blocking TryAccept.  qps <= 0 disables
+    limiting (flowcontrol's fakeAlwaysRateLimiter shape).
+    """
+
+    def __init__(self, qps: float, burst: int,
+                 now=time.monotonic, sleep=time.sleep):
+        self.qps = qps
+        self.burst = max(burst, 1)
+        self._now = now
+        self._sleep = sleep
+        self._tokens = float(self.burst)
+        self._last = now()
+        self._lock = threading.Lock()
+
+    def _refill(self) -> None:
+        now = self._now()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.qps)
+        self._last = now
+
+    def try_accept(self) -> bool:
+        if self.qps <= 0:
+            return True
+        with self._lock:
+            self._refill()
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def accept(self) -> None:
+        if self.qps <= 0:
+            return
+        while True:
+            with self._lock:
+                self._refill()
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return
+                wait = (1.0 - self._tokens) / self.qps
+            self._sleep(wait)
+
+    def saturation(self) -> float:
+        """Fraction of the bucket consumed (throttle.go Saturation)."""
+        if self.qps <= 0:
+            return 0.0
+        with self._lock:
+            self._refill()
+            return 1.0 - self._tokens / self.burst

@@ -82,6 +82,46 @@ class InMemoryLock:
             return True
 
 
+class APIResourceLock:
+    """Annotation-CAS lock on an apiserver object — the reference's
+    EndpointsLock (leaderelection.go:99-148): the LeaderElectionRecord lives
+    in the ``control-plane.alpha.kubernetes.io/leader`` annotation of an
+    Endpoints object, CAS'd on resourceVersion."""
+
+    def __init__(self, client, kind: str = "endpoints",
+                 name: str = "kube-scheduler"):
+        self.client = client
+        self.kind = kind
+        self.name = name
+
+    def _ensure(self) -> dict:
+        obj = self.client.get(self.kind, self.name)
+        if obj is None:
+            try:
+                self.client.create(self.kind, {"metadata": {"name": self.name}})
+            except Exception:  # noqa: BLE001 — lost the create race
+                pass
+            obj = self.client.get(self.kind, self.name) or \
+                {"metadata": {"name": self.name}}
+        return obj
+
+    def get(self) -> tuple[Optional[str], int]:
+        obj = self._ensure()
+        meta = obj.get("metadata") or {}
+        ann = (meta.get("annotations") or {}).get(LEADER_ANNOTATION_KEY)
+        return ann, int(meta.get("resourceVersion", "0") or "0")
+
+    def update(self, value: str, expected_version: int) -> bool:
+        try:
+            self.client.update(self.kind, {
+                "metadata": {"name": self.name,
+                             "resourceVersion": str(expected_version),
+                             "annotations": {LEADER_ANNOTATION_KEY: value}}})
+            return True
+        except Exception:  # noqa: BLE001 — CAS conflict or apiserver error
+            return False
+
+
 @dataclass
 class LeaderElector:
     """leaderelection.go:174-340: acquire -> renew loop; on_started_leading

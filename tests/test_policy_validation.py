@@ -1,0 +1,144 @@
+"""Policy validation + the cross-release compatibility pin.
+
+Validation semantics re-derived from
+``plugin/pkg/scheduler/api/validation/validation.go`` (collect ALL errors;
+positive priority weights, non-negative extender weights) and
+``factory/plugins.go:251,266`` (unknown names are rejected when the policy
+is materialized).  The compatibility table pins the accepted policy JSON
+the way ``algorithmprovider/defaults/compatibility_test.go`` does — the
+JSON blocks must keep parsing, resolving, and building a working solver.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubernetes_tpu.api.policy import (PredicateSpec, PrioritySpec,
+                                       ExtenderConfig, Policy,
+                                       canonical_predicate_name,
+                                       canonical_priority_name,
+                                       default_provider, policy_from_json)
+from kubernetes_tpu.api.validation import (PolicyValidationError,
+                                           validate_policy)
+from kubernetes_tpu.engine.solver import Solver
+
+
+def test_default_providers_validate():
+    from kubernetes_tpu.api.policy import cluster_autoscaler_provider
+    validate_policy(default_provider())
+    validate_policy(cluster_autoscaler_provider())
+
+
+def test_unknown_predicate_rejected():
+    p = Policy(predicates=[PredicateSpec("NoSuchPredicate")],
+               priorities=[PrioritySpec("LeastRequestedPriority", 1)])
+    with pytest.raises(PolicyValidationError) as ei:
+        validate_policy(p)
+    assert 'Invalid predicate name "NoSuchPredicate"' in str(ei.value)
+
+
+def test_unknown_priority_rejected():
+    p = Policy(priorities=[PrioritySpec("NoSuchPriority", 1)])
+    with pytest.raises(PolicyValidationError) as ei:
+        validate_policy(p)
+    assert "Invalid priority name NoSuchPriority" in str(ei.value)
+
+
+def test_nonpositive_priority_weight_rejected():
+    # validation.go:31-34.
+    p = Policy(priorities=[PrioritySpec("LeastRequestedPriority", 0)])
+    with pytest.raises(PolicyValidationError) as ei:
+        validate_policy(p)
+    assert "positive weight" in str(ei.value)
+
+
+def test_negative_extender_weight_rejected():
+    p = Policy(extenders=[ExtenderConfig(url_prefix="http://x",
+                                         prioritize_verb="prioritize",
+                                         weight=-1)])
+    with pytest.raises(PolicyValidationError) as ei:
+        validate_policy(p)
+    assert "non negative weight" in str(ei.value)
+
+
+def test_extender_without_verbs_rejected():
+    p = Policy(extenders=[ExtenderConfig(url_prefix="http://x")])
+    with pytest.raises(PolicyValidationError):
+        validate_policy(p)
+
+
+def test_all_errors_collected():
+    """validation.go:28: 'does not return early'."""
+    p = Policy(predicates=[PredicateSpec("Bogus")],
+               priorities=[PrioritySpec("AlsoBogus", -3)])
+    with pytest.raises(PolicyValidationError) as ei:
+        validate_policy(p)
+    assert len(ei.value.errors) == 3  # unknown pred, weight, unknown prio
+
+
+# -- compatibility pin (compatibility_test.go) ---------------------------
+
+# Do not change this JSON. A failure indicates backwards compatibility with
+# the 1.0 policy schema was broken (compatibility_test.go:44-60).
+POLICY_1_0 = """{
+  "kind": "Policy",
+  "apiVersion": "v1",
+  "predicates": [
+    {"name": "MatchNodeSelector"},
+    {"name": "PodFitsResources"},
+    {"name": "PodFitsPorts"},
+    {"name": "NoDiskConflict"},
+    {"name": "TestServiceAffinity", "argument": {"serviceAffinity" : {"labels" : ["region"]}}},
+    {"name": "TestLabelsPresence",  "argument": {"labelsPresence"  : {"labels" : ["foo"], "presence":true}}}
+  ],"priorities": [
+    {"name": "LeastRequestedPriority",   "weight": 1},
+    {"name": "ServiceSpreadingPriority", "weight": 2},
+    {"name": "TestServiceAntiAffinity",  "weight": 3, "argument": {"serviceAntiAffinity": {"label": "zone"}}},
+    {"name": "TestLabelPreference",      "weight": 4, "argument": {"labelPreference": {"label": "bar", "presence":true}}}
+  ]
+}"""
+
+# Do not change this JSON after 1.1 (compatibility_test.go:80-89).
+POLICY_1_1 = """{
+  "kind": "Policy",
+  "apiVersion": "v1",
+  "predicates": [
+    {"name": "PodFitsHostPorts"}
+  ],"priorities": [
+    {"name": "SelectorSpreadPriority",   "weight": 2}
+  ]
+}"""
+
+
+def test_compatibility_1_0():
+    policy = policy_from_json(POLICY_1_0)
+    assert [p.name for p in policy.predicates] == [
+        "MatchNodeSelector", "PodFitsResources", "PodFitsPorts",
+        "NoDiskConflict", "TestServiceAffinity", "TestLabelsPresence"]
+    # Argument-keyed resolution (plugins.go behavior).
+    assert canonical_predicate_name(policy.predicates[4]) == "ServiceAffinity"
+    assert policy.predicates[4].affinity_labels == ("region",)
+    assert canonical_predicate_name(policy.predicates[5]) == \
+        "NewNodeLabelPredicate"
+    assert policy.predicates[5].labels == ("foo",)
+    assert policy.predicates[5].presence is True
+    assert [(s.name, s.weight) for s in policy.priorities] == [
+        ("LeastRequestedPriority", 1), ("ServiceSpreadingPriority", 2),
+        ("TestServiceAntiAffinity", 3), ("TestLabelPreference", 4)]
+    assert canonical_priority_name(policy.priorities[2]) == \
+        "ServiceAntiAffinityPriority"
+    assert policy.priorities[2].anti_affinity_label == "zone"
+    assert canonical_priority_name(policy.priorities[3]) == \
+        "NodeLabelPriority"
+    assert policy.priorities[3].label == "bar"
+    validate_policy(policy)
+    Solver(policy)  # CreateFromConfig must succeed (compat test tail)
+
+
+def test_compatibility_1_1():
+    policy = policy_from_json(POLICY_1_1)
+    assert [p.name for p in policy.predicates] == ["PodFitsHostPorts"]
+    assert [(s.name, s.weight) for s in policy.priorities] == [
+        ("SelectorSpreadPriority", 2)]
+    validate_policy(policy)
+    Solver(policy)
