@@ -10,6 +10,7 @@ ExtenderCore), so steady-state verb latency is a single-pod evaluate, not a
 from __future__ import annotations
 
 import json
+import os
 import time
 import urllib.request
 
@@ -73,8 +74,14 @@ def test_filter_prioritize_p99_at_5k_nodes(extender_url):
     lat.sort()
     p50 = lat[len(lat) // 2]
     p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
-    # VERDICT r1 next-step #4 target: p99 < 100 ms at 5k nodes.
-    assert p99 < 0.100, f"p99 {p99*1e3:.1f} ms (p50 {p50*1e3:.1f} ms)"
+    print(f"\nextender verb latency at {N_NODES} nodes: "
+          f"p50 {p50*1e3:.1f} ms p99 {p99*1e3:.1f} ms")
+    # Target: p99 < 100 ms at 5k nodes (vs the reference's 5 s extender
+    # timeout, extender.go:34-36).  Wall-clock asserts are
+    # hardware-dependent; KT_PERF_ASSERTS=0 keeps the measurement but
+    # skips the hard bar on contended CI runners.
+    if os.environ.get("KT_PERF_ASSERTS", "1") != "0":
+        assert p99 < 0.100, f"p99 {p99*1e3:.1f} ms (p50 {p50*1e3:.1f} ms)"
 
 
 def test_node_change_invalidates_cached_tensors(extender_url):
