@@ -40,6 +40,46 @@ class _EngineEvicted(Exception):
     the caller must fall back to a full parse."""
 
 
+class _EvalResult:
+    """One pod-template evaluation against one node list, with the filter
+    verdict computed lazily and cached (the filter→prioritize pair and every
+    later spec-identical pod reuse it).  Holds the shared Solver — not the
+    engine — so engine-attached memos don't form reference cycles, and no
+    parsed node dicts (node names suffice; responses join item_bytes)."""
+
+    __slots__ = ("pod", "node_names", "feasible", "scores", "solver",
+                 "db", "dc", "nt", "item_bytes", "_filter_parts")
+
+    def __init__(self, pod, node_names, feasible, scores, solver, db, dc,
+                 nt, item_bytes):
+        self.pod = pod
+        self.node_names = node_names
+        self.feasible = feasible
+        self.scores = scores
+        self.solver = solver
+        self.db = db
+        self.dc = dc
+        self.nt = nt
+        self.item_bytes = item_bytes
+        self._filter_parts = None
+
+    def filter_parts(self) -> tuple[np.ndarray, dict[str, str]]:
+        """Feasible indices + per-node failure reasons (cached: the masks
+        breakdown is a second device computation, paid once per template)."""
+        if self._filter_parts is None:
+            failed: dict[str, str] = {}
+            masks = None
+            for i in np.flatnonzero(~self.feasible):
+                if masks is None:
+                    masks = {k: np.asarray(v[0]) for k, v in
+                             self.solver.masks(self.db, self.dc).items()}
+                reasons = [p for p, m in masks.items() if not m[i]] \
+                    if self.nt.schedulable[i] else ["Unschedulable"]
+                failed[self.node_names[i]] = ", ".join(reasons) or "does not fit"
+            self._filter_parts = (np.flatnonzero(self.feasible), failed)
+        return self._filter_parts
+
+
 class ExtenderCore:
     """Per-request engine with persistent cluster state: the extender wire
     protocol carries the node list on every call (extender.go:157-187), but
@@ -57,10 +97,19 @@ class ExtenderCore:
         self._lock = threading.Lock()
         self._solver_holder: GenericScheduler | None = None
         self._engines: dict = {}   # node-list key -> GenericScheduler (LRU)
-        # The scheduler calls filter then prioritize for the SAME pod
-        # back-to-back (generic_scheduler.go:189-207, :287-305): memoize the
-        # last evaluation so the pair costs one solve.
-        self._eval_memo: tuple | None = None
+        # Evaluations are memoized per pod TEMPLATE key, nested inside the
+        # engine for that node list (so memo entries die with the engine —
+        # memory stays bounded by _MAX_ENGINES): the scheduler calls filter
+        # then prioritize for the same pod back-to-back
+        # (generic_scheduler.go:189-207, :287-305), and controller-stamped
+        # replicas are spec-identical — the extender is stateless between
+        # calls (the wire carries the whole node list, extender.go:157-187),
+        # so identical specs against an identical node list get identical
+        # verdicts.  Only genuinely new templates pay a compile + solve;
+        # this is the verb-path analogue of the drain path's template dedup
+        # (features/batch.py pod_template_key).
+        self._TPL_MEMO_MAX = 32   # per engine
+        self._inflight = 0        # concurrent handle() calls (refreeze gate)
         # Wire-path memos: a raw-body digest memo (the prioritize call that
         # follows filter carries byte-identical ExtenderArgs, so it should
         # cost zero parsing), and the previous request's node-list byte span
@@ -111,6 +160,20 @@ class ExtenderCore:
             self._engines[key] = eng
             while len(self._engines) > self._MAX_ENGINES:
                 self._engines.pop(next(iter(self._engines)))
+        # A fresh engine is long-lived state (compiled node tensors for the
+        # cluster's current shape): fold it into the frozen baseline so
+        # gen-2 collections never scan it — an unfrozen 5k-node engine is
+        # ~100k tracked objects and a single gen-2 pass over them stalls an
+        # in-flight verb for tens of ms (the p99 tail).  Only when no other
+        # request is in flight (their live temporaries must not be frozen);
+        # the freeze runs UNDER the lock so a new request can't start
+        # (handle() increments _inflight under the same lock) between the
+        # quiet check and the freeze.  collect() first so only live objects
+        # are frozen, and refcounting still reclaims evicted engines
+        # (freeze only exempts cyclic GC).
+        with self._lock:
+            if self._inflight <= 1:
+                _refreeze_heap()
         return eng
 
     def _evaluate(self, args: dict):
@@ -123,20 +186,31 @@ class ExtenderCore:
                                      self._node_list_key(node_items))
 
     def _evaluate_parsed(self, pod_raw: dict, node_items: list | None, nkey,
-                         item_bytes: list | None = None):
-        mkey = (nkey, json.dumps(pod_raw, sort_keys=True))
-        memo = self._eval_memo
-        if memo is not None and memo[0] == mkey:
-            return memo[1]
+                         item_bytes: list | None = None) -> _EvalResult:
+        from kubernetes_tpu.features.batch import pod_template_key
         pod = api.pod_from_json(pod_raw)
+        tkey = pod_template_key(pod)
         eng = self._engine(node_items, nkey)
-        nodes = eng.cache.nodes()
+        memo = getattr(eng, "_tpl_memo", None)
+        if memo is None:
+            memo = eng._tpl_memo = {}
+        with self._lock:
+            result = memo.pop(tkey, None)
+            if result is not None:
+                memo[tkey] = result  # refresh LRU position
+                if result.item_bytes is None:
+                    result.item_bytes = item_bytes
+                return result
         batch, db, dc, nt = eng._compile([pod])
         from kubernetes_tpu.engine.solver import batch_flags
         feasible, scores = eng.solver.evaluate(db, dc, batch_flags(batch))
-        result = (pod, nodes, node_items, np.asarray(feasible[0]),
-                  np.asarray(scores[0]), eng, db, dc, nt, item_bytes)
-        self._eval_memo = (mkey, result)
+        result = _EvalResult(pod, [n.name for n in eng.cache.nodes()],
+                             np.asarray(feasible[0]), np.asarray(scores[0]),
+                             eng.solver, db, dc, nt, item_bytes)
+        with self._lock:
+            memo[tkey] = result
+            while len(memo) > self._TPL_MEMO_MAX:
+                memo.pop(next(iter(memo)))
         return result
 
     # -- wire path: parse once, recognize unchanged node lists by bytes ----
@@ -158,11 +232,18 @@ class ExtenderCore:
         i += 1
         vals: dict = {}
         spans: dict = {}
+        closed = False
         while i < n:
+            saw_comma = False
             while i < n and s[i] in " \t\r\n,":
+                saw_comma = saw_comma or s[i] == ","
                 i += 1
             if i < n and s[i] == "}":
+                closed = True
+                i += 1
                 break
+            if vals and not saw_comma:
+                raise ValueError("missing ',' between members")
             if i >= n or s[i] != '"':
                 raise ValueError("bad object key")
             key, i = json.decoder.scanstring(s, i + 1)
@@ -176,6 +257,13 @@ class ExtenderCore:
             vals[key], j = dec.raw_decode(s, i)
             spans[key] = (i, j)
             i = j
+        # Reject truncated bodies and trailing garbage the way json.loads
+        # would: a short write must surface as an error, not an
+        # empty-node-list verdict.
+        if not closed:
+            raise ValueError("unterminated ExtenderArgs object")
+        if s[i:].strip():
+            raise ValueError("trailing data after ExtenderArgs object")
         return vals, spans, s
 
     def _parse_args(self, raw: bytes, allow_fast: bool = True):
@@ -226,6 +314,15 @@ class ExtenderCore:
         """Serve one wire verb from raw request bytes to raw response bytes.
         Identical bodies (the filter→prioritize pair for one pod) hit a
         digest memo and cost no parsing or solving at all."""
+        with self._lock:
+            self._inflight += 1
+        try:
+            return self._handle(verb, raw)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def _handle(self, verb: str, raw: bytes) -> bytes:
         dig = hashlib.sha256(raw).digest()
         memo = self._raw_memo
         item_bytes = None
@@ -246,75 +343,77 @@ class ExtenderCore:
                     result = self._evaluate_parsed(pod_raw, node_items, nkey,
                                                    item_bytes)
             except Exception as e:  # noqa: BLE001 — wire contract: Error field
-                err = e
+                # str(e), not e: a stored exception pins its traceback
+                # frames (and with them the multi-MB request body) until
+                # the memo is replaced.
+                err = str(e) or type(e).__name__
             self._raw_memo = (dig, result, item_bytes, err)
         if verb == "filter":
-            if err is not None:
-                return json.dumps({"nodes": {"items": []}, "failedNodes": {},
-                                   "error": str(err)}).encode()
-            return self._filter_response(result, item_bytes)
-        if err is not None:
-            # Prioritize errors are ignorable (api/types.go:128-130): answer
-            # zero scores for whatever node names can be salvaged.
+            if err is None:
+                # Response building includes filter_parts (a device masks
+                # computation): failures there must still answer the wire
+                # contract's Error field, not drop the exchange.
+                try:
+                    return self._filter_response(result, item_bytes)
+                except Exception as e:  # noqa: BLE001 — wire contract
+                    err = str(e) or type(e).__name__
+            return json.dumps({"nodes": {"items": []}, "failedNodes": {},
+                               "error": str(err)}).encode()
+        if err is None:
             try:
-                args = json.loads(raw)
-                nodes_obj = (args.get("nodes") or args.get("Nodes") or {}) \
-                    if isinstance(args, dict) else {}
-                items = (nodes_obj.get("items") or nodes_obj.get("Items")
-                         or []) if isinstance(nodes_obj, dict) else []
-            except ValueError:
-                items = []
-            return json.dumps(
-                [{"host": (nd.get("metadata") or {}).get("name", ""),
-                  "score": 0} for nd in items]).encode()
-        return json.dumps(self._priority_list(result)).encode()
+                return json.dumps(self._priority_list(result)).encode()
+            except Exception as e:  # noqa: BLE001 — prioritize is ignorable
+                err = str(e) or type(e).__name__
+        # Prioritize errors are ignorable (api/types.go:128-130): answer
+        # zero scores for whatever node names can be salvaged.
+        try:
+            args = json.loads(raw)
+            nodes_obj = (args.get("nodes") or args.get("Nodes") or {}) \
+                if isinstance(args, dict) else {}
+            items = (nodes_obj.get("items") or nodes_obj.get("Items")
+                     or []) if isinstance(nodes_obj, dict) else []
+        except ValueError:
+            items = []
+        return json.dumps(
+            [{"host": (nd.get("metadata") or {}).get("name", ""),
+              "score": 0} for nd in items]).encode()
 
-    @staticmethod
-    def _filter_parts(result) -> tuple[np.ndarray, dict[str, str]]:
-        """Feasible indices + per-node failure reasons for a filter verdict."""
-        _, nodes, _, feasible, _, eng, db, dc, nt, _ = result
-        failed: dict[str, str] = {}
-        masks = None
-        for i in np.flatnonzero(~feasible):
-            if masks is None:
-                masks = {k: np.asarray(v[0]) for k, v in
-                         eng.solver.masks(db, dc).items()}
-            reasons = [p for p, m in masks.items() if not m[i]] \
-                if nt.schedulable[i] else ["Unschedulable"]
-            failed[nodes[i].name] = ", ".join(reasons) or "does not fit"
-        return np.flatnonzero(feasible), failed
-
-    def _filter_response(self, result, item_bytes) -> bytes:
-        node_items, memo_bytes = result[2], result[9]
+    def _filter_response(self, result: _EvalResult, item_bytes) -> bytes:
         if item_bytes is None:
-            item_bytes = memo_bytes
-        keep_idx, failed = self._filter_parts(result)
+            item_bytes = result.item_bytes
+        keep_idx, failed = result.filter_parts()
         if item_bytes is not None:
             # Response items join pre-serialized per-node bytes: a 5k-node
             # keep list costs a join, not a 30 ms json.dumps.
             items_blob = b",".join(item_bytes[i] for i in keep_idx)
             return (b'{"nodes":{"items":[' + items_blob + b']},"failedNodes":'
                     + json.dumps(failed).encode() + b"}")
-        keep = [node_items[i] for i in keep_idx]
+        # No serialized items available (nodes absent/empty on the wire):
+        # echo minimal objects carrying the names.
+        keep = [{"metadata": {"name": result.node_names[i]}}
+                for i in keep_idx]
         return json.dumps({"nodes": {"items": keep},
                            "failedNodes": failed}).encode()
 
     @staticmethod
-    def _priority_list(result) -> list[dict]:
-        _, nodes, _, feasible, scores, *_ = result
+    def _priority_list(result: _EvalResult) -> list[dict]:
+        names, scores = result.node_names, result.scores
         smax = float(scores.max()) if len(scores) else 0.0
         out = []
-        for i, nd in enumerate(nodes):
+        for i, name in enumerate(names):
             score = int(10.0 * scores[i] / smax) if smax > 0 else 0
-            out.append({"host": nd.name, "score": score})
+            out.append({"host": name, "score": score})
         return out
 
     def filter(self, args: dict) -> dict:
         """ExtenderArgs -> ExtenderFilterResult (extender.go:97-125)."""
         try:
             result = self._evaluate(args)
-            keep_idx, failed = self._filter_parts(result)
-            node_items = result[2]
+            keep_idx, failed = result.filter_parts()
+            # Echo this request's node objects (a memo hit may carry
+            # node_items=None from the wire fast path).
+            nodes_obj = args.get("nodes") or args.get("Nodes") or {}
+            node_items = nodes_obj.get("items") or nodes_obj.get("Items") or []
             return {"nodes": {"items": [node_items[i] for i in keep_idx]},
                     "failedNodes": failed}
         except Exception as err:  # noqa: BLE001 — wire contract: Error field
@@ -394,12 +493,22 @@ def _freeze_baseline_heap() -> None:
     # The post-import heap (jax + friends) is a few hundred thousand
     # long-lived objects; every gen-2 collection scans them all and stalls
     # an in-flight verb for tens of ms.  Freeze the stable heap so cyclic
-    # GC only ever walks objects created while serving.  Once per process:
-    # repeated freezes would exempt each prior server's garbage forever.
+    # GC only ever walks objects created while serving.  Once per process
+    # at startup; _refreeze_heap extends the baseline after cold compiles.
     global _heap_frozen
     if _heap_frozen:
         return
     _heap_frozen = True
+    gc.collect()
+    gc.freeze()
+
+
+def _refreeze_heap() -> None:
+    """Fold objects that survived a cold compile into the frozen baseline.
+    collect() first so only *live* objects freeze; cyclic garbage created
+    since the last freeze is reclaimed, not immortalized.  Refcounting
+    still frees frozen objects when dropped — freeze only exempts them
+    from gen-2 scans, which is exactly what keeps verb tails flat."""
     gc.collect()
     gc.freeze()
 
