@@ -20,6 +20,13 @@ from typing import Any, Optional
 from kubernetes_tpu.api.quantity import milli_value, value
 
 # Annotation keys (pkg/api/helpers.go:414-424, pkg/api/types.go:3053).
+# Resource kinds whose storage keys carry a namespace segment — one shared
+# definition so the apiserver's key derivation and the client's URL paths
+# can never drift apart.
+NAMESPACED_KINDS = frozenset({"pods", "services", "persistentvolumeclaims",
+                              "replicationcontrollers", "replicasets",
+                              "events"})
+
 AFFINITY_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/affinity"
 TOLERATIONS_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/tolerations"
 TAINTS_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/taints"
@@ -646,3 +653,49 @@ def node_from_json(d: dict) -> Node:
         images=[ContainerImage(names=tuple(i.get("names") or ()),
                                size_bytes=int(i.get("sizeBytes", 0)))
                 for i in status.get("images") or ()])
+
+
+def pv_from_json(d: dict) -> PersistentVolume:
+    """Decode a v1 PersistentVolume (the fields MaxPDVolumeCountChecker's
+    filters and VolumeZoneChecker read, predicates.go:284-316, :391-407)."""
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    gce = spec.get("gcePersistentDisk") or {}
+    ebs = spec.get("awsElasticBlockStore") or {}
+    return PersistentVolume(
+        name=meta.get("name", ""),
+        labels=dict(meta.get("labels") or {}),
+        gce_pd_name=gce.get("pdName", ""),
+        aws_ebs_id=ebs.get("volumeID", ""))
+
+
+def pvc_from_json(d: dict) -> PersistentVolumeClaim:
+    """Decode a v1 PersistentVolumeClaim (spec.volumeName binding)."""
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    return PersistentVolumeClaim(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        volume_name=spec.get("volumeName", ""))
+
+
+def rc_from_json(d: dict) -> ReplicationController:
+    """Decode a v1 ReplicationController (spec.selector is a plain
+    label map)."""
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    return ReplicationController(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        selector=dict(spec.get("selector") or {}))
+
+
+def rs_from_json(d: dict) -> ReplicaSet:
+    """Decode an extensions/v1beta1 ReplicaSet (spec.selector is a
+    LabelSelector)."""
+    meta = d.get("metadata") or {}
+    spec = d.get("spec") or {}
+    return ReplicaSet(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        selector=_parse_label_selector(spec.get("selector")))

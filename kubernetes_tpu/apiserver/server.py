@@ -25,8 +25,14 @@ from urllib.parse import parse_qs, urlparse
 
 from kubernetes_tpu.apiserver.memstore import (ConflictError, MemStore,
                                                TooOldError)
+from kubernetes_tpu.apiserver.validation import (AdmissionError,
+                                                 admit_and_validate)
 
-_NAMESPACED = {"pods", "services"}
+from kubernetes_tpu.api.types import NAMESPACED_KINDS as _NAMESPACED
+
+# Idle watch streams carry a blank heartbeat chunk this often so clients'
+# read deadlines only fire on genuinely dead sockets.
+WATCH_HEARTBEAT_PERIOD = 10.0
 
 
 def make_handler(store: MemStore):
@@ -47,6 +53,21 @@ def make_handler(store: MemStore):
         def _read_body(self) -> dict:
             length = int(self.headers.get("Content-Length", 0))
             return json.loads(self.rfile.read(length) or b"{}")
+
+        def _admit(self, kind: str, body: dict) -> bool:
+            """Write-path chain (pkg/apiserver: admission -> validation):
+            403 on an admission veto, 422 with collected reasons on a
+            structurally invalid object.  True = proceed to the store."""
+            try:
+                errors = admit_and_validate(kind, body)
+            except AdmissionError as err:
+                self._send_json(403, {"error": str(err)})
+                return False
+            if errors:
+                self._send_json(422, {"error": "validation failed",
+                                      "reasons": errors})
+                return False
+            return True
 
         def _parts(self):
             parsed = urlparse(self.path)
@@ -102,11 +123,22 @@ def make_handler(store: MemStore):
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
             try:
+                idle = 0.0
                 while True:
                     ev = watcher.next(timeout=0.5)
                     if ev is None:
-                        # Keep-alive heartbeat chunk boundary check.
+                        # Idle: send a blank-line heartbeat chunk every
+                        # ~10 s so clients can tell a quiet stream from a
+                        # dead socket (their read timeout only fires when
+                        # heartbeats stop — reflector.go bounds watches
+                        # the same way server-side).
+                        idle += 0.5
+                        if idle >= WATCH_HEARTBEAT_PERIOD:
+                            idle = 0.0
+                            self.wfile.write(b"1\r\n\n\r\n")
+                            self.wfile.flush()
                         continue
+                    idle = 0.0
                     line = json.dumps({"type": ev.type,
                                        "object": ev.object}) + "\n"
                     data = line.encode()
@@ -139,6 +171,8 @@ def make_handler(store: MemStore):
                     if kind in _NAMESPACED:
                         body.setdefault("metadata", {}).setdefault(
                             "namespace", "default")
+                    if not self._admit(kind, body):
+                        return
                     created = store.create(kind, body)
                     self._send_json(201, created)
                     return
@@ -164,6 +198,8 @@ def make_handler(store: MemStore):
                     kind = parts[2]
                 else:
                     self._send_json(404, {"error": "unknown path"})
+                    return
+                if not self._admit(kind, body):
                     return
                 # GuaranteedUpdate semantics: a submitted resourceVersion is
                 # a CAS precondition (pkg/storage/etcd/etcd_helper.go).

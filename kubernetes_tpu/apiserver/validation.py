@@ -1,0 +1,148 @@
+"""Object validation + admission for the apiserver write path.
+
+The reference runs every write through structural validation
+(``pkg/api/validation/validation.go`` — ValidatePod/ValidateNode collect
+field errors) and then a configured admission chain
+(``pkg/admission``, plugins under ``plugin/pkg/admission/*``) before the
+object reaches the registry.  This module is that slice for the
+scheduler-relevant resources: malformed pods/nodes bounce with 422 and the
+collected reasons; admission plugins can veto with 403.
+
+Validation collects ALL errors (field.ErrorList behavior) rather than
+stopping at the first.
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.quantity import parse_quantity
+
+# pkg/api/validation/name.go: DNS-1123 subset — enough to catch junk
+# without re-implementing the full RFC grammar.
+_NAME_OK = set("abcdefghijklmnopqrstuvwxyz0123456789.-")
+
+def _check_name(meta: dict, errors: list[str], what: str) -> None:
+    name = meta.get("name", "")
+    if not name or not isinstance(name, str):
+        errors.append(f"{what}.metadata.name: required")
+        return
+    if len(name) > 253:
+        errors.append(f"{what}.metadata.name: must be <= 253 chars")
+    if not all(c in _NAME_OK for c in name.lower()):
+        errors.append(f"{what}.metadata.name: invalid characters in "
+                      f"{name!r}")
+
+
+def _check_quantity(val, path: str, errors: list[str]) -> None:
+    try:
+        q = parse_quantity(val)
+    except (ValueError, TypeError, ArithmeticError):
+        errors.append(f"{path}: unparseable quantity {val!r}")
+        return
+    if q < 0:
+        errors.append(f"{path}: must be non-negative, got {val!r}")
+
+
+def validate_pod(obj: dict) -> list[str]:
+    """ValidatePod (validation.go): name present, containers named and
+    unique, resource requests/limits parseable and non-negative."""
+    errors: list[str] = []
+    meta = obj.get("metadata") or {}
+    _check_name(meta, errors, "pod")
+    spec = obj.get("spec") or {}
+    containers = spec.get("containers")
+    if not isinstance(containers, list) or not containers:
+        errors.append("pod.spec.containers: at least one container required")
+        containers = []
+    seen = set()
+    for i, c in enumerate(containers):
+        if not isinstance(c, dict):
+            errors.append(f"pod.spec.containers[{i}]: not an object")
+            continue
+        cname = c.get("name", "")
+        if not cname:
+            errors.append(f"pod.spec.containers[{i}].name: required")
+        elif cname in seen:
+            errors.append(f"pod.spec.containers[{i}].name: duplicate "
+                          f"{cname!r}")
+        seen.add(cname)
+        res = c.get("resources") or {}
+        for kind in ("requests", "limits"):
+            for rname, val in (res.get(kind) or {}).items():
+                _check_quantity(
+                    val, f"pod.spec.containers[{i}].resources."
+                    f"{kind}[{rname}]", errors)
+    return errors
+
+
+def validate_node(obj: dict) -> list[str]:
+    """ValidateNode (validation.go): name present, allocatable/capacity
+    quantities parseable and non-negative, condition entries well-formed."""
+    errors: list[str] = []
+    meta = obj.get("metadata") or {}
+    _check_name(meta, errors, "node")
+    status = obj.get("status") or {}
+    for fieldname in ("allocatable", "capacity"):
+        for rname, val in (status.get(fieldname) or {}).items():
+            _check_quantity(val, f"node.status.{fieldname}[{rname}]", errors)
+    for i, cond in enumerate(status.get("conditions") or ()):
+        if not isinstance(cond, dict):
+            errors.append(f"node.status.conditions[{i}]: not an object")
+            continue
+        # Unknown condition TYPES are allowed (the reference's ValidateNode
+        # doesn't restrict them; consumers ignore types they don't read) —
+        # only the shape is enforced.
+        if not cond.get("type", ""):
+            errors.append(f"node.status.conditions[{i}].type: required")
+        if cond.get("status") not in ("True", "False", "Unknown"):
+            errors.append(f"node.status.conditions[{i}].status: must be "
+                          f"True/False/Unknown")
+    return errors
+
+
+VALIDATORS = {"pods": validate_pod, "nodes": validate_node}
+
+
+class AdmissionError(Exception):
+    """A plugin vetoed the write (admission.Handler denial -> 403)."""
+
+
+class LimitPodHardAntiAffinityTopology:
+    """plugin/pkg/admission/antiaffinity: reject pods whose REQUIRED
+    anti-affinity uses a topology key other than the hostname label —
+    cluster-wide hard anti-affinity lets one pod fence off whole zones."""
+
+    name = "LimitPodHardAntiAffinityTopology"
+
+    def admit(self, kind: str, obj: dict) -> None:
+        if kind != "pods":
+            return
+        import json as _json
+        ann = (obj.get("metadata") or {}).get("annotations") or {}
+        raw = ann.get("scheduler.alpha.kubernetes.io/affinity", "")
+        if not raw:
+            return
+        try:
+            aff = _json.loads(raw) if isinstance(raw, str) else raw
+        except ValueError:
+            return  # malformed affinity is the engine's concern, not ours
+        terms = ((aff.get("podAntiAffinity") or {})
+                 .get("requiredDuringSchedulingIgnoredDuringExecution")) or ()
+        for term in terms:
+            key = term.get("topologyKey", "")
+            if key and key != "kubernetes.io/hostname":
+                raise AdmissionError(
+                    f"{self.name}: required pod anti-affinity with topology "
+                    f"key {key!r} is not allowed (hostname only)")
+
+
+DEFAULT_ADMISSION = (LimitPodHardAntiAffinityTopology(),)
+
+
+def admit_and_validate(kind: str, obj: dict,
+                       admission=DEFAULT_ADMISSION) -> list[str]:
+    """The write-path chain (pkg/apiserver: admission -> validation ->
+    registry).  Returns validation errors; raises AdmissionError on veto."""
+    for plugin in admission:
+        plugin.admit(kind, obj)
+    validator = VALIDATORS.get(kind)
+    return validator(obj) if validator else []

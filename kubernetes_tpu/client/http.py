@@ -18,6 +18,7 @@ import urllib.error
 import urllib.request
 from typing import Callable, Optional
 
+from kubernetes_tpu.api.types import NAMESPACED_KINDS
 from kubernetes_tpu.apiserver.memstore import (ConflictError, Event,
                                                TooOldError)
 from kubernetes_tpu.utils.flowcontrol import TokenBucketRateLimiter
@@ -35,7 +36,7 @@ class APIError(Exception):
 class APIClient:
     """Rate-limited JSON client for the apiserver HTTP surface."""
 
-    _NAMESPACED = {"pods", "services"}
+    _NAMESPACED = NAMESPACED_KINDS
 
     def __init__(self, base_url: str, qps: float = DEFAULT_QPS,
                  burst: int = DEFAULT_BURST, timeout: float = 10.0):
@@ -117,18 +118,30 @@ class APIClient:
             kind)
 
 
+# A healthy watch stream carries a server heartbeat every ~10 s
+# (apiserver/server.py WATCH_HEARTBEAT_PERIOD); a read deadline several
+# periods long therefore only fires on a genuinely dead socket — the pump
+# then surfaces ERROR and the reflector relists instead of hanging forever
+# (the reference bounds watches the same way, reflector.go timeout).
+WATCH_READ_DEADLINE = 45.0
+
+
 class HTTPWatcher:
     """Reads newline-delimited JSON events off a chunked watch response in a
     thread; ``next(timeout)``/``stop()`` mirror the memstore Watcher so the
     Reflector is transport-agnostic."""
 
-    def __init__(self, url: str, kind: str):
+    def __init__(self, url: str, kind: str,
+                 read_deadline: float = WATCH_READ_DEADLINE):
         self.kind = kind
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._stopped = threading.Event()
         req = urllib.request.Request(url)
         try:
-            self._resp = urllib.request.urlopen(req)  # streams; no timeout
+            # The timeout is the per-read socket deadline, not a stream
+            # lifetime: heartbeats reset it, so it only fires when the
+            # peer stops transmitting entirely (half-open TCP).
+            self._resp = urllib.request.urlopen(req, timeout=read_deadline)
         except urllib.error.HTTPError as err:
             if err.code == 410:
                 raise TooOldError(err.read().decode(errors="replace")) from err

