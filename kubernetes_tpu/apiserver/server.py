@@ -38,6 +38,9 @@ WATCH_HEARTBEAT_PERIOD = 10.0
 def make_handler(store: MemStore):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # Response header/body write pairs on keep-alive connections stall
+        # ~40 ms under Nagle + the peer's delayed ACK; verbs are small.
+        disable_nagle_algorithm = True
 
         def log_message(self, *a):
             pass

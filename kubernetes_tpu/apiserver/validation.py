@@ -27,7 +27,8 @@ def _check_name(meta: dict, errors: list[str], what: str) -> None:
         return
     if len(name) > 253:
         errors.append(f"{what}.metadata.name: must be <= 253 chars")
-    if not all(c in _NAME_OK for c in name.lower()):
+    if not all(c in _NAME_OK for c in name):
+        # DNS-1123 is lowercase-only: 'MyPod' is invalid, not normalized.
         errors.append(f"{what}.metadata.name: invalid characters in "
                       f"{name!r}")
 

@@ -74,28 +74,62 @@ class SchedulerCache:
         self._ep: Optional[fc.ExistingPodTensors] = None
         self._dirty_nodes = True
         self.generation = 0
+        # Churn observability: full rebuilds vs incremental row updates.
+        self.stats = {"rebuilds": 0, "rebuild_s": 0.0,
+                      "incremental_node_updates": 0}
 
     # ---- node lifecycle (cache.go:263-307) ----------------------------
 
     @_locked
     def add_node(self, node: api.Node) -> None:
+        known = node.name in self._nodes
         self._nodes[node.name] = node
         if node.name not in self._node_pods:
             self._node_pods[node.name] = {}
-        self._mark_nodes_dirty()
+        if self._dirty_nodes or self._nt is None:
+            self._mark_nodes_dirty()
+        elif known:
+            # Duplicate ADDED (relist Replace): treat as update in place.
+            fc.update_node_row(self._nt, self._nt.name_to_idx[node.name],
+                               node, self.space)
+            self.stats["incremental_node_updates"] += 1
+            self.generation += 1
+        else:
+            # Incremental append: one new row across the node tensors +
+            # zero aggregates; no 5k-row recompile per joining node.
+            fc.append_node_row(self._nt, node, self.space)
+            fc.append_aggregate_row(self._agg)
+            self._node_order.append(node.name)
+            self.stats["incremental_node_updates"] += 1
+            self.generation += 1
 
     @_locked
     def update_node(self, node: api.Node) -> None:
         self._nodes[node.name] = node
         if node.name not in self._node_pods:
             self._node_pods[node.name] = {}
-        self._mark_nodes_dirty()
+        idx = None if (self._dirty_nodes or self._nt is None) else \
+            self._nt.name_to_idx.get(node.name)
+        if idx is None:
+            self._mark_nodes_dirty()
+        else:
+            # Incremental UPDATE (Ready flip, capacity change): rewrite the
+            # one row — the node controller's churn must not cost a full
+            # rebuild (nodecontroller.go:70-160 at 5k nodes).  In-place
+            # writes are safe against concurrent solves because every
+            # reader (GenericScheduler._compile) holds self.lock across
+            # snapshot + feature compile + the device transfer; after the
+            # transfer the solver reads device copies, not these arrays.
+            fc.update_node_row(self._nt, idx, node, self.space)
+            self.stats["incremental_node_updates"] += 1
+            self.generation += 1
 
     @_locked
     def remove_node(self, name: str) -> None:
         self._nodes.pop(name, None)
         # Pods on the node stay tracked (the reference keeps them until their
         # own delete events arrive); their rows rebuild against the new order.
+        # Removal reshapes every [N, ...] tensor: full rebuild (bulk path).
         self._mark_nodes_dirty()
 
     def _mark_nodes_dirty(self) -> None:
@@ -318,19 +352,32 @@ class SchedulerCache:
     def _ensure_tensors(self) -> None:
         if not self._dirty_nodes and self._nt is not None:
             return
+        t0 = time.perf_counter()
         self._node_order = list(self._nodes.keys())
         self._nt = fc.compile_nodes(
             [self._nodes[n] for n in self._node_order], self.space)
         self._agg = fc.empty_aggregates(len(self._node_order), self.space)
         self._ep = fc.empty_existing_pods(self.space)
-        for name, pods in self._node_pods.items():
+        # Re-attach every tracked pod through the BULK paths: the per-pod
+        # loop is O(pods x numpy-call overhead) — tens of seconds at 30k
+        # attached pods, per node event, before this.
+        idxs: list[int] = []
+        pods: list[api.Pod] = []
+        for name, podmap in self._node_pods.items():
             idx = self._nt.name_to_idx.get(name)
             if idx is None:
                 continue
-            for pod in pods.values():
-                self._agg = fc.add_pod_to_aggregates(self._agg, idx, pod, self.space)
-                self._ep = fc.existing_pods_add(self._ep, pod, idx, self.space)
+            for pod in podmap.values():
+                idxs.append(idx)
+                pods.append(pod)
+        if pods:
+            self._agg = fc.add_pods_to_aggregates_bulk(
+                self._agg, idxs, pods, self.space)
+            self._ep = fc.existing_pods_add_bulk(
+                self._ep, pods, idxs, self.space)
         self._dirty_nodes = False
+        self.stats["rebuilds"] += 1
+        self.stats["rebuild_s"] += time.perf_counter() - t0
 
     @_locked
     def snapshot(self) -> tuple[fc.NodeTensors, fc.NodeAggregates,

@@ -11,10 +11,13 @@ ListAndWatch contract.
 
 from __future__ import annotations
 
+import http.client
 import json
 import queue
+import socket
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Callable, Optional
 
@@ -43,26 +46,72 @@ class APIClient:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.limiter = TokenBucketRateLimiter(qps, burst)
+        parsed = urllib.parse.urlparse(self.base_url)
+        self._scheme = parsed.scheme or "http"
+        self._host = parsed.hostname or "127.0.0.1"
+        self._port = parsed.port or (443 if self._scheme == "https"
+                                     else 80)
+        self._local = threading.local()
 
     # -- verbs -----------------------------------------------------------
+
+    def _conn(self):
+        """Per-thread keep-alive connection: a TCP handshake per verb
+        multiplies wire latency several-fold at bind rates; the reference
+        restclient reuses Go's pooled Transport the same way."""
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            cls = http.client.HTTPSConnection if self._scheme == "https" \
+                else http.client.HTTPConnection
+            c = cls(self._host, self._port, timeout=self.timeout)
+            c.connect()
+            # Nagle + delayed-ACK stalls every header/body write pair on a
+            # keep-alive connection by ~40 ms; verbs are small and latency
+            # bound, so flush segments immediately.
+            c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._local.conn = c
+        return c
 
     def _request(self, method: str, path: str,
                  obj: Optional[dict] = None) -> dict:
         self.limiter.accept()
         data = json.dumps(obj).encode() if obj is not None else None
-        req = urllib.request.Request(
-            f"{self.base_url}{path}", data=data, method=method,
-            headers={"Content-Type": "application/json"} if data else {})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as err:
-            body = err.read().decode(errors="replace")
-            if err.code == 409:
-                raise ConflictError(body) from err
-            if err.code == 410:
-                raise TooOldError(body) from err
-            raise APIError(err.code, body) from err
+        headers = {"Content-Type": "application/json"} if data else {}
+        for attempt in (0, 1):
+            c = self._conn()
+            try:
+                c.request(method, path, data, headers)
+            except (http.client.HTTPException, OSError):
+                # Stale keep-alive (server closed between verbs): the
+                # request was not delivered, so one reconnect + resend is
+                # safe for any verb.
+                c.close()
+                self._local.conn = None
+                if attempt:
+                    raise
+                continue
+            try:
+                resp = c.getresponse()
+                status = resp.status
+                body = resp.read()
+                break
+            except (http.client.HTTPException, OSError):
+                # The request may have been processed even though the
+                # response was lost; blindly re-sending a non-idempotent
+                # verb (POST bindings!) would double-apply it.  Retry
+                # reads only.
+                c.close()
+                self._local.conn = None
+                if attempt or method not in ("GET", "HEAD"):
+                    raise
+        if status < 300:
+            return json.loads(body or b"{}")
+        text = body.decode(errors="replace")
+        if status == 409:
+            raise ConflictError(text)
+        if status == 410:
+            raise TooOldError(text)
+        raise APIError(status, text)
 
     def _object_path(self, kind: str, key: str) -> str:
         if kind in self._NAMESPACED or "/" in key:

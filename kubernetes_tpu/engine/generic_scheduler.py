@@ -163,6 +163,8 @@ class GenericScheduler:
 
     def schedule(self, pod: api.Pod) -> str:
         trace = Trace(f"Scheduling {pod.namespace}/{pod.name}")
+        if not self.cache.nodes():
+            raise FitError(pod, {})
         batch, db, dc, nt = self._compile([pod])
         trace.step("Computing predicates & priorities")
         feasible, scores = self.solver.evaluate(db, dc,
@@ -231,6 +233,10 @@ class GenericScheduler:
         placement quality, no per-pod order parity."""
         if not pods:
             return []
+        if not self.cache.nodes():
+            # Empty cluster: findNodesThatFit over zero nodes fails every
+            # pod (no device solve; zero-size tensors don't reduce).
+            return [None] * len(pods)
         if self.extenders:
             # Extenders are a per-pod HTTP protocol; run the exact one-pod
             # path with temporary assumes for in-batch visibility, then
@@ -295,6 +301,11 @@ class GenericScheduler:
         the same compiled executable."""
         p = len(pods)
         if p == 0:
+            return
+        if not self.cache.nodes():
+            for start in range(0, p, chunk_size):
+                chunk = pods[start:start + chunk_size]
+                yield chunk, [None] * len(chunk)
             return
         n_chunks = (p + chunk_size - 1) // chunk_size
         padded = n_chunks * chunk_size

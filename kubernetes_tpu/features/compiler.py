@@ -201,6 +201,113 @@ def compile_nodes(nodes: Sequence[api.Node], space: FeatureSpace) -> NodeTensors
         image_kib=image_kib, topo_val=topo_val)
 
 
+def _intern_node(node: api.Node, space: FeatureSpace) -> None:
+    for k, v in node.labels.items():
+        space.labels.kv_id(k, v)
+        space.labels.key_id(k)
+    for t in node.taints():
+        space.taints.id(f"{t.key}={t.value}:{t.effect}")
+    for img in node.images:
+        for name in img.names:
+            space.images.id(name)
+    for key in space.topo_keys.tokens():
+        if key in node.labels:
+            space.topo_vals.id(f"{key}={node.labels[key]}")
+
+
+def _grow_node_columns(nt: NodeTensors, space: FeatureSpace) -> None:
+    nt.labels = _grow_cols(nt.labels, space.labels.capacity)
+    nt.taints_nosched = _grow_cols(nt.taints_nosched, space.taints.capacity)
+    nt.taints_prefer = _grow_cols(nt.taints_prefer, space.taints.capacity)
+    nt.image_kib = _grow_cols(nt.image_kib, space.images.capacity)
+    nt.topo_val = _grow_cols(nt.topo_val, space.topo_keys.capacity, fill=-1)
+
+
+def _write_node_row(nt: NodeTensors, i: int, node: api.Node,
+                    space: FeatureSpace) -> None:
+    nt.alloc[i] = (node.allocatable_milli_cpu,
+                   _mib_floor(node.allocatable_memory),
+                   node.allocatable_gpu, node.allocatable_pods)
+    nt.labels[i, :] = False
+    for k, v in node.labels.items():
+        nt.labels[i, space.labels.kv_id(k, v)] = True
+        nt.labels[i, space.labels.key_id(k)] = True
+    nt.taints_nosched[i, :] = False
+    nt.taints_prefer[i, :] = False
+    for t in node.taints():
+        tid = space.taints.id(f"{t.key}={t.value}:{t.effect}")
+        if t.effect == api.TAINT_EFFECT_PREFER_NO_SCHEDULE:
+            nt.taints_prefer[i, tid] = True
+        else:
+            nt.taints_nosched[i, tid] = True
+    nt.mem_pressure[i] = node.condition(api.NODE_MEMORY_PRESSURE) == "True"
+    nt.disk_pressure[i] = node.condition(api.NODE_DISK_PRESSURE) == "True"
+    nt.schedulable[i] = node.is_ready()
+    nt.image_kib[i, :] = 0
+    for img in node.images:
+        kib = img.size_bytes // 1024
+        for name in img.names:
+            nt.image_kib[i, space.images.id(name)] = kib
+    nt.topo_val[i, :] = -1
+    for ki, key in enumerate(space.topo_keys.tokens()):
+        if key in node.labels:
+            nt.topo_val[i, ki] = space.topo_vals.id(
+                f"{key}={node.labels[key]}")
+
+
+def update_node_row(nt: NodeTensors, idx: int, node: api.Node,
+                    space: FeatureSpace) -> None:
+    """Incremental node UPDATE: rewrite one row of the static node tensors
+    in place (growing vocab columns when the node introduced new tokens) —
+    the churn path the node controller exercises with Ready flips
+    (nodecontroller.go:70-160) must not recompile 5k rows."""
+    _intern_node(node, space)
+    _grow_node_columns(nt, space)
+    _write_node_row(nt, idx, node, space)
+
+
+def append_node_row(nt: NodeTensors, node: api.Node,
+                    space: FeatureSpace) -> int:
+    """Incremental node ADD: append one row to every [N, ...] tensor."""
+    _intern_node(node, space)
+    _grow_node_columns(nt, space)
+    i = len(nt.names)
+    nt.alloc = np.concatenate([nt.alloc, np.zeros((1, 4), np.int32)])
+    nt.labels = np.concatenate(
+        [nt.labels, np.zeros((1, nt.labels.shape[1]), bool)])
+    nt.taints_nosched = np.concatenate(
+        [nt.taints_nosched,
+         np.zeros((1, nt.taints_nosched.shape[1]), bool)])
+    nt.taints_prefer = np.concatenate(
+        [nt.taints_prefer, np.zeros((1, nt.taints_prefer.shape[1]), bool)])
+    nt.mem_pressure = np.concatenate([nt.mem_pressure, np.zeros(1, bool)])
+    nt.disk_pressure = np.concatenate([nt.disk_pressure, np.zeros(1, bool)])
+    nt.schedulable = np.concatenate([nt.schedulable, np.zeros(1, bool)])
+    nt.image_kib = np.concatenate(
+        [nt.image_kib, np.zeros((1, nt.image_kib.shape[1]), np.int32)])
+    nt.topo_val = np.concatenate(
+        [nt.topo_val, np.full((1, nt.topo_val.shape[1]), -1, np.int32)])
+    nt.names.append(node.name)
+    nt.name_to_idx[node.name] = i
+    _write_node_row(nt, i, node, space)
+    return i
+
+
+def append_aggregate_row(agg: NodeAggregates) -> None:
+    """Zero aggregates for a newly appended node row."""
+    agg.requested = np.concatenate(
+        [agg.requested, np.zeros((1, 4), np.int32)])
+    agg.nonzero = np.concatenate([agg.nonzero, np.zeros((1, 2), np.int32)])
+    for field_name in ("ports_used", "vol_any", "vol_rw"):
+        a = getattr(agg, field_name)
+        setattr(agg, field_name,
+                np.concatenate([a, np.zeros((1, a.shape[1]), bool)]))
+    for field_name in ("vol_rw_count", "vol_any_count"):
+        a = getattr(agg, field_name)
+        setattr(agg, field_name,
+                np.concatenate([a, np.zeros((1, a.shape[1]), np.int16)]))
+
+
 def pod_resource_row(pod: api.Pod) -> np.ndarray:
     """[4] int32 (cpu, mem_mib ceil, gpu, 1) — getResourceRequest.
 
@@ -326,10 +433,10 @@ def remove_pod_from_aggregates(agg: NodeAggregates, node_idx: int, pod: api.Pod,
     return agg
 
 
-def _grow_cols(a: np.ndarray, width: int) -> np.ndarray:
+def _grow_cols(a: np.ndarray, width: int, fill=0) -> np.ndarray:
     if a.shape[1] >= width:
         return a
-    out = np.zeros((a.shape[0], width), a.dtype)
+    out = np.full((a.shape[0], width), fill, a.dtype)
     out[:, : a.shape[1]] = a
     return out
 

@@ -47,6 +47,14 @@ class ClusterState:
         """getNodeConditionPredicate (factory.go:436-462)."""
         return [n for n in self.nodes if n.is_ready()]
 
+    def affinity_pods(self) -> list[api.Pod]:
+        """Pods carrying any affinity annotation — the reference's
+        PodsWithAffinity precompute (node_info.go:32-54, maintained by
+        addPod/removePod) that bounds the anti-affinity scans to pods that
+        can actually contribute terms.  Pods without affinity contribute
+        nothing to those loops, so restricting them is semantics-neutral."""
+        return [p for p in self.pods if p.affinity() is not None]
+
 
 # ---------------------------------------------------------------------------
 # Label / selector matching (pkg/labels)
@@ -313,19 +321,37 @@ def volume_zone(pod: api.Pod, node: api.Node,
     return True
 
 
-def inter_pod_affinity(pod: api.Pod, node: api.Node,
-                       cluster: ClusterState) -> bool:
-    """InterPodAffinityMatches (predicates.go:825-1068)."""
-    # 1. Existing pods' anti-affinity (satisfiesExistingPodsAntiAffinity).
-    for epod in cluster.pods:
+def matching_anti_affinity_terms(pod: api.Pod, cluster: ClusterState
+                                 ) -> list[tuple[api.Node,
+                                                 api.PodAffinityTerm]]:
+    """getMatchingAntiAffinityTerms (predicates.go:881-906): the per-pod
+    precompute of predicateMetadata — (existing pod's node, term) for every
+    existing anti-affinity term that matches the pending pod.  Only
+    affinity-carrying pods can contribute (PodsWithAffinity,
+    node_info.go:32-54)."""
+    out = []
+    for epod in cluster.affinity_pods():
         enode = cluster.node(epod.node_name)
         if enode is None:
             continue
         _, req_aa, _, _ = _affinity_terms(epod)
         for term in req_aa:
-            if pod_matches_term(pod, epod, term) and \
-                    nodes_same_topology(node, enode, term.topology_key):
-                return False
+            if pod_matches_term(pod, epod, term):
+                out.append((enode, term))
+    return out
+
+
+def inter_pod_affinity(pod: api.Pod, node: api.Node,
+                       cluster: ClusterState, meta=None) -> bool:
+    """InterPodAffinityMatches (predicates.go:825-1068).  ``meta``: the
+    matching_anti_affinity_terms precompute (predicateMetadata,
+    predicates.go:71-98); derived on the fly when absent."""
+    # 1. Existing pods' anti-affinity (satisfiesExistingPodsAntiAffinity).
+    if meta is None:
+        meta = matching_anti_affinity_terms(pod, cluster)
+    for enode, term in meta:
+        if nodes_same_topology(node, enode, term.topology_key):
+            return False
     # 2. The pod's own required terms.
     req_a, req_aa, _, _ = _affinity_terms(pod)
     for term in req_a:
@@ -363,6 +389,7 @@ def find_nodes_that_fit(pod: api.Pod, cluster: ClusterState
     (defaults.go:113-163), over ready nodes."""
     fits = []
     failures: dict[str, list[str]] = {}
+    meta = matching_anti_affinity_terms(pod, cluster)
     for node in cluster.ready_nodes():
         node_pods = cluster.node_pods(node.name)
         checks = [
@@ -371,7 +398,8 @@ def find_nodes_that_fit(pod: api.Pod, cluster: ClusterState
                 pod, node_pods, "ebs", DEFAULT_MAX_EBS, cluster)),
             ("MaxGCEPDVolumeCount", max_pd_volume_count(
                 pod, node_pods, "gce", DEFAULT_MAX_GCE, cluster)),
-            ("MatchInterPodAffinity", inter_pod_affinity(pod, node, cluster)),
+            ("MatchInterPodAffinity", inter_pod_affinity(pod, node, cluster,
+                                                         meta)),
             ("NoDiskConflict", no_disk_conflict(pod, node_pods)),
             ("PodFitsResources", pod_fits_resources(pod, node, node_pods)),
             ("PodFitsHost", pod_fits_host(pod, node)),
@@ -456,6 +484,16 @@ def _sel_matches(sel, labels: dict[str, str]) -> bool:
     return sel.matches(labels)
 
 
+def first_matching_service(pod: api.Pod, services) -> Optional[api.Service]:
+    """GetPodServices[0] — ServiceAffinity/ServiceAntiAffinity read only
+    the FIRST matching service (predicates.go:676-678)."""
+    for s in services:
+        if s.namespace == pod.namespace and _sel_matches(s.selector,
+                                                         pod.labels):
+            return s
+    return None
+
+
 def selector_spread(pod: api.Pod, cluster: ClusterState) -> dict[str, int]:
     """CalculateSpreadPriority (selector_spreading.go:63-175), over ready
     nodes."""
@@ -499,6 +537,39 @@ def selector_spread(pod: api.Pod, cluster: ClusterState) -> dict[str, int]:
                 f = f * (1 - 2 / 3) + (2 / 3) * zscore
         result[node.name] = int(f)
     return result
+
+
+def service_anti_affinity(pod: api.Pod, cluster: ClusterState,
+                          label: str) -> dict[str, int]:
+    """CalculateAntiAffinityPriority (selector_spreading.go:193-253): spread
+    the pods of the pod's FIRST matching service across values of a node
+    label.  Ready nodes carrying the label score
+    int(10 * (numServicePods - countsOnValue) / numServicePods); nodes
+    without the label score 0; every labeled node scores 10 when the
+    service has no pods."""
+    nodes = cluster.ready_nodes()
+    svc = first_matching_service(pod, cluster.services)
+    peers: list[api.Pod] = []
+    if svc is not None:
+        peers = [p for p in cluster.pods
+                 if p.namespace == svc.namespace and p.node_name and
+                 _sel_matches(svc.selector, p.labels)]
+    num = len(peers)
+    counts: dict[str, int] = {}
+    for peer in peers:
+        pn = cluster.node(peer.node_name)
+        if pn is not None and pn.is_ready() and label in pn.labels:
+            counts[pn.labels[label]] = counts.get(pn.labels[label], 0) + 1
+    out = {}
+    for node in nodes:
+        if label not in node.labels:
+            out[node.name] = 0
+        elif num == 0:
+            out[node.name] = MAX_PRIORITY
+        else:
+            v = node.labels[label]
+            out[node.name] = int(10.0 * (num - counts.get(v, 0)) / num)
+    return out
 
 
 def node_prefer_avoid(pod: api.Pod, cluster: ClusterState) -> dict[str, int]:
@@ -595,14 +666,25 @@ def inter_pod_affinity_priority(pod: api.Pod,
                     counts[node.name] = counts.get(node.name, 0) + weight
 
     req_a, req_aa, pref_a, pref_aa = _affinity_terms(pod)
-    for epod in cluster.pods:
+    # The pending pod's own preferred terms are checked against EVERY
+    # existing pod (their labels matter, not their affinity)...
+    if pref_a or pref_aa:
+        for epod in cluster.pods:
+            enode = cluster.node(epod.node_name)
+            if enode is None:
+                continue
+            for wt in pref_a:
+                process_term(wt.pod_affinity_term, pod, epod, enode,
+                             wt.weight)
+            for wt in pref_aa:
+                process_term(wt.pod_affinity_term, pod, epod, enode,
+                             -wt.weight)
+    # ...while existing pods' terms can only come from affinity-carrying
+    # pods (PodsWithAffinity, node_info.go:32-54).
+    for epod in cluster.affinity_pods():
         enode = cluster.node(epod.node_name)
         if enode is None:
             continue
-        for wt in pref_a:
-            process_term(wt.pod_affinity_term, pod, epod, enode, wt.weight)
-        for wt in pref_aa:
-            process_term(wt.pod_affinity_term, pod, epod, enode, -wt.weight)
         ereq_a, _, epref_a, epref_aa = _affinity_terms(epod)
         if cluster.hard_pod_affinity_weight > 0:
             for term in ereq_a:

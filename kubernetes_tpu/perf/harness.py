@@ -81,6 +81,172 @@ def density(num_nodes: int, num_pods: int, profile: str = "uniform",
         algorithm_ms_per_pod=elapsed / max(scheduled, 1) * 1e3)
 
 
+@dataclass
+class WireDensityResult:
+    num_nodes: int
+    num_pods: int
+    elapsed_s: float          # first pod POST -> last pod bound
+    scheduled: int
+    pods_per_second: float
+    create_s: float           # time to POST all pods (overlaps scheduling)
+    warm_s: float             # daemon-side compile warmup before the clock
+
+
+def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
+                 qps: float = 5000.0, burst: int = 5000,
+                 creators: int = 8, quiet: bool = False,
+                 timeout_s: float = 900.0) -> WireDensityResult:
+    """The density rig across a REAL process boundary: the apiserver runs
+    as a separate process (its own MemStore + HTTP surface, no jax), the
+    daemon in this process joins it over HTTP list/watch/bind at
+    QPS/Burst — the reference's rig shape (util.go:46-74 binds through a
+    real apiserver; client QPS/Burst 5000, util.go:63-64).  Pods are
+    created by parallel keep-alive connections like makePodsFromRC's
+    30-way creation (util.go:85-170); the clock runs from the first pod
+    POST until every pod is bound."""
+    import http.client
+    import os as _os
+    import subprocess
+    import sys as _sys
+    import socket
+    import threading
+
+    from kubernetes_tpu.scheduler.factory import ConfigFactory
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "kubernetes_tpu.apiserver",
+         "--port", str(port)],
+        env=dict(_os.environ),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def conn() -> http.client.HTTPConnection:
+        return http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+
+    def post(c, path: str, obj: dict) -> None:
+        c.request("POST", path, json.dumps(obj),
+                  {"Content-Type": "application/json"})
+        r = c.getresponse()
+        r.read()
+        if r.status not in (200, 201):
+            raise RuntimeError(f"POST {path}: {r.status}")
+
+    try:
+        # Wait for the apiserver socket.
+        deadline = time.time() + 30
+        while True:
+            try:
+                c0 = conn()
+                c0.request("GET", "/healthz")
+                c0.getresponse().read()
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise RuntimeError("apiserver never came up") from None
+                time.sleep(0.1)
+
+        nodes = synth.make_nodes(num_nodes, profile=profile, n_zones=4)
+        for nd in nodes:
+            post(c0, "/api/v1/nodes", {
+                "metadata": {"name": nd.name, "labels": dict(nd.labels),
+                             "annotations": dict(nd.annotations)},
+                "status": {
+                    "allocatable": {
+                        "cpu": f"{nd.allocatable_milli_cpu}m",
+                        "memory": str(nd.allocatable_memory),
+                        "pods": str(nd.allocatable_pods)},
+                    "conditions": [{"type": cc.type, "status": cc.status}
+                                   for cc in nd.conditions]}})
+
+        factory = ConfigFactory(f"http://127.0.0.1:{port}",
+                                qps=qps, burst=burst).run()
+        daemon = factory.daemon
+        # Live arrivals drain in whatever size the queue holds: route EVERY
+        # drain through the stream path, whose chunks are padded to one
+        # fixed shape — so the whole run compiles exactly one device
+        # program, no matter what sizes the arrival race produces.
+        daemon.STREAM_THRESHOLD = 1
+        daemon.stream_chunk = 4096
+
+        # Warm that one shape before the clock (the reference excludes
+        # apiserver warmup the same way); the cold-compile cost is
+        # reported, not hidden.
+        t_warm = time.perf_counter()
+        warm_pods = synth.make_pods(
+            min(num_pods, 2 * daemon.stream_chunk_size()),
+            profile=profile, name_prefix="warm")
+        for _ in factory.algorithm.schedule_batch_stream(
+                warm_pods, chunk_size=daemon.stream_chunk_size()):
+            pass
+        warm_s = time.perf_counter() - t_warm
+
+        pods = synth.make_pods(num_pods, profile=profile)
+        payloads = []
+        for pod in pods:
+            payloads.append(json.dumps({
+                "metadata": {"name": pod.name, "namespace": pod.namespace,
+                             "labels": dict(pod.labels),
+                             "annotations": dict(pod.annotations)},
+                "spec": {
+                    "nodeSelector": dict(pod.node_selector),
+                    "containers": [{
+                        "name": cc.name,
+                        "resources": {"requests": dict(cc.requests)}}
+                        for cc in pod.containers]}}))
+
+        start = time.perf_counter()
+        shards = [payloads[i::creators] for i in range(creators)]
+
+        def create(shard):
+            c = conn()
+            for body in shard:
+                c.request("POST", "/api/v1/pods", body,
+                          {"Content-Type": "application/json"})
+                r = c.getresponse()
+                r.read()
+
+        threads = [threading.Thread(target=create, args=(sh,), daemon=True)
+                   for sh in shards]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        create_s = time.perf_counter() - start
+
+        # Poll the daemon-side bind metric until the queue drains; cheap
+        # in-process read (the binder posts over the wire).
+        deadline = time.time() + timeout_s
+        bound = 0
+        while time.time() < deadline:
+            bound = factory.daemon.config.metrics.binding_latency._count
+            if bound >= num_pods:
+                break
+            time.sleep(0.25)
+        factory.daemon.wait_for_binds()
+        elapsed = time.perf_counter() - start
+        bound = factory.daemon.config.metrics.binding_latency._count
+        factory.stop()
+        if not quiet:
+            print(f"density-wire {num_nodes} nodes x {num_pods} pods: "
+                  f"{bound} bound in {elapsed:.3f}s = "
+                  f"{bound / max(elapsed, 1e-9):,.0f} pods/s "
+                  f"(create {create_s:.1f}s, warm compile {warm_s:.1f}s)",
+                  file=sys.stderr)
+        return WireDensityResult(
+            num_nodes=num_nodes, num_pods=num_pods, elapsed_s=elapsed,
+            scheduled=int(bound),
+            pods_per_second=int(bound) / max(elapsed, 1e-9),
+            create_s=create_s, warm_s=warm_s)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 BENCH_MATRIX = ((100, 0), (100, 1000), (1000, 0), (1000, 1000))
 
 

@@ -32,13 +32,17 @@ def make_nodes(n: int, seed: int = 0, profile: str = "uniform",
                memory: int = 32 * 1024 ** 3, pods: int = 110) -> list[api.Node]:
     """N ready nodes.  ``uniform`` mirrors the perf rig's identical nodes;
     ``mixed`` adds zone/region labels (3 regions x n_zones) and capacity
-    jitter like a kubemark fleet."""
+    jitter like a kubemark fleet; ``rich`` additionally taints ~8% of the
+    fleet (NoSchedule/PreferNoSchedule), marks ~2% NotReady and ~2% under
+    memory pressure — the full predicate surface for parity runs."""
     rng = np.random.RandomState(seed)
     out = []
     for i in range(n):
         labels = {api.HOSTNAME_LABEL: f"node-{i}"}
         cpu, mem, npods = milli_cpu, memory, pods
-        if profile == "mixed":
+        taints = None
+        conditions = list(_READY)
+        if profile in ("mixed", "rich"):
             if n_zones > 0:
                 z = int(rng.randint(n_zones))
                 labels[api.ZONE_LABEL] = f"zone-{z}"
@@ -46,10 +50,27 @@ def make_nodes(n: int, seed: int = 0, profile: str = "uniform",
             labels["kt/pool"] = f"pool-{int(rng.randint(4))}"
             scale = float(rng.choice([0.5, 1.0, 1.0, 2.0]))
             cpu, mem = int(milli_cpu * scale), int(memory * scale)
-        out.append(api.Node(
+        if profile == "rich":
+            r = rng.rand()
+            if r < 0.04:
+                taints = [{"key": "dedicated", "value": "infra",
+                           "effect": "NoSchedule"}]
+            elif r < 0.08:
+                taints = [{"key": "degraded", "value": "true",
+                           "effect": "PreferNoSchedule"}]
+            r = rng.rand()
+            if r < 0.02:
+                conditions = [api.NodeCondition(api.NODE_READY, "False")]
+            elif r < 0.04:
+                conditions = conditions + [
+                    api.NodeCondition("MemoryPressure", "True")]
+        node = api.Node(
             name=f"node-{i}", labels=labels,
             allocatable_milli_cpu=cpu, allocatable_memory=mem,
-            allocatable_pods=npods, conditions=list(_READY)))
+            allocatable_pods=npods, conditions=conditions)
+        if taints is not None:
+            node.annotations[api.TAINTS_ANNOTATION_KEY] = json.dumps(taints)
+        out.append(node)
     return out
 
 
@@ -72,7 +93,10 @@ def make_pods(n: int, seed: int = 1, profile: str = "uniform",
               name_prefix: str = "pod") -> list[api.Pod]:
     """N pending pods.  ``uniform`` = identical pause pods; ``mixed`` adds
     service-labeled spreading groups, node selectors, and affinity
-    annotations in kubemark-like proportions."""
+    annotations in kubemark-like proportions; ``rich`` additionally mixes
+    in required pod anti-affinity replica groups (don't co-locate), soft
+    pod affinity toward a service, EBS volumes, host ports, and
+    tolerations — the full feature surface for parity runs."""
     rng = np.random.RandomState(seed)
     out = []
     for i in range(n):
@@ -83,6 +107,7 @@ def make_pods(n: int, seed: int = 1, profile: str = "uniform",
         labels: dict[str, str] = {}
         annotations: dict[str, str] = {}
         node_selector: dict[str, str] = {}
+        kw: dict = {}
         cpu = int(rng.choice([50, 100, 200, 500]))
         mem = int(rng.choice([128, 256, 500, 1024])) * 1024 ** 2
         if n_services and r < 0.4:  # service-member pods spread
@@ -98,9 +123,47 @@ def make_pods(n: int, seed: int = 1, profile: str = "uniform",
                             "key": api.ZONE_LABEL, "operator": "In",
                             "values": [f"zone-{int(rng.randint(4))}"]}]},
                     }]}})
-        out.append(_pause_pod(f"{name_prefix}-{i}", namespace, labels=labels, milli_cpu=cpu,
-                              memory=mem, node_selector=node_selector,
-                              annotations=annotations))
+        if profile == "rich":
+            rr = rng.rand()
+            if rr < 0.02:
+                # Replica group spread across hosts: required anti-affinity
+                # against the pod's own small group.
+                g = f"g{i // 3}"
+                labels["kt/aa"] = g
+                annotations[api.AFFINITY_ANNOTATION_KEY] = json.dumps({
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [{
+                            "labelSelector": {"matchLabels": {"kt/aa": g}},
+                            "topologyKey": api.HOSTNAME_LABEL}]}})
+            elif rr < 0.04 and n_services:
+                # Soft co-location with a service's pods by zone.
+                annotations[api.AFFINITY_ANNOTATION_KEY] = json.dumps({
+                    "podAffinity": {
+                        "preferredDuringSchedulingIgnoredDuringExecution": [{
+                            "weight": int(rng.randint(1, 10)),
+                            "podAffinityTerm": {
+                                "labelSelector": {"matchLabels": {
+                                    "app": f"svc-{int(rng.randint(n_services))}"}},
+                                "topologyKey": api.ZONE_LABEL}}]}})
+            rr = rng.rand()
+            if rr < 0.03:
+                kw["volumes"] = [api.Volume(
+                    name="data", aws_ebs_id=f"vol-{int(rng.randint(200))}",
+                    aws_read_only=bool(rng.rand() < 0.5))]
+            rr = rng.rand()
+            if rr < 0.05:
+                annotations[api.TOLERATIONS_ANNOTATION_KEY] = json.dumps([
+                    {"key": "dedicated", "operator": "Equal",
+                     "value": "infra", "effect": "NoSchedule"}])
+        pod = _pause_pod(f"{name_prefix}-{i}", namespace, labels=labels,
+                         milli_cpu=cpu, memory=mem,
+                         node_selector=node_selector,
+                         annotations=annotations, **kw)
+        if profile == "rich" and rng.rand() < 0.02:
+            pod.containers[0].ports = [api.ContainerPort(
+                container_port=8080,
+                host_port=int(rng.choice([30080, 30443, 31000])))]
+        out.append(pod)
     return out
 
 

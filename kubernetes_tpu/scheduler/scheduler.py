@@ -102,6 +102,13 @@ class Scheduler:
     STREAM_THRESHOLD = int(os.environ.get("KT_STREAM_CHUNK", "0") or "0") \
         or (1 << 62)
 
+    # Drains below this size are routed through the stream path with a
+    # power-of-two chunk, whose live-flag padding gives them a fixed
+    # compiled shape — a live-arrival workload (queue drained while pods
+    # trickle in) then compiles at most log2 distinct batch shapes
+    # instead of one per queue length.
+    _PAD_LIMIT = 4096
+
     def schedule_pending(self, wait_first: bool = True,
                          timeout: Optional[float] = None) -> int:
         """Drain the queue and solve it as one device batch.  Returns the
@@ -109,9 +116,32 @@ class Scheduler:
         pods = self.queue.pop_all(wait_first=wait_first, timeout=timeout)
         if not pods:
             return 0
+        try:
+            return self._solve_drain(pods)
+        except Exception:  # noqa: BLE001 — HandleCrash analogue
+            # The pods were already popped: requeue each through the
+            # backoff path (condition + event + delayed retry) so a
+            # crashing drain can't silently strand them Pending, and a
+            # poison pod retries at most once per 60 s.
+            log.exception("drain of %d pods crashed; requeueing", len(pods))
+            cache = self.config.algorithm.cache
+            for pod in pods:
+                if not cache.is_assumed(pod.key):
+                    self._handle_failure(pod, "SchedulingError",
+                                         "internal error during scheduling")
+            return len(pods)
+
+    def _solve_drain(self, pods: list) -> int:
         if len(pods) >= self.STREAM_THRESHOLD and \
                 not self.config.algorithm.extenders:
             return self._schedule_pending_stream(pods)
+        if len(pods) < self._PAD_LIMIT and \
+                not self.config.algorithm.extenders:
+            # Small drain: one power-of-two stream chunk (live-flag
+            # padded), so arrival races don't mint a new compiled shape
+            # per queue length.
+            bucket = 1 << (len(pods) - 1).bit_length()
+            return self._schedule_pending_stream(pods, chunk_size=bucket)
         start = time.perf_counter()
         placements = self.config.algorithm.schedule_batch(pods)
         algo_us = (time.perf_counter() - start) * 1e6 / len(pods)
@@ -150,12 +180,16 @@ class Scheduler:
         else:
             self._bind_assumed_batch(placed, start)
 
+    # Fixed stream chunk override (else derived from STREAM_THRESHOLD).
+    stream_chunk: int = 0
+
     def stream_chunk_size(self) -> int:
         """Chunk size the streamed drain compiles at (harness warmup must
         pre-trace the same shape)."""
-        return min(self.STREAM_THRESHOLD, 8192)
+        return self.stream_chunk or min(self.STREAM_THRESHOLD, 8192)
 
-    def _schedule_pending_stream(self, pods: list[api.Pod]) -> int:
+    def _schedule_pending_stream(self, pods: list[api.Pod],
+                                 chunk_size: Optional[int] = None) -> int:
         """The pipelined drain: as each device chunk lands, bulk-assume it
         and hand it to an async binder thread while the device scans the
         next chunk.  Same observable state machine as the one-shot path."""
@@ -163,7 +197,7 @@ class Scheduler:
         solve_done = start
         for chunk_pods, placements in \
                 self.config.algorithm.schedule_batch_stream(
-                    pods, chunk_size=self.stream_chunk_size()):
+                    pods, chunk_size=chunk_size or self.stream_chunk_size()):
             solve_done = time.perf_counter()
             self._assume_and_bind_batch(chunk_pods, placements, start)
         # Algorithm latency spans until the LAST chunk's results landed
@@ -178,13 +212,21 @@ class Scheduler:
 
     def run(self, batched: bool = True) -> threading.Thread:
         """wait.Until(scheduleOne, 0, stop) (scheduler.go:89-91), in a
-        daemon thread; batched mode drains the queue per iteration."""
+        daemon thread; batched mode drains the queue per iteration.  A
+        crashing iteration is logged and the loop continues — the
+        reference's runtime.HandleCrash keeps its daemons alive the same
+        way; without this, one bad drain kills scheduling forever."""
         def loop():
             while not self._stop.is_set():
-                if batched:
-                    self.schedule_pending(timeout=0.05)
-                else:
-                    self.schedule_one(timeout=0.05)
+                try:
+                    if batched:
+                        self.schedule_pending(timeout=0.05)
+                    else:
+                        self.schedule_one(timeout=0.05)
+                except Exception:  # noqa: BLE001 — HandleCrash analogue
+                    log.exception("scheduling iteration crashed; "
+                                  "continuing")
+                    time.sleep(0.5)
         t = threading.Thread(target=loop, daemon=True,
                              name="scheduler-loop")
         t.start()

@@ -170,3 +170,29 @@ class TestBatchedDrain:
         text = s.config.metrics.expose()
         assert "scheduler_e2e_scheduling_latency_microseconds_bucket" in text
         assert 'le="1000"' in text and 'le="+Inf"' in text
+
+class TestDrainPadding:
+    def test_padding_is_decision_neutral(self):
+        """schedule_pending pads small drains to power-of-two buckets;
+        pad pods are infeasible everywhere and must not change any real
+        pod's placement (tie counter bumps only on success)."""
+        from kubernetes_tpu.engine.generic_scheduler import GenericScheduler
+        algo = GenericScheduler()
+        for i in range(5):
+            algo.cache.add_node(make_node(f"n{i}", milli_cpu=2000))
+        pods = [make_pod(f"q{i}", cpu="300m") for i in range(11)]
+        bare = algo.schedule_batch([make_pod(f"q{i}", cpu="300m")
+                                    for i in range(11)])
+        s = _scheduler(n_nodes=0)
+        for i in range(5):
+            s.config.algorithm.cache.add_node(make_node(f"n{i}",
+                                                        milli_cpu=2000))
+        for p in pods:
+            s.enqueue(p)
+        assert s.schedule_pending() == 11  # 11 -> padded to 16 internally
+        binder = s.config.binder
+        got = [binder.bound_node(f"default/q{i}") for i in range(11)]
+        assert got == bare
+        # No pad pod leaked into the binder or the cache.
+        assert binder.count() == 11
+        assert s.config.algorithm.cache.pod_count() == 11

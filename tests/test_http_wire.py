@@ -60,7 +60,7 @@ def _pod_json(name: str, cpu: str = "100m") -> dict:
 
 
 @pytest.fixture(scope="module")
-def wire():
+def wire(tmp_path_factory):
     """In-process apiserver HTTP (own thread/socket) + daemon SUBPROCESS."""
     store = MemStore()
     api_srv = serve(store, port=0)
@@ -69,12 +69,17 @@ def wire():
 
     status_port = _free_port()
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    # Daemon output goes to a file, not PIPE: an undrained pipe fills at
+    # ~64 KB and blocks the daemon mid-write.
+    errlog = tmp_path_factory.mktemp("daemon") / "stderr.log"
+    errf = open(errlog, "wb")
     proc = subprocess.Popen(
         [sys.executable, "-m", "kubernetes_tpu.scheduler",
          "--api-server", api_url, "--port", str(status_port),
          "--kube-api-qps", "5000", "--kube-api-burst", "5000"],
         env=env, cwd=REPO,
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        stdout=subprocess.DEVNULL, stderr=errf)
+    errf.close()
     # Wait for the daemon's /healthz.
     deadline = time.time() + 60
     while time.time() < deadline:
@@ -84,8 +89,8 @@ def wire():
         except OSError:
             time.sleep(0.2)
         if proc.poll() is not None:
-            out, err = proc.communicate()
-            raise RuntimeError(f"daemon died: {err.decode()[-2000:]}")
+            raise RuntimeError(
+                f"daemon died: {errlog.read_text()[-2000:]}")
     else:
         proc.kill()
         raise RuntimeError("daemon /healthz never came up")
@@ -96,6 +101,9 @@ def wire():
     except subprocess.TimeoutExpired:
         proc.kill()
     api_srv.shutdown()
+    err_tail = errlog.read_text()[-4000:]
+    if "Traceback" in err_tail:
+        print(f"\n--- daemon stderr tail ---\n{err_tail}", file=sys.stderr)
 
 
 def test_thousand_pods_over_http_only(wire):
