@@ -66,7 +66,8 @@ def validate_policy(policy: Policy) -> None:
         if not ext.filter_verb and not ext.prioritize_verb:
             errors.append(f"Extender {ext.url_prefix} must configure a "
                           f"filterVerb or prioritizeVerb")
-    if policy.hard_pod_affinity_symmetric_weight < 0:
-        errors.append("hardPodAffinitySymmetricWeight must be non-negative")
+    if not 0 <= policy.hard_pod_affinity_symmetric_weight <= 100:
+        # factory.go:305 rejects values outside 0-100.
+        errors.append("hardPodAffinitySymmetricWeight must be in [0, 100]")
     if errors:
         raise PolicyValidationError(errors)

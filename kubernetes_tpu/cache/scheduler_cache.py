@@ -192,13 +192,25 @@ class SchedulerCache:
                 idxs.append(idx)
         if not self._dirty_nodes and pods:
             import numpy as np
-            if (agg_handoff is not None
-                    and agg_handoff[0] == gen_at_entry
-                    and not skipped and len(pods) == len(assignments)):
+            use_handoff = (agg_handoff is not None
+                           and agg_handoff[0] == gen_at_entry
+                           and not skipped
+                           and len(pods) == len(assignments))
+            if use_handoff:
+                # The handoff is stamped with the solve's placement
+                # signature: ingest only if this assume is EXACTLY that
+                # set (a different set at an unchanged generation would
+                # corrupt requested/nonzero).
+                name_to_idx = agg_handoff[2].name_to_idx
+                sig = hash(frozenset(
+                    (pod.key, name_to_idx.get(node, -1))
+                    for pod, node in assignments))
+                use_handoff = sig == agg_handoff[1]
+            if use_handoff:
                 # copy(): jax->numpy views are read-only, later incremental
                 # updates write in place.
-                self._agg.requested = np.asarray(agg_handoff[1]).copy()
-                self._agg.nonzero = np.asarray(agg_handoff[2]).copy()
+                self._agg.requested = np.asarray(agg_handoff[3]).copy()
+                self._agg.nonzero = np.asarray(agg_handoff[4]).copy()
             else:
                 self._agg = fc.add_pods_to_aggregates_bulk(
                     self._agg, idxs, pods, self.space)
@@ -261,6 +273,11 @@ class SchedulerCache:
     def is_assumed(self, key: str) -> bool:
         st = self._pod_states.get(key)
         return st is not None and st.assumed
+
+    @_locked
+    def contains(self, key: str) -> bool:
+        """Pod is tracked at all (assumed OR confirmed)."""
+        return key in self._pod_states
 
     @_locked
     def pod_count(self) -> int:

@@ -250,29 +250,38 @@ class GenericScheduler:
                       len({getattr(p, "_tpl_key", None) for p in pods}),
                       dc.alloc.shape[0], joint, flags)
         self._agg_handoff = None
+        from kubernetes_tpu.utils.profiling import device_trace
         if joint:
-            choices, new_last, _ = self.solver.solve_joint(
-                db, dc, jnp.uint32(self.last_node_index), flags=flags)
+            with device_trace("solve_joint"):
+                choices, new_last, _ = self.solver.solve_joint(
+                    db, dc, jnp.uint32(self.last_node_index), flags=flags)
+                rows = np.asarray(choices).tolist()
             self.last_node_index = np.uint32(new_last)
-            rows = np.asarray(choices).tolist()
         else:
             # One packed device->host fetch for the whole drain (each fetch
             # is a full RTT on a tunneled chip): choices + tie counter +
             # final aggregates.
             p, n = len(pods), dc.alloc.shape[0]
-            host = np.asarray(self.solver.solve_sequential_packed(
-                db, dc, jnp.uint32(self.last_node_index), flags))
+            with device_trace("solve_sequential"):
+                host = np.asarray(self.solver.solve_sequential_packed(
+                    db, dc, jnp.uint32(self.last_node_index), flags))
             rows = host[:p].tolist()
             self.last_node_index = np.uint32(host[p])
             # Device-aggregate handoff: the scan's final requested/nonzero
             # equal the snapshot plus every in-batch placement, so
             # assume_pods can ingest them instead of re-aggregating — valid
             # only when the batch carries no port/volume state (host-only
-            # counters) and the cache hasn't moved since the snapshot.
+            # counters), the cache hasn't moved since the snapshot, and the
+            # assumed set is EXACTLY this solve's placements (stamped with
+            # their signature so a caller can't pair the aggregates with a
+            # different assignment set at an unchanged generation).
             if not (flags.any_ports or flags.any_volumes or flags.any_ebs
                     or flags.any_gce):
+                placed_sig = hash(frozenset(
+                    (pod.key, rows[i]) for i, pod in enumerate(pods)
+                    if rows[i] >= 0))
                 self._agg_handoff = (
-                    self._snapshot_generation,
+                    self._snapshot_generation, placed_sig, nt,
                     host[p + 1:p + 1 + 4 * n].reshape(n, 4),
                     host[p + 1 + 4 * n:].reshape(n, 2))
         names = nt.names
@@ -330,11 +339,13 @@ class GenericScheduler:
                           for c in rows[: stop - start]]
             return chunk_pods, placements
 
+        from kubernetes_tpu.utils.profiling import device_trace
         for start in range(0, padded, chunk_size):
             db_k = sv.slice_pod_axis(db, start, start + chunk_size)
             live = jnp.asarray(live_np[start:start + chunk_size])
-            choices_k, counter, carry = self.solver._solve_scan(
-                db_k, dc, counter, None, flags, carry, live)
+            with device_trace("solve_stream_chunk"):
+                choices_k, counter, carry = self.solver._solve_scan(
+                    db_k, dc, counter, None, flags, carry, live)
             pending.append((start, choices_k))
             if len(pending) > 1:
                 yield emit(*pending.pop(0))

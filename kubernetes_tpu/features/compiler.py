@@ -142,63 +142,32 @@ class ExistingPodTensors:
 
 
 def compile_nodes(nodes: Sequence[api.Node], space: FeatureSpace) -> NodeTensors:
-    """Build static node tensors, interning all label/taint/image tokens."""
+    """Build static node tensors, interning all label/taint/image tokens.
+    Row encoding is shared with the incremental churn path
+    (update_node_row/append_node_row) via _intern_node/_write_node_row, so
+    rebuilt rows and incrementally-updated rows cannot diverge."""
     n = len(nodes)
     # Intern first so capacities are final before allocation.
     for node in nodes:
-        for k, v in node.labels.items():
-            space.labels.kv_id(k, v)
-            space.labels.key_id(k)
-        for t in node.taints():
-            space.taints.id(f"{t.key}={t.value}:{t.effect}")
-        for img in node.images:
-            for name in img.names:
-                space.images.id(name)
-        for ki, key in enumerate(space.topo_keys.tokens()):
-            if key in node.labels:
-                space.topo_vals.id(f"{key}={node.labels[key]}")
+        _intern_node(node, space)
 
     V, T, I, K = (space.labels.capacity, space.taints.capacity,
                   space.images.capacity, space.topo_keys.capacity)
-    alloc = np.zeros((n, 4), np.int32)
-    labels = np.zeros((n, V), bool)
-    t_ns = np.zeros((n, T), bool)
-    t_pref = np.zeros((n, T), bool)
-    memp = np.zeros(n, bool)
-    diskp = np.zeros(n, bool)
-    sched = np.zeros(n, bool)
-    image_kib = np.zeros((n, I), np.int32)
-    topo_val = np.full((n, K), -1, np.int32)
-
-    for i, node in enumerate(nodes):
-        alloc[i] = (node.allocatable_milli_cpu, _mib_floor(node.allocatable_memory),
-                    node.allocatable_gpu, node.allocatable_pods)
-        for k, v in node.labels.items():
-            labels[i, space.labels.kv_id(k, v)] = True
-            labels[i, space.labels.key_id(k)] = True
-        for t in node.taints():
-            tid = space.taints.id(f"{t.key}={t.value}:{t.effect}")
-            if t.effect == api.TAINT_EFFECT_PREFER_NO_SCHEDULE:
-                t_pref[i, tid] = True
-            else:
-                t_ns[i, tid] = True
-        memp[i] = node.condition(api.NODE_MEMORY_PRESSURE) == "True"
-        diskp[i] = node.condition(api.NODE_DISK_PRESSURE) == "True"
-        sched[i] = node.is_ready()
-        for img in node.images:
-            kib = img.size_bytes // 1024
-            for name in img.names:
-                image_kib[i, space.images.id(name)] = kib
-        for ki, key in enumerate(space.topo_keys.tokens()):
-            if key in node.labels:
-                topo_val[i, ki] = space.topo_vals.id(f"{key}={node.labels[key]}")
-
-    return NodeTensors(
+    nt = NodeTensors(
         names=[nd.name for nd in nodes],
         name_to_idx={nd.name: i for i, nd in enumerate(nodes)},
-        alloc=alloc, labels=labels, taints_nosched=t_ns, taints_prefer=t_pref,
-        mem_pressure=memp, disk_pressure=diskp, schedulable=sched,
-        image_kib=image_kib, topo_val=topo_val)
+        alloc=np.zeros((n, 4), np.int32),
+        labels=np.zeros((n, V), bool),
+        taints_nosched=np.zeros((n, T), bool),
+        taints_prefer=np.zeros((n, T), bool),
+        mem_pressure=np.zeros(n, bool),
+        disk_pressure=np.zeros(n, bool),
+        schedulable=np.zeros(n, bool),
+        image_kib=np.zeros((n, I), np.int32),
+        topo_val=np.full((n, K), -1, np.int32))
+    for i, node in enumerate(nodes):
+        _write_node_row(nt, i, node, space)
+    return nt
 
 
 def _intern_node(node: api.Node, space: FeatureSpace) -> None:

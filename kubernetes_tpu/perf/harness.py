@@ -81,6 +81,40 @@ def density(num_nodes: int, num_pods: int, profile: str = "uniform",
         algorithm_ms_per_pod=elapsed / max(scheduled, 1) * 1e3)
 
 
+def _pod_payload(pod) -> dict:
+    """Full v1 serialization of a synth pod — volumes and host ports
+    included, so rich-profile wire runs exercise the same predicate
+    surface as the in-process run."""
+    containers = []
+    for cc in pod.containers:
+        c: dict = {"name": cc.name,
+                   "resources": {"requests": dict(cc.requests)}}
+        if cc.ports:
+            c["ports"] = [{"containerPort": p.container_port,
+                           "hostPort": p.host_port,
+                           "protocol": p.protocol} for p in cc.ports]
+        containers.append(c)
+    spec: dict = {"nodeSelector": dict(pod.node_selector),
+                  "containers": containers}
+    vols = []
+    for v in pod.volumes:
+        if v.aws_ebs_id:
+            vols.append({"name": v.name, "awsElasticBlockStore": {
+                "volumeID": v.aws_ebs_id, "readOnly": v.aws_read_only}})
+        elif v.gce_pd_name:
+            vols.append({"name": v.name, "gcePersistentDisk": {
+                "pdName": v.gce_pd_name, "readOnly": v.gce_read_only}})
+        elif v.pvc_claim_name:
+            vols.append({"name": v.name, "persistentVolumeClaim": {
+                "claimName": v.pvc_claim_name}})
+    if vols:
+        spec["volumes"] = vols
+    return {"metadata": {"name": pod.name, "namespace": pod.namespace,
+                         "labels": dict(pod.labels),
+                         "annotations": dict(pod.annotations)},
+            "spec": spec}
+
+
 @dataclass
 class WireDensityResult:
     num_nodes: int
@@ -133,6 +167,7 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         if r.status not in (200, 201):
             raise RuntimeError(f"POST {path}: {r.status}")
 
+    factory = None
     try:
         # Wait for the apiserver socket.
         deadline = time.time() + 30
@@ -183,21 +218,11 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         warm_s = time.perf_counter() - t_warm
 
         pods = synth.make_pods(num_pods, profile=profile)
-        payloads = []
-        for pod in pods:
-            payloads.append(json.dumps({
-                "metadata": {"name": pod.name, "namespace": pod.namespace,
-                             "labels": dict(pod.labels),
-                             "annotations": dict(pod.annotations)},
-                "spec": {
-                    "nodeSelector": dict(pod.node_selector),
-                    "containers": [{
-                        "name": cc.name,
-                        "resources": {"requests": dict(cc.requests)}}
-                        for cc in pod.containers]}}))
+        payloads = [json.dumps(_pod_payload(pod)) for pod in pods]
 
         start = time.perf_counter()
         shards = [payloads[i::creators] for i in range(creators)]
+        create_failures: list[str] = []
 
         def create(shard):
             c = conn()
@@ -205,7 +230,10 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
                 c.request("POST", "/api/v1/pods", body,
                           {"Content-Type": "application/json"})
                 r = c.getresponse()
-                r.read()
+                resp_body = r.read()
+                if r.status not in (200, 201):
+                    create_failures.append(
+                        f"{r.status}: {resp_body[:200]!r}")
 
         threads = [threading.Thread(target=create, args=(sh,), daemon=True)
                    for sh in shards]
@@ -213,21 +241,37 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
             t.start()
         for t in threads:
             t.join()
+        if create_failures:
+            raise RuntimeError(
+                f"{len(create_failures)} pod creates failed; first: "
+                f"{create_failures[0]}")
         create_s = time.perf_counter() - start
 
         # Poll the daemon-side bind metric until the queue drains; cheap
-        # in-process read (the binder posts over the wire).
+        # in-process read (the binder posts over the wire).  A workload
+        # with genuinely unschedulable pods (rich profile) never reaches
+        # bound == num_pods, so also stop when binding makes no progress
+        # for a stall window.
         deadline = time.time() + timeout_s
         bound = 0
+        last_change = time.perf_counter()
+        stalled = False
         while time.time() < deadline:
-            bound = factory.daemon.config.metrics.binding_latency._count
+            now_bound = factory.daemon.config.metrics.binding_latency._count
+            if now_bound != bound:
+                bound = now_bound
+                last_change = time.perf_counter()
             if bound >= num_pods:
+                break
+            if time.perf_counter() - last_change > 15.0:
+                stalled = True
                 break
             time.sleep(0.25)
         factory.daemon.wait_for_binds()
-        elapsed = time.perf_counter() - start
+        # On a stall exit the clock stops at the LAST bind, not at stall
+        # detection — the tail is idle requeue time of unschedulable pods.
+        elapsed = (last_change if stalled else time.perf_counter()) - start
         bound = factory.daemon.config.metrics.binding_latency._count
-        factory.stop()
         if not quiet:
             print(f"density-wire {num_nodes} nodes x {num_pods} pods: "
                   f"{bound} bound in {elapsed:.3f}s = "
@@ -240,6 +284,10 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
             pods_per_second=int(bound) / max(elapsed, 1e-9),
             create_s=create_s, warm_s=warm_s)
     finally:
+        # Stop the daemon's reflector/scheduler threads on EVERY exit path
+        # (left running they'd relist-spin against the dead apiserver).
+        if factory is not None:
+            factory.stop()
         proc.terminate()
         try:
             proc.wait(timeout=10)

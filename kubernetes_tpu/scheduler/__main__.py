@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--leader-elect-retry-period", type=float, default=2.0)
     p.add_argument("--v", type=int, default=None,
                    help="log verbosity (glog-style; also KT_LOG_V)")
+    p.add_argument("--profile-dir", default="",
+                   help="write jax.profiler device traces of every solve "
+                        "here (also KT_PROFILE_DIR; view with XProf)")
     return p
 
 
@@ -110,6 +113,20 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
             elif self.path == "/configz":
                 self._send(200, json.dumps(configz).encode(),
                            "application/json")
+            elif self.path.startswith("/debug/pprof"):
+                # The goroutine-dump analogue (app/server.go:96-100): all
+                # live thread stacks.
+                from kubernetes_tpu.utils.profiling import thread_stacks
+                self._send(200, thread_stacks().encode())
+            elif self.path == "/debug/vars":
+                cache = factory.algorithm.cache
+                self._send(200, json.dumps({
+                    "queueDepth": len(factory.daemon.queue),
+                    "cachedPods": cache.pod_count(),
+                    "cachedNodes": len(cache.nodes()),
+                    "cacheStats": cache.stats,
+                    "generation": cache.generation,
+                }).encode(), "application/json")
             else:
                 self._send(404, b"not found")
 
@@ -122,6 +139,9 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
 def main(argv=None) -> int:
     opts = build_parser().parse_args(argv)
     configure(v=opts.v)
+    if opts.profile_dir:
+        from kubernetes_tpu.utils.profiling import set_profile_dir
+        set_profile_dir(opts.profile_dir)
     policy = load_policy(opts)
     configz = {
         "apiServer": opts.api_server or "(in-process)",

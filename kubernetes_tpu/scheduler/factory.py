@@ -154,8 +154,9 @@ class ConfigFactory:
         if isinstance(store, APIClient):
             binder = APIClientBinder(store)
             events_client = APIClient(store.base_url, qps=0)
-            recorder = EventRecorder(sink=_throttled_sink(
-                make_event_sink(events_client), qps, burst))
+            from kubernetes_tpu.utils.events import async_sink
+            recorder = EventRecorder(sink=async_sink(_throttled_sink(
+                make_event_sink(events_client), qps, burst)))
         else:
             binder = MemStoreBinder(store)
             recorder = EventRecorder(sink=None)
@@ -302,3 +303,7 @@ class ConfigFactory:
         for r in self._reflectors:
             r.stop()
         self.daemon.stop()
+        sink = getattr(self.daemon.config.recorder, "_sink", None)
+        close = getattr(sink, "close", None)
+        if close is not None:
+            close()

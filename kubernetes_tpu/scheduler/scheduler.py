@@ -126,7 +126,10 @@ class Scheduler:
             log.exception("drain of %d pods crashed; requeueing", len(pods))
             cache = self.config.algorithm.cache
             for pod in pods:
-                if not cache.is_assumed(pod.key):
+                # Skip pods the crash didn't strand: anything tracked in
+                # the cache (assumed by a completed chunk, or already
+                # confirmed bound by the watch) made it through.
+                if not cache.contains(pod.key):
                     self._handle_failure(pod, "SchedulingError",
                                          "internal error during scheduling")
             return len(pods)
@@ -296,15 +299,17 @@ class Scheduler:
         bind_start = time.perf_counter()
         bind_many = getattr(self.config.binder, "bind_many", None)
         if bind_many is not None:
-            conflicted = {pod.key for pod, _ in bind_many(placed)}
+            failed = {pod.key: err for pod, err in bind_many(placed)}
             ok = 0
             items = []
             for pod, dest in placed:
-                if pod.key in conflicted:
+                if pod.key in failed:
                     cache.forget_pod(pod)
+                    # Surface the real error: a CAS conflict and a
+                    # network failure require different operator action.
                     self._handle_failure(
                         pod, "FailedScheduling",
-                        f"Binding rejected: pod {pod.key} already bound")
+                        f"Binding rejected: {failed[pod.key]}")
                 else:
                     ok += 1
                     items.append((pod.key, "Normal", "Scheduled",

@@ -5,6 +5,7 @@ default keeps a bounded in-memory ring like the apiserver's event window."""
 from __future__ import annotations
 
 import collections
+import queue as _queue
 import threading
 import time
 from dataclasses import dataclass
@@ -55,3 +56,44 @@ class EventRecorder:
         if object_key is not None:
             evs = [e for e in evs if e.object_key == object_key]
         return evs
+
+
+_SINK_CLOSED = object()
+
+
+def async_sink(sink, max_pending: int = 8192):
+    """Wrap a sink so posting never blocks the scheduling loop: events go
+    through a bounded queue drained by one background thread, and overflow
+    is DROPPED — the reference's event broadcaster behaves exactly this
+    way (record/event.go buffered channel; a full buffer drops).  At wire
+    bind rates a synchronous sink serializes ~0.5 ms per event into the
+    drain loop; 30k binds would cost ~15 s of scheduling stall.
+
+    The returned callable carries ``.close()`` (StopEventWatcher analogue)
+    so owners can terminate the pump thread."""
+    q: "_queue.Queue" = _queue.Queue(maxsize=max_pending)
+
+    def pump():
+        while True:
+            ev = q.get()
+            if ev is _SINK_CLOSED:
+                return
+            try:
+                sink(ev)
+            except Exception:  # noqa: BLE001 — event loss is non-fatal
+                pass
+
+    threading.Thread(target=pump, daemon=True,
+                     name="event-sink-pump").start()
+
+    def enqueue(ev) -> None:
+        try:
+            q.put_nowait(ev)
+        except _queue.Full:
+            pass  # drop under pressure (broadcaster semantics)
+
+    def close() -> None:
+        q.put(_SINK_CLOSED)
+
+    enqueue.close = close
+    return enqueue

@@ -1,0 +1,53 @@
+"""Profiling hooks: the TPU analogue of the reference's pprof surface.
+
+Every reference daemon serves /debug/pprof (app/server.go:96-100) and the
+perf rig collects cpu/mem/block profiles
+(test/component/scheduler/perf/test-performance.sh).  Here the device side
+is XLA, so the equivalent is a ``jax.profiler`` trace around the device
+solve — flag-gated by ``--profile-dir`` / ``KT_PROFILE_DIR`` — which
+captures per-op device timelines viewable in TensorBoard/XProf; the host
+side is the /debug/stacks thread dump the daemon mux serves (the
+goroutine-dump analogue).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import traceback
+
+_PROFILE_DIR = [os.environ.get("KT_PROFILE_DIR", "")]
+
+
+def set_profile_dir(path: str) -> None:
+    _PROFILE_DIR[0] = path or ""
+
+
+@contextlib.contextmanager
+def device_trace(label: str):
+    """jax.profiler trace around a device solve when profiling is enabled
+    (no-op — zero overhead — otherwise)."""
+    if not _PROFILE_DIR[0]:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(_PROFILE_DIR[0]):
+        with jax.profiler.TraceAnnotation(label):
+            yield
+
+
+def thread_stacks() -> str:
+    """All live thread stacks as text — /debug/pprof/goroutine?debug=2."""
+    frames = sys._current_frames()
+    out = []
+    for t in threading.enumerate():
+        frame = frames.get(t.ident)
+        out.append(f"thread {t.name} (daemon={t.daemon}, "
+                   f"alive={t.is_alive()}):")
+        if frame is not None:
+            out.extend("  " + ln for ln in
+                       traceback.format_stack(frame))
+        out.append("")
+    return "\n".join(out)

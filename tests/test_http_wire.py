@@ -145,6 +145,13 @@ def test_daemon_status_endpoints(wire):
     assert cfg["schedulerName"] == "default-scheduler"
     assert "PodFitsResources" in cfg["predicates"] or \
         "GeneralPredicates" in cfg["predicates"]
+    # /debug/pprof analogue: live thread stacks (app/server.go:96-100).
+    code, body = _get(f"{status_url}/debug/pprof/goroutine")
+    assert code == 200 and "scheduler-loop" in body
+    code, body = _get(f"{status_url}/debug/vars")
+    assert code == 200
+    dv = json.loads(body)
+    assert "queueDepth" in dv and "cacheStats" in dv
 
 
 def test_unschedulable_then_capacity_frees(wire):

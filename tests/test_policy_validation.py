@@ -142,3 +142,17 @@ def test_compatibility_1_1():
         ("SelectorSpreadPriority", 2)]
     validate_policy(policy)
     Solver(policy)
+
+
+def test_hard_pod_affinity_weight_above_100_rejected():
+    """factory.go:305: the symmetric weight must be within 0-100."""
+    from kubernetes_tpu.api.policy import default_provider
+    from kubernetes_tpu.api.validation import (PolicyValidationError,
+                                               validate_policy)
+    pol = default_provider()
+    pol.hard_pod_affinity_symmetric_weight = 500
+    try:
+        validate_policy(pol)
+        raise AssertionError("weight 500 passed validation")
+    except PolicyValidationError as err:
+        assert "0, 100" in str(err)

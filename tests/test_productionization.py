@@ -213,3 +213,27 @@ class TestPolicySchemaCompat:
         assert back_n.allocatable_memory == node.allocatable_memory
         assert [t.key for t in back_n.taints()] == ["k"]
         assert back_n.is_ready() == node.is_ready()
+
+class TestObservability:
+    def test_device_trace_writes_profile(self, tmp_path):
+        """--profile-dir captures a jax.profiler device trace per solve
+        (the TPU pprof analogue, SURVEY §5 tracing row)."""
+        from kubernetes_tpu.engine.generic_scheduler import GenericScheduler
+        from kubernetes_tpu.utils import profiling
+        from helpers import make_node, make_pod
+        eng = GenericScheduler()
+        for i in range(4):
+            eng.cache.add_node(make_node(f"n{i}"))
+        profiling.set_profile_dir(str(tmp_path))
+        try:
+            eng.schedule_batch([make_pod("p1"), make_pod("p2")])
+        finally:
+            profiling.set_profile_dir("")
+        written = list(tmp_path.rglob("*"))
+        assert any(p.is_file() for p in written), \
+            f"no profile artifacts under {tmp_path}"
+
+    def test_thread_stacks_dump(self):
+        from kubernetes_tpu.utils.profiling import thread_stacks
+        text = thread_stacks()
+        assert "MainThread" in text and "thread_stacks" in text
