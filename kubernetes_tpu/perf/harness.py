@@ -81,40 +81,6 @@ def density(num_nodes: int, num_pods: int, profile: str = "uniform",
         algorithm_ms_per_pod=elapsed / max(scheduled, 1) * 1e3)
 
 
-def _pod_payload(pod) -> dict:
-    """Full v1 serialization of a synth pod — volumes and host ports
-    included, so rich-profile wire runs exercise the same predicate
-    surface as the in-process run."""
-    containers = []
-    for cc in pod.containers:
-        c: dict = {"name": cc.name,
-                   "resources": {"requests": dict(cc.requests)}}
-        if cc.ports:
-            c["ports"] = [{"containerPort": p.container_port,
-                           "hostPort": p.host_port,
-                           "protocol": p.protocol} for p in cc.ports]
-        containers.append(c)
-    spec: dict = {"nodeSelector": dict(pod.node_selector),
-                  "containers": containers}
-    vols = []
-    for v in pod.volumes:
-        if v.aws_ebs_id:
-            vols.append({"name": v.name, "awsElasticBlockStore": {
-                "volumeID": v.aws_ebs_id, "readOnly": v.aws_read_only}})
-        elif v.gce_pd_name:
-            vols.append({"name": v.name, "gcePersistentDisk": {
-                "pdName": v.gce_pd_name, "readOnly": v.gce_read_only}})
-        elif v.pvc_claim_name:
-            vols.append({"name": v.name, "persistentVolumeClaim": {
-                "claimName": v.pvc_claim_name}})
-    if vols:
-        spec["volumes"] = vols
-    return {"metadata": {"name": pod.name, "namespace": pod.namespace,
-                         "labels": dict(pod.labels),
-                         "annotations": dict(pod.annotations)},
-            "spec": spec}
-
-
 @dataclass
 class WireDensityResult:
     num_nodes: int
@@ -182,18 +148,10 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
                     raise RuntimeError("apiserver never came up") from None
                 time.sleep(0.1)
 
+        from kubernetes_tpu.api.types import node_to_json, pod_to_json
         nodes = synth.make_nodes(num_nodes, profile=profile, n_zones=4)
         for nd in nodes:
-            post(c0, "/api/v1/nodes", {
-                "metadata": {"name": nd.name, "labels": dict(nd.labels),
-                             "annotations": dict(nd.annotations)},
-                "status": {
-                    "allocatable": {
-                        "cpu": f"{nd.allocatable_milli_cpu}m",
-                        "memory": str(nd.allocatable_memory),
-                        "pods": str(nd.allocatable_pods)},
-                    "conditions": [{"type": cc.type, "status": cc.status}
-                                   for cc in nd.conditions]}})
+            post(c0, "/api/v1/nodes", node_to_json(nd))
 
         factory = ConfigFactory(f"http://127.0.0.1:{port}",
                                 qps=qps, burst=burst).run()
@@ -218,7 +176,7 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         warm_s = time.perf_counter() - t_warm
 
         pods = synth.make_pods(num_pods, profile=profile)
-        payloads = [json.dumps(_pod_payload(pod)) for pod in pods]
+        payloads = [json.dumps(pod_to_json(pod)) for pod in pods]
 
         start = time.perf_counter()
         shards = [payloads[i::creators] for i in range(creators)]

@@ -45,15 +45,18 @@ class InMemoryBinder:
 
     def bind_many(self, bindings: list[tuple[api.Pod, str]]
                   ) -> list[tuple[api.Pod, str]]:
-        """Per-pod CAS under one lock acquisition.  Returns the conflicts as
-        (pod, current_node) — same semantics as bind() raising per pod."""
+        """Per-pod CAS under one lock acquisition.  Returns the failures as
+        (pod, error) — the bind_many contract every binder shares (the
+        daemon surfaces the error text in the FailedScheduling event)."""
         conflicts = []
         with self._lock:
             bound = self._bound
             for pod, node_name in bindings:
                 current = bound.get(pod.key, "")
                 if current:
-                    conflicts.append((pod, current))
+                    conflicts.append((pod, BindConflict(
+                        f"pod {pod.key} is already assigned to node "
+                        f"{current}")))
                 else:
                     bound[pod.key] = node_name
         return conflicts

@@ -95,11 +95,14 @@ def test_agg_handoff_rejected_for_mismatched_assignments():
     placements = eng.schedule_batch(pods)
     handoff = eng.take_agg_handoff()
     assert handoff is not None
-    # Assume a DIFFERENT set (swap two pods' destinations).
+    # Assume a DIFFERENT set: swap the destinations of two pods that
+    # genuinely landed on different nodes (so the signature must differ).
     wrong = list(zip(pods, placements))
-    (p0, d0), (p1, d1) = wrong[0], wrong[1]
-    assert d0 != d1 or True
-    wrong[0], wrong[1] = (p0, d1), (p1, d0)
+    i, j = next((a, b) for a in range(len(wrong))
+                for b in range(a + 1, len(wrong))
+                if wrong[a][1] != wrong[b][1])
+    (p0, d0), (p1, d1) = wrong[i], wrong[j]
+    wrong[i], wrong[j] = (p0, d1), (p1, d0)
     eng.cache.assume_pods(wrong, agg_handoff=handoff)
     # The aggregates reflect the ACTUAL (swapped) assignments, proving the
     # handoff was rejected and the bulk path ran.

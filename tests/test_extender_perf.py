@@ -124,7 +124,7 @@ def test_filter_prioritize_p99_at_5k_nodes(extender_url):
     # template), so the sample mix covers the template-memo MISS path —
     # a full pod compile + solve — not just memoized verdicts.
     lat: list[float] = []
-    for k in range(100):
+    for k in range(200):
         args["Pod"]["metadata"]["name"] = f"probe-{k}"
         req = args["Pod"]["spec"]["containers"][0]["resources"]["requests"]
         req["cpu"] = f"{100 + k // 10}m" if k % 10 == 0 else "100m"

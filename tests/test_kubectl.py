@@ -1,0 +1,135 @@
+"""kubectl-analogue CLI over the apiserver HTTP surface (pkg/kubectl +
+cmd/kubectl shape: resource aliases, table printers, create -f, cordon)."""
+
+from __future__ import annotations
+
+import io
+import json
+
+import pytest
+
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.apiserver.server import serve
+from kubernetes_tpu.kubectl.__main__ import main
+
+
+@pytest.fixture()
+def rig():
+    store = MemStore()
+    srv = serve(store, port=0)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield store, base
+    srv.shutdown()
+
+
+def run(base, *argv):
+    out = io.StringIO()
+    rc = main(["--server", base, *argv], out=out)
+    return rc, out.getvalue()
+
+
+def _node(name, ready=True):
+    return {"metadata": {"name": name},
+            "status": {"allocatable": {"cpu": "4", "memory": "16Gi",
+                                       "pods": "110"},
+                       "conditions": [{"type": "Ready",
+                                       "status": "True" if ready
+                                       else "False"}]}}
+
+
+def _pod(name, node=""):
+    d = {"metadata": {"name": name, "namespace": "default"},
+         "spec": {"containers": [{"name": "c", "resources": {
+             "requests": {"cpu": "100m"}}}]}}
+    if node:
+        d["spec"]["nodeName"] = node
+    return d
+
+
+def test_get_nodes_and_pods_table(rig):
+    store, base = rig
+    store.create("nodes", _node("n1"))
+    store.create("nodes", _node("n2", ready=False))
+    store.create("pods", _pod("p1", node="n1"))
+    store.create("pods", _pod("p2"))
+    rc, out = run(base, "get", "no")
+    assert rc == 0
+    assert "NAME" in out and "n1" in out and "NotReady" in out
+    rc, out = run(base, "get", "po")
+    assert rc == 0
+    lines = {ln.split()[0]: ln for ln in out.splitlines()[1:]}
+    assert "n1" in lines["p1"]
+    assert "Pending" in lines["p2"]
+
+
+def test_get_single_and_json_output(rig):
+    store, base = rig
+    store.create("pods", _pod("solo"))
+    rc, out = run(base, "get", "pods", "solo", "-o", "json")
+    assert rc == 0
+    assert json.loads(out)["items"][0]["metadata"]["name"] == "solo"
+    rc, _ = run(base, "get", "pods", "missing")
+    assert rc == 1
+
+
+def test_create_from_yaml_and_delete(rig, tmp_path):
+    store, base = rig
+    f = tmp_path / "objs.yaml"
+    f.write_text("""
+kind: Node
+metadata:
+  name: yn-1
+status:
+  allocatable: {cpu: "4", memory: 16Gi, pods: "110"}
+  conditions: [{type: Ready, status: "True"}]
+---
+kind: Pod
+metadata: {name: yp-1, namespace: default}
+spec:
+  containers:
+  - name: c
+    resources: {requests: {cpu: 100m}}
+""")
+    rc, out = run(base, "create", "-f", str(f))
+    assert rc == 0
+    assert "node/yn-1 created" in out and "pod/yp-1 created" in out
+    assert store.get("nodes", "yn-1") is not None
+    rc, out = run(base, "delete", "pods", "yp-1")
+    assert rc == 0
+    assert store.get("pods", "default/yp-1") is None
+
+
+def test_create_invalid_is_rejected(rig, tmp_path):
+    _, base = rig
+    f = tmp_path / "bad.json"
+    f.write_text(json.dumps({"kind": "Pod",
+                             "metadata": {"name": "Bad Name!"},
+                             "spec": {"containers": [{"name": "c"}]}}))
+    rc, _ = run(base, "create", "-f", str(f))
+    assert rc == 1
+
+
+def test_cordon_uncordon_round_trip(rig):
+    store, base = rig
+    store.create("nodes", _node("cn-1"))
+    rc, out = run(base, "cordon", "cn-1")
+    assert rc == 0 and "cordoned" in out
+    assert store.get("nodes", "cn-1")["spec"]["unschedulable"] is True
+    rc, out = run(base, "get", "nodes")
+    assert "SchedulingDisabled" in out
+    rc, _ = run(base, "uncordon", "cn-1")
+    assert store.get("nodes", "cn-1")["spec"]["unschedulable"] is False
+
+
+def test_describe_pod_includes_events(rig):
+    store, base = rig
+    store.create("pods", _pod("dp-1"))
+    store.create("events", {
+        "metadata": {"name": "dp-1.1", "namespace": "default"},
+        "involvedObject": {"kind": "Pod", "namespace": "default",
+                           "name": "dp-1"},
+        "type": "Warning", "reason": "FailedScheduling",
+        "message": "no nodes"})
+    rc, out = run(base, "describe", "pod", "dp-1")
+    assert rc == 0
+    assert "FailedScheduling" in out and "no nodes" in out
