@@ -1,0 +1,49 @@
+"""kube-controller-manager analogue: the control loops that keep desired
+state true (cmd/kube-controller-manager), scheduler-relevant subset —
+the ReplicationController manager and the node lifecycle controller.
+
+    python -m kubernetes_tpu.controller --api-server http://...
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from kubernetes_tpu.controller.node import NodeLifecycleController
+from kubernetes_tpu.controller.replication import ReplicationManager
+from kubernetes_tpu.utils.logging import configure, get_logger
+
+log = get_logger("controller-manager")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="kube-controller-manager (kubernetes_tpu)", description=__doc__)
+    p.add_argument("--api-server", required=True)
+    p.add_argument("--node-monitor-grace-period", type=float, default=40.0)
+    p.add_argument("--pod-eviction-timeout", type=float, default=60.0)
+    p.add_argument("--v", type=int, default=None)
+    opts = p.parse_args(argv)
+    configure(v=opts.v)
+
+    rm = ReplicationManager(opts.api_server).run()
+    nc = NodeLifecycleController(
+        opts.api_server,
+        monitor_grace=opts.node_monitor_grace_period,
+        eviction_timeout=opts.pod_eviction_timeout).run()
+    log.info("controller-manager running (replication + node lifecycle)")
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    rm.stop()
+    nc.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""ReplicationController controller: keep spec.replicas pods alive.
+
+The reference's replication manager (pkg/controller/replication) watches
+RCs and pods, diffs desired vs actual, and creates/deletes pods stamped
+from the RC's template.  This is that loop over the apiserver surface:
+works on raw v1 JSON (the controller has no scheduling opinions), labels
+created pods from the template, and names them ``{rc}-{suffix}`` the way
+the reference's pod generator does.
+"""
+
+from __future__ import annotations
+
+import random
+import string
+import threading
+from typing import Union
+
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.client.http import APIClient
+from kubernetes_tpu.client.reflector import Reflector
+from kubernetes_tpu.utils.logging import get_logger
+
+log = get_logger("rc-controller")
+
+SYNC_PERIOD = 1.0
+
+
+def _alive(pod: dict) -> bool:
+    status = pod.get("status") or {}
+    return status.get("phase") not in ("Failed", "Succeeded") and \
+        not (pod.get("metadata") or {}).get("deletionTimestamp")
+
+
+def _matches(selector: dict, pod: dict) -> bool:
+    labels = (pod.get("metadata") or {}).get("labels") or {}
+    return bool(selector) and \
+        all(labels.get(k) == v for k, v in selector.items())
+
+
+class ReplicationManager:
+    """controller-manager's replication controller loop."""
+
+    def __init__(self, source: Union[MemStore, APIClient, str],
+                 sync_period: float = SYNC_PERIOD):
+        if isinstance(source, str):
+            source = APIClient(source)
+        self.store = source
+        self.sync_period = sync_period
+        self._rcs: dict[str, dict] = {}
+        self._pods: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._reflectors: list[Reflector] = []
+        self._rand = random.Random(0)
+
+    def run(self) -> "ReplicationManager":
+        for kind, handler in (("replicationcontrollers", self._on_rc),
+                              ("pods", self._on_pod)):
+            r = Reflector(self.store, kind, handler)
+            self._reflectors.append(r)
+            r.run()
+        for r in self._reflectors:
+            r.wait_for_sync()
+        t = threading.Thread(target=self._sync_loop, daemon=True,
+                             name="rc-sync")
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for r in self._reflectors:
+            r.stop()
+
+    def _on_rc(self, etype: str, obj: dict) -> None:
+        key = MemStore.object_key(obj)
+        with self._lock:
+            if etype == "DELETED":
+                self._rcs.pop(key, None)
+            else:
+                self._rcs[key] = obj
+
+    def _on_pod(self, etype: str, obj: dict) -> None:
+        key = MemStore.object_key(obj)
+        with self._lock:
+            if etype == "DELETED":
+                self._pods.pop(key, None)
+            else:
+                self._pods[key] = obj
+
+    def _sync_loop(self) -> None:
+        while not self._stop.wait(self.sync_period):
+            try:
+                self.sync_all()
+            except Exception:  # noqa: BLE001 — HandleCrash analogue
+                log.exception("rc sync crashed; continuing")
+
+    def sync_all(self) -> None:
+        with self._lock:
+            rcs = list(self._rcs.values())
+            pods = list(self._pods.values())
+        for rc in rcs:
+            self._sync_one(rc, pods)
+
+    def _sync_one(self, rc: dict, pods: list[dict]) -> None:
+        meta = rc.get("metadata") or {}
+        spec = rc.get("spec") or {}
+        ns = meta.get("namespace", "default")
+        selector = spec.get("selector") or {}
+        if not selector:
+            # The reference defaults an absent selector from the template's
+            # labels; with neither, the RC can never adopt its own pods and
+            # syncing it would create replicas forever.
+            selector = dict(((spec.get("template") or {}).get("metadata")
+                             or {}).get("labels") or {})
+            if not selector:
+                log.warning("rc %s/%s has no selector and no template "
+                            "labels; skipping", ns, meta.get("name"))
+                return
+        want = int(spec.get("replicas", 1))
+        mine = [p for p in pods
+                if (p.get("metadata") or {}).get("namespace", "default")
+                == ns and _matches(selector, p) and _alive(p)]
+        have = len(mine)
+        if have < want:
+            for _ in range(want - have):
+                self._create_replica(rc, ns, selector)
+        elif have > want:
+            # Prefer deleting unassigned pods first (the reference ranks
+            # not-running pods for deletion first).
+            mine.sort(key=lambda p: bool(
+                (p.get("spec") or {}).get("nodeName")))
+            for p in mine[: have - want]:
+                pmeta = p.get("metadata") or {}
+                try:
+                    self.store.delete(
+                        "pods", f"{ns}/{pmeta.get('name', '')}")
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
+
+    def _create_replica(self, rc: dict, ns: str, selector: dict) -> None:
+        meta = rc.get("metadata") or {}
+        template = (rc.get("spec") or {}).get("template") or {}
+        suffix = "".join(self._rand.choices(string.ascii_lowercase +
+                                            string.digits, k=5))
+        tmeta = dict(template.get("metadata") or {})
+        labels = dict(tmeta.get("labels") or {})
+        labels.update(selector)  # template pods must match the selector
+        pod = {
+            "metadata": {
+                "name": f"{meta.get('name', 'rc')}-{suffix}",
+                "namespace": ns,
+                "labels": labels,
+                "annotations": dict(tmeta.get("annotations") or {}),
+            },
+            "spec": dict(template.get("spec") or
+                         {"containers": [{"name": "c"}]}),
+        }
+        try:
+            self.store.create("pods", pod)
+            log.info("rc %s/%s created pod %s", ns, meta.get("name"),
+                     pod["metadata"]["name"])
+        except Exception:  # noqa: BLE001 — retried next sync
+            log.debug("replica create failed; will retry", exc_info=True)

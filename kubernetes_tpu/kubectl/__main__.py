@@ -55,7 +55,7 @@ def _kind(arg: str) -> str:
     return kind
 
 
-def _pod_row(o: dict) -> list[str]:
+def _pod_row(o: dict, wide: bool = False) -> list[str]:
     meta = o.get("metadata") or {}
     spec = o.get("spec") or {}
     status = o.get("status") or {}
@@ -65,7 +65,18 @@ def _pod_row(o: dict) -> list[str]:
              for c in status.get("conditions") or ()}
     if conds.get("PodScheduled") == "False":
         phase = "Pending(Unschedulable)"
-    return [meta.get("name", ""), phase, spec.get("nodeName", "<none>")]
+    row = [meta.get("name", ""), phase, spec.get("nodeName") or "<none>"]
+    if wide:
+        reqs: dict = {}
+        for c in spec.get("containers") or ():
+            for k, v in ((c.get("resources") or {})
+                         .get("requests") or {}).items():
+                reqs[k] = v
+        row += [",".join(f"{k}={v}" for k, v in sorted(reqs.items()))
+                or "<none>",
+                ",".join(f"{k}={v}" for k, v in sorted(
+                    (meta.get("labels") or {}).items())) or "<none>"]
+    return row
 
 
 def _node_row(o: dict) -> list[str]:
@@ -92,11 +103,16 @@ _TABLES = {
 }
 
 
-def _print_table(kind: str, items: list[dict], out) -> None:
+def _print_table(kind: str, items: list[dict], out,
+                 wide: bool = False) -> None:
     headers, row_fn = _TABLES.get(
         kind, (["NAME"],
                lambda o: [(o.get("metadata") or {}).get("name", "")]))
-    rows = [row_fn(o) for o in items]
+    if wide and kind == "pods":
+        headers = headers + ["REQUESTS", "LABELS"]
+        rows = [row_fn(o, wide=True) for o in items]
+    else:
+        rows = [row_fn(o) for o in items]
     widths = [max([len(h)] + [len(r[i]) for r in rows])
               for i, h in enumerate(headers)]
     print("   ".join(h.ljust(w) for h, w in zip(headers, widths)), file=out)
@@ -123,7 +139,7 @@ def cmd_get(client: APIClient, opts, out) -> int:
     if opts.output == "json":
         print(json.dumps({"items": items}, indent=1), file=out)
     else:
-        _print_table(kind, items, out)
+        _print_table(kind, items, out, wide=opts.output == "wide")
     return 0
 
 
@@ -232,7 +248,8 @@ def main(argv=None, out=sys.stdout) -> int:
     g.add_argument("resource")
     g.add_argument("name", nargs="?", default="")
     g.add_argument("-n", "--namespace", default="default")
-    g.add_argument("-o", "--output", default="", choices=["", "json"])
+    g.add_argument("-o", "--output", default="",
+                   choices=["", "json", "wide"])
 
     d = sub.add_parser("describe")
     d.add_argument("resource")

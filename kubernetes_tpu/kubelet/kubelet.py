@@ -1,0 +1,166 @@
+"""Hollow kubelet: the node agent, kubemark-style.
+
+The reference kubelet (pkg/kubelet, 43k LoC) is a container runtime
+manager; its *control-plane surface* — what the rest of the system
+observes — is much smaller, and kubemark ships exactly that: the real
+kubelet with fake runtime deps (pkg/kubemark/hollow_kubelet.go:43-90).
+This module is that surface for the TPU control plane:
+
+* self-registration: creates its Node object on startup (kubelet
+  --register-node);
+* status heartbeats: periodically PUTs status.conditions[Ready] with
+  lastHeartbeatTime (kubelet's NodeStatus update loop) — when they stop,
+  the node controller marks the node gone;
+* pod lifecycle: watches pods bound to its node and "runs" them —
+  status.phase=Running — after re-running GeneralPredicates at admission
+  (pkg/kubelet/lifecycle/predicate.go runs the SAME functions the
+  scheduler uses, which is why GeneralPredicates is factored as one
+  unit); pods that no longer fit are rejected with phase=Failed and
+  reason=OutOfResources, exactly the kubelet's admission behavior.
+
+The admission check reuses the pure-Python oracle predicates — the
+kubelet is a host-side daemon with one node; there is nothing to batch
+on a TPU.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Union
+
+from kubernetes_tpu import oracle
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.client.http import APIClient
+from kubernetes_tpu.client.reflector import Reflector
+from kubernetes_tpu.utils.logging import get_logger
+
+log = get_logger("kubelet")
+
+HEARTBEAT_PERIOD = 10.0  # kubelet nodeStatusUpdateFrequency
+
+
+class HollowKubelet:
+    def __init__(self, source: Union[MemStore, APIClient, str],
+                 node: api.Node,
+                 heartbeat_period: float = HEARTBEAT_PERIOD):
+        if isinstance(source, str):
+            source = APIClient(source)
+        self.store = source
+        self.node = node
+        self.heartbeat_period = heartbeat_period
+        self._running: dict[str, api.Pod] = {}  # pods admitted + "running"
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._reflector: Reflector | None = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def run(self) -> "HollowKubelet":
+        self._register()
+        selector = self._my_pod
+        self._reflector = Reflector(self.store, "pods", self._on_pod,
+                                    selector)
+        self._threads.append(self._reflector.run())
+        t = threading.Thread(target=self._heartbeat_loop, daemon=True,
+                             name=f"kubelet-heartbeat-{self.node.name}")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        """Stop heartbeating and pod handling (simulates node death: the
+        Node object remains; only the heartbeats cease)."""
+        self._stop.set()
+        if self._reflector is not None:
+            self._reflector.stop()
+
+    def _my_pod(self, obj: dict) -> bool:
+        return (obj.get("spec") or {}).get("nodeName") == self.node.name
+
+    # -- registration + heartbeat ---------------------------------------
+
+    def _register(self) -> None:
+        """--register-node: create the Node object if absent."""
+        obj = api.node_to_json(self.node)
+        self._stamp_ready(obj)
+        try:
+            self.store.create("nodes", obj)
+            log.info("registered node %s", self.node.name)
+        except Exception:  # noqa: BLE001 — already exists: refresh status
+            existing = self.store.get("nodes", self.node.name)
+            if existing is not None:
+                existing["status"] = obj["status"]
+                try:
+                    self.store.update("nodes", existing)
+                except Exception:  # noqa: BLE001 — heartbeat will retry
+                    pass
+
+    @staticmethod
+    def _stamp_ready(obj: dict) -> None:
+        conds = obj.setdefault("status", {}).setdefault("conditions", [])
+        conds[:] = [c for c in conds if c.get("type") != "Ready"]
+        conds.append({"type": "Ready", "status": "True",
+                      "lastHeartbeatTime": time.time()})
+
+    def _heartbeat_loop(self) -> None:
+        from kubernetes_tpu.client import cas_update
+        while not self._stop.wait(self.heartbeat_period):
+            try:
+                obj = self.store.get("nodes", self.node.name)
+                if obj is None:
+                    self._register()
+                    continue
+                self._stamp_ready(obj)
+                cas_update(self.store, "nodes", obj)
+            except Exception:  # noqa: BLE001 — apiserver down / CAS race:
+                pass           # next heartbeat retries
+
+    # -- pod admission + "running" --------------------------------------
+
+    def _on_pod(self, etype: str, obj: dict) -> None:
+        key = MemStore.object_key(obj)
+        if etype == "DELETED":
+            with self._lock:
+                self._running.pop(key, None)
+            return
+        phase = (obj.get("status") or {}).get("phase", "")
+        if phase in ("Running", "Failed", "Succeeded"):
+            if phase == "Running":
+                with self._lock:
+                    self._running.setdefault(key, api.pod_from_json(obj))
+            return
+        pod = api.pod_from_json(obj)
+        with self._lock:
+            admitted = self._admit(pod, key)
+            if admitted:
+                self._running[key] = pod
+        self._set_phase(obj, "Running" if admitted else "Failed",
+                        "" if admitted else "OutOfResources")
+
+    def _admit(self, pod: api.Pod, key: str) -> bool:
+        """GeneralPredicates at admission (lifecycle/predicate.go) against
+        this node and its running pods, via the oracle's re-derivations.
+        The pod's own key is excluded so a redelivered admission (lost
+        status CAS) doesn't count the pod against itself."""
+        node_pods = [p for k, p in self._running.items() if k != key]
+        return (oracle.pod_fits_resources(pod, self.node, node_pods)
+                and oracle.pod_fits_host(pod, self.node)
+                and oracle.pod_fits_host_ports(pod, node_pods)
+                and oracle.pod_matches_node_labels(pod, self.node))
+
+    def _set_phase(self, obj: dict, phase: str, reason: str) -> None:
+        status = obj.setdefault("status", {})
+        status["phase"] = phase
+        if reason:
+            status["reason"] = reason
+        try:
+            self.store.update("pods", obj)
+        except Exception:  # noqa: BLE001 — a newer write wins; watch
+            pass           # redelivers and the handler re-runs
+
+    def running_pods(self) -> list[str]:
+        with self._lock:
+            return sorted(self._running)

@@ -1,0 +1,168 @@
+"""Full control-plane loop: RC controller -> scheduler -> hollow kubelet
+-> node death -> node controller eviction -> reschedule.
+
+This is the reference's end-to-end story (test/integration +
+nodecontroller.go:70-160 + pkg/kubemark) over the in-memory apiserver:
+every component joins through list/watch only — nobody calls anybody
+directly.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.controller.node import NodeLifecycleController
+from kubernetes_tpu.controller.replication import ReplicationManager
+from kubernetes_tpu.kubelet.kubelet import HollowKubelet
+from kubernetes_tpu.scheduler.factory import ConfigFactory
+
+
+def _node(name: str, milli_cpu: int = 8000) -> api.Node:
+    return api.Node(
+        name=name, labels={api.HOSTNAME_LABEL: name},
+        allocatable_milli_cpu=milli_cpu,
+        allocatable_memory=32 * 1024 ** 3, allocatable_pods=110,
+        conditions=[api.NodeCondition("Ready", "True")])
+
+
+def _wait(cond, timeout=30.0, period=0.2, msg=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(period)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def plane():
+    """apiserver store + scheduler + controllers + two kubelets, fast
+    clocks (heartbeat 0.3 s, grace 1.2 s, eviction 1 s)."""
+    store = MemStore()
+    kubelets = [HollowKubelet(store, _node(f"hk-{i}"),
+                              heartbeat_period=0.3).run()
+                for i in range(2)]
+    scheduler = ConfigFactory(store).run()
+    rm = ReplicationManager(store, sync_period=0.3).run()
+    nc = NodeLifecycleController(store, monitor_grace=1.2,
+                                 eviction_timeout=1.0,
+                                 sync_period=0.3).run()
+    yield store, kubelets, scheduler
+    nc.stop()
+    rm.stop()
+    scheduler.stop()
+    for k in kubelets:
+        k.stop()
+
+
+def _rc(name: str, replicas: int, cpu: str = "100m") -> dict:
+    return {"metadata": {"name": name, "namespace": "default"},
+            "spec": {"replicas": replicas,
+                     "selector": {"run": name},
+                     "template": {
+                         "metadata": {"labels": {"run": name}},
+                         "spec": {"containers": [{
+                             "name": "c",
+                             "resources": {"requests": {"cpu": cpu}}}]}}}}
+
+
+def _pods_of(store, rc_name):
+    items, _ = store.list("pods")
+    return [o for o in items
+            if ((o.get("metadata") or {}).get("labels") or {})
+            .get("run") == rc_name]
+
+
+def test_rc_to_running_pods(plane):
+    """RC controller creates replicas; the scheduler binds them; kubelets
+    admit and run them — all through watches."""
+    store, kubelets, _ = plane
+    store.create("replicationcontrollers", _rc("web", 4))
+
+    def all_running():
+        pods = _pods_of(store, "web")
+        return len(pods) == 4 and all(
+            (p.get("status") or {}).get("phase") == "Running"
+            and (p.get("spec") or {}).get("nodeName") for p in pods)
+    _wait(all_running, msg="4 web replicas Running")
+    # Both kubelets are actually running pods (spreading).
+    nodes_used = {(p.get("spec") or {}).get("nodeName")
+                  for p in _pods_of(store, "web")}
+    assert nodes_used == {"hk-0", "hk-1"}
+
+
+def test_scale_up_and_down(plane):
+    store, _, _ = plane
+    store.create("replicationcontrollers", _rc("app", 2))
+    _wait(lambda: len(_pods_of(store, "app")) == 2, msg="2 replicas")
+    rc = store.get("replicationcontrollers", "default/app")
+    rc["spec"]["replicas"] = 5
+    store.update("replicationcontrollers", rc)
+    _wait(lambda: len(_pods_of(store, "app")) == 5, msg="scale to 5")
+    rc = store.get("replicationcontrollers", "default/app")
+    rc["spec"]["replicas"] = 1
+    store.update("replicationcontrollers", rc)
+    _wait(lambda: len([p for p in _pods_of(store, "app")
+                       if not (p.get("metadata") or {})
+                       .get("deletionTimestamp")]) == 1,
+          msg="scale down to 1")
+
+
+def test_node_death_evicts_and_reschedules(plane):
+    """Kill one kubelet: the node controller marks the node unknown and
+    evicts its pods; the RC recreates them; the scheduler places them on
+    the surviving node; its kubelet runs them (TestUnschedulableNodes +
+    nodecontroller eviction at integration scale)."""
+    store, kubelets, _ = plane
+    store.create("replicationcontrollers", _rc("ha", 4))
+
+    def all_running():
+        pods = _pods_of(store, "ha")
+        return len(pods) == 4 and all(
+            (p.get("status") or {}).get("phase") == "Running"
+            and (p.get("spec") or {}).get("nodeName") for p in pods)
+    _wait(all_running, msg="initial 4 running")
+
+    kubelets[0].stop()  # node hk-0 dies (heartbeats cease)
+
+    def node_unknown():
+        n = store.get("nodes", "hk-0")
+        conds = {c.get("type"): c.get("status")
+                 for c in (n.get("status") or {}).get("conditions") or ()}
+        return conds.get("Ready") == "Unknown"
+    _wait(node_unknown, timeout=15, msg="hk-0 Ready=Unknown")
+
+    def all_on_survivor():
+        pods = _pods_of(store, "ha")
+        live = [p for p in pods
+                if not (p.get("metadata") or {}).get("deletionTimestamp")]
+        return len(live) == 4 and all(
+            (p.get("spec") or {}).get("nodeName") == "hk-1"
+            and (p.get("status") or {}).get("phase") == "Running"
+            for p in live)
+    _wait(all_on_survivor, timeout=30,
+          msg="4 replicas rescheduled onto hk-1 and Running")
+
+
+def test_kubelet_admission_rejects_overcommit(plane):
+    """The kubelet re-runs GeneralPredicates at admission
+    (lifecycle/predicate.go): a pod force-bound over capacity is rejected
+    with phase=Failed, and the RC replaces it."""
+    store, kubelets, _ = plane
+    # Force-bind a pod that exceeds hk-0's 8-CPU allocatable.
+    store.create("pods", {
+        "metadata": {"name": "fat", "namespace": "default"},
+        "spec": {"nodeName": "hk-0",
+                 "containers": [{"name": "c",
+                                 "resources": {"requests": {"cpu": "64"}}}]}})
+
+    def failed():
+        o = store.get("pods", "default/fat")
+        return (o.get("status") or {}).get("phase") == "Failed" and \
+            (o.get("status") or {}).get("reason") == "OutOfResources"
+    _wait(failed, msg="kubelet admission rejection")

@@ -133,3 +133,11 @@ def test_describe_pod_includes_events(rig):
     rc, out = run(base, "describe", "pod", "dp-1")
     assert rc == 0
     assert "FailedScheduling" in out and "no nodes" in out
+
+
+def test_get_pods_wide(rig):
+    store, base = rig
+    store.create("pods", _pod("wp-1", node="n1"))
+    rc, out = run(base, "get", "pods", "-o", "wide")
+    assert rc == 0
+    assert "REQUESTS" in out and "cpu=100m" in out
