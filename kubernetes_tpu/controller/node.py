@@ -135,8 +135,16 @@ class NodeLifecycleController:
             "nodes", (node.get("metadata") or {}).get("name", ""))
         if fresh is None:
             return
-        conds = fresh.setdefault("status", {}).setdefault("conditions", [])
         hb = self._last_heartbeat(fresh)
+        if hb and time.time() - hb <= self.monitor_grace:
+            # The FRESH object heartbeated within grace: our reflector
+            # cache was stale (watch hiccup), not the kubelet.  A healthy
+            # node must never be marked Unknown off stale cache.
+            name = (fresh.get("metadata") or {}).get("name", "")
+            with self._lock:
+                self._silent_since.pop(name, None)
+            return
+        conds = fresh.setdefault("status", {}).setdefault("conditions", [])
         conds[:] = [c for c in conds if c.get("type") != "Ready"]
         conds.append({"type": "Ready", "status": "Unknown",
                       "reason": "NodeStatusUnknown",

@@ -1,11 +1,15 @@
-"""ReplicationController controller: keep spec.replicas pods alive.
+"""Replication controllers: keep spec.replicas pods alive.
 
-The reference's replication manager (pkg/controller/replication) watches
-RCs and pods, diffs desired vs actual, and creates/deletes pods stamped
-from the RC's template.  This is that loop over the apiserver surface:
-works on raw v1 JSON (the controller has no scheduling opinions), labels
-created pods from the template, and names them ``{rc}-{suffix}`` the way
-the reference's pod generator does.
+The reference's replication manager (pkg/controller/replication) and
+replica-set controller (pkg/controller/replicaset — the same loop over
+set-based selectors) watch their resources plus pods, diff desired vs
+actual, and create/delete pods stamped from the template.  This is that
+loop over the apiserver surface: works on raw v1 JSON (the controller has
+no scheduling opinions), labels created pods from the template, and names
+them ``{rc}-{suffix}`` the way the reference's pod generator does.
+
+ReplicaSets use a LabelSelector (matchLabels + matchExpressions);
+ReplicationControllers a plain label map — both handled by _matches.
 """
 
 from __future__ import annotations
@@ -15,6 +19,7 @@ import string
 import threading
 from typing import Union
 
+from kubernetes_tpu.api import types as api
 from kubernetes_tpu.apiserver.memstore import MemStore
 from kubernetes_tpu.client.http import APIClient
 from kubernetes_tpu.client.reflector import Reflector
@@ -31,10 +36,30 @@ def _alive(pod: dict) -> bool:
         not (pod.get("metadata") or {}).get("deletionTimestamp")
 
 
+def _is_label_selector(selector: dict) -> bool:
+    return "matchLabels" in selector or "matchExpressions" in selector
+
+
 def _matches(selector: dict, pod: dict) -> bool:
+    """RC map selector or RS LabelSelector against a pod's labels.  The
+    set-based semantics are api.types.LabelSelector.matches — one
+    implementation, not a copy."""
     labels = (pod.get("metadata") or {}).get("labels") or {}
+    if _is_label_selector(selector):
+        parsed = api._parse_label_selector(selector)
+        if parsed is None or (not parsed.match_labels
+                              and not parsed.match_expressions):
+            return False
+        return parsed.matches(labels)
     return bool(selector) and \
         all(labels.get(k) == v for k, v in selector.items())
+
+
+def _selector_labels(selector: dict) -> dict:
+    """Labels a freshly stamped replica needs to match its selector."""
+    if _is_label_selector(selector):
+        return dict(selector.get("matchLabels") or {})
+    return dict(selector)
 
 
 class ReplicationManager:
@@ -54,11 +79,15 @@ class ReplicationManager:
         self._rand = random.Random(0)
 
     def run(self) -> "ReplicationManager":
-        for kind, handler in (("replicationcontrollers", self._on_rc),
-                              ("pods", self._on_pod)):
-            r = Reflector(self.store, kind, handler)
+        import functools
+        for kind in ("replicationcontrollers", "replicasets"):
+            r = Reflector(self.store, kind,
+                          functools.partial(self._on_rc, kind))
             self._reflectors.append(r)
             r.run()
+        r = Reflector(self.store, "pods", self._on_pod)
+        self._reflectors.append(r)
+        r.run()
         for r in self._reflectors:
             r.wait_for_sync()
         t = threading.Thread(target=self._sync_loop, daemon=True,
@@ -71,8 +100,9 @@ class ReplicationManager:
         for r in self._reflectors:
             r.stop()
 
-    def _on_rc(self, etype: str, obj: dict) -> None:
-        key = MemStore.object_key(obj)
+    def _on_rc(self, kind: str, etype: str, obj: dict) -> None:
+        # Keyed by kind too: an RC and an RS may share a ns/name.
+        key = f"{kind}:{MemStore.object_key(obj)}"
         with self._lock:
             if etype == "DELETED":
                 self._rcs.pop(key, None)
@@ -106,7 +136,11 @@ class ReplicationManager:
         spec = rc.get("spec") or {}
         ns = meta.get("namespace", "default")
         selector = spec.get("selector") or {}
-        if not selector:
+        empty = not selector or (
+            _is_label_selector(selector)
+            and not (selector.get("matchLabels")
+                     or selector.get("matchExpressions")))
+        if empty:
             # The reference defaults an absent selector from the template's
             # labels; with neither, the RC can never adopt its own pods and
             # syncing it would create replicas forever.
@@ -144,7 +178,7 @@ class ReplicationManager:
                                             string.digits, k=5))
         tmeta = dict(template.get("metadata") or {})
         labels = dict(tmeta.get("labels") or {})
-        labels.update(selector)  # template pods must match the selector
+        labels.update(_selector_labels(selector))  # replicas must match
         pod = {
             "metadata": {
                 "name": f"{meta.get('name', 'rc')}-{suffix}",
@@ -155,6 +189,16 @@ class ReplicationManager:
             "spec": dict(template.get("spec") or
                          {"containers": [{"name": "c"}]}),
         }
+        if not _matches(selector, pod):
+            # A replica that can't match its own selector (e.g. a
+            # matchExpressions requirement the template labels don't
+            # satisfy) would never be adopted — creating it would mint
+            # `replicas` orphans per sync forever.  The reference rejects
+            # such RCs at validation; this controller refuses to act.
+            log.warning("rc %s/%s: stamped replica would not match its "
+                        "selector; refusing to create", ns,
+                        meta.get("name"))
+            return
         try:
             self.store.create("pods", pod)
             log.info("rc %s/%s created pod %s", ns, meta.get("name"),

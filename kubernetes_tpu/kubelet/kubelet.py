@@ -90,11 +90,12 @@ class HollowKubelet:
             self.store.create("nodes", obj)
             log.info("registered node %s", self.node.name)
         except Exception:  # noqa: BLE001 — already exists: refresh status
+            from kubernetes_tpu.client import cas_update
             existing = self.store.get("nodes", self.node.name)
             if existing is not None:
                 existing["status"] = obj["status"]
                 try:
-                    self.store.update("nodes", existing)
+                    cas_update(self.store, "nodes", existing)
                 except Exception:  # noqa: BLE001 — heartbeat will retry
                     pass
 
@@ -157,7 +158,11 @@ class HollowKubelet:
         if reason:
             status["reason"] = reason
         try:
-            self.store.update("pods", obj)
+            # CAS on the watched rv: a concurrent writer (labels,
+            # conditions) must win over this watch-stale copy; the watch
+            # then redelivers and the handler re-runs.
+            from kubernetes_tpu.client import cas_update
+            cas_update(self.store, "pods", obj)
         except Exception:  # noqa: BLE001 — a newer write wins; watch
             pass           # redelivers and the handler re-runs
 

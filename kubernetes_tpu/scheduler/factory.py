@@ -162,7 +162,11 @@ class ConfigFactory:
             recorder = EventRecorder(sink=None)
         self.daemon = Scheduler(SchedulerConfig(
             algorithm=self.algorithm, binder=binder,
-            scheduler_name=scheduler_name, async_bind=False,
+            # Async binds, like the reference's per-bind goroutine
+            # (scheduler.go:122-153): over a real wire a chunk's ~4k bind
+            # POSTs take seconds, and the device must be scanning the next
+            # chunk meanwhile, not idling behind them.
+            scheduler_name=scheduler_name, async_bind=True,
             recorder=recorder,
             condition_updater=self._update_pod_condition))
         self.batched = batched

@@ -179,6 +179,11 @@ class Scheduler:
             t = threading.Thread(target=self._bind_assumed_batch,
                                  args=(placed, start), daemon=True)
             t.start()
+            # Prune finished binders on append: a long-running daemon
+            # drains every ~50 ms and must not accumulate dead Thread
+            # objects without bound.
+            self._bind_threads = [x for x in self._bind_threads
+                                  if x.is_alive()]
             self._bind_threads.append(t)
         else:
             self._bind_assumed_batch(placed, start)
@@ -264,6 +269,8 @@ class Scheduler:
         if self.config.async_bind:
             t = threading.Thread(target=bind, daemon=True)
             t.start()
+            self._bind_threads = [x for x in self._bind_threads
+                                  if x.is_alive()]
             self._bind_threads.append(t)
         else:
             bind()

@@ -166,3 +166,63 @@ def test_kubelet_admission_rejects_overcommit(plane):
         return (o.get("status") or {}).get("phase") == "Failed" and \
             (o.get("status") or {}).get("reason") == "OutOfResources"
     _wait(failed, msg="kubelet admission rejection")
+
+
+def test_replicaset_with_label_selector(plane):
+    """The same manager syncs ReplicaSets (pkg/controller/replicaset):
+    set-based LabelSelector, matchExpressions included."""
+    store, _, _ = plane
+    store.create("replicasets", {
+        "metadata": {"name": "rs-web", "namespace": "default"},
+        "spec": {"replicas": 3,
+                 "selector": {"matchLabels": {"tier": "fe"},
+                              "matchExpressions": [
+                                  {"key": "env", "operator": "In",
+                                   "values": ["prod"]}]},
+                 "template": {
+                     "metadata": {"labels": {"tier": "fe", "env": "prod"}},
+                     "spec": {"containers": [{
+                         "name": "c",
+                         "resources": {"requests": {"cpu": "50m"}}}]}}}})
+
+    def all_running():
+        items, _ = store.list("pods")
+        mine = [o for o in items
+                if ((o.get("metadata") or {}).get("labels") or {})
+                .get("tier") == "fe"]
+        return len(mine) == 3 and all(
+            (p.get("status") or {}).get("phase") == "Running" for p in mine)
+    _wait(all_running, msg="3 RS replicas Running")
+
+
+def test_hollow_fleet_scale():
+    """Kubemark shape (docs/proposals/kubemark.md): a fleet of hollow
+    kubelets against the real control plane — 40 nodes self-register,
+    an RC asks for 400 replicas, every replica ends up Running with the
+    fleet sharing the load."""
+    store = MemStore()
+    fleet = [HollowKubelet(store, _node(f"hollow-{i:03d}", milli_cpu=16000),
+                           heartbeat_period=2.0).run()
+             for i in range(40)]
+    scheduler = ConfigFactory(store).run()
+    rm = ReplicationManager(store, sync_period=0.5).run()
+    try:
+        store.create("replicationcontrollers", _rc("load", 400, cpu="50m"))
+
+        def all_running():
+            pods = _pods_of(store, "load")
+            return len(pods) == 400 and all(
+                (p.get("status") or {}).get("phase") == "Running"
+                for p in pods)
+        _wait(all_running, timeout=90, msg="400 replicas Running on fleet")
+        per_node: dict[str, int] = {}
+        for p in _pods_of(store, "load"):
+            nn = p["spec"]["nodeName"]
+            per_node[nn] = per_node.get(nn, 0) + 1
+        assert len(per_node) == 40, f"only {len(per_node)} nodes used"
+        assert max(per_node.values()) <= 20, per_node
+    finally:
+        rm.stop()
+        scheduler.stop()
+        for k in fleet:
+            k.stop()
