@@ -1,6 +1,7 @@
 """kube-controller-manager analogue: the control loops that keep desired
-state true (cmd/kube-controller-manager), scheduler-relevant subset —
-the ReplicationController manager and the node lifecycle controller.
+state true (cmd/kube-controller-manager) — the replication manager
+(RCs + ReplicaSets), the node lifecycle controller, and the endpoints
+controller.
 
     python -m kubernetes_tpu.controller --api-server http://...
 """
@@ -12,6 +13,7 @@ import signal
 import sys
 import threading
 
+from kubernetes_tpu.controller.endpoints import EndpointsController
 from kubernetes_tpu.controller.node import NodeLifecycleController
 from kubernetes_tpu.controller.replication import ReplicationManager
 from kubernetes_tpu.utils.logging import configure, get_logger
@@ -34,7 +36,9 @@ def main(argv=None) -> int:
         opts.api_server,
         monitor_grace=opts.node_monitor_grace_period,
         eviction_timeout=opts.pod_eviction_timeout).run()
-    log.info("controller-manager running (replication + node lifecycle)")
+    ec = EndpointsController(opts.api_server).run()
+    log.info("controller-manager running (replication + node lifecycle "
+             "+ endpoints)")
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -42,6 +46,7 @@ def main(argv=None) -> int:
     stop.wait()
     rm.stop()
     nc.stop()
+    ec.stop()
     return 0
 
 

@@ -1,0 +1,161 @@
+"""Endpoints controller: keep each Service's Endpoints object equal to
+the IPs of its running, selector-matching pods.
+
+The reference's endpoint controller (pkg/controller/endpoint) joins the
+service and pod watches and writes Endpoints objects the proxies consume
+(pkg/proxy watches Services + Endpoints).  Subset shape matches v1:
+``{"subsets": [{"addresses": [{"ip", "targetRef"}]}]}``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Union
+
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.client.http import APIClient
+from kubernetes_tpu.client.reflector import Reflector
+from kubernetes_tpu.utils.logging import get_logger
+
+log = get_logger("endpoints-controller")
+
+SYNC_PERIOD = 1.0
+
+
+class EndpointsController:
+    def __init__(self, source: Union[MemStore, APIClient, str],
+                 sync_period: float = SYNC_PERIOD):
+        if isinstance(source, str):
+            source = APIClient(source)
+        self.store = source
+        self.sync_period = sync_period
+        self._services: dict[str, dict] = {}
+        self._pods: dict[str, dict] = {}
+        self._endpoints: dict[str, dict] = {}
+        self._deleted_services: set[str] = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._reflectors: list[Reflector] = []
+
+    def run(self) -> "EndpointsController":
+        for kind, handler in (("services", self._on_service),
+                              ("pods", self._on_pod),
+                              ("endpoints", self._on_endpoints)):
+            r = Reflector(self.store, kind, handler)
+            self._reflectors.append(r)
+            r.run()
+        for r in self._reflectors:
+            r.wait_for_sync()
+        t = threading.Thread(target=self._sync_loop, daemon=True,
+                             name="endpoints-sync")
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for r in self._reflectors:
+            r.stop()
+
+    def _on_service(self, etype: str, obj: dict) -> None:
+        key = MemStore.object_key(obj)
+        with self._lock:
+            if etype == "DELETED":
+                svc = self._services.pop(key, None)
+                # Only garbage-collect endpoints this controller manages
+                # (selector-bearing services); manual endpoints of
+                # selectorless services are left alone.
+                if svc is not None and \
+                        (svc.get("spec") or {}).get("selector"):
+                    self._deleted_services.add(key)
+            else:
+                self._services[key] = obj
+
+    def _on_pod(self, etype: str, obj: dict) -> None:
+        key = MemStore.object_key(obj)
+        with self._lock:
+            if etype == "DELETED":
+                self._pods.pop(key, None)
+            else:
+                self._pods[key] = obj
+
+    def _on_endpoints(self, etype: str, obj: dict) -> None:
+        key = MemStore.object_key(obj)
+        with self._lock:
+            if etype == "DELETED":
+                self._endpoints.pop(key, None)
+            else:
+                self._endpoints[key] = obj
+
+    def _sync_loop(self) -> None:
+        while not self._stop.wait(self.sync_period):
+            try:
+                self.sync_all()
+            except Exception:  # noqa: BLE001 — HandleCrash analogue
+                log.exception("endpoints sync crashed; continuing")
+
+    def sync_all(self) -> None:
+        with self._lock:
+            services = list(self._services.values())
+            pods = list(self._pods.values())
+            gone = list(self._deleted_services)
+            self._deleted_services.clear()
+        # GC endpoints of deleted selector-bearing services.
+        for key in gone:
+            try:
+                self.store.delete("endpoints", key)
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+        for svc in services:
+            self._sync_one(svc, pods)
+
+    def _sync_one(self, svc: dict, pods: list[dict]) -> None:
+        meta = svc.get("metadata") or {}
+        ns = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        selector = (svc.get("spec") or {}).get("selector") or {}
+        if not selector:
+            # Selectorless services carry manually-managed endpoints
+            # (external-backend pattern): not ours to touch (the reference
+            # controller skips them the same way).
+            return
+        addresses = []
+        for pod in pods:
+            pmeta = pod.get("metadata") or {}
+            status = pod.get("status") or {}
+            if pmeta.get("namespace", "default") != ns:
+                continue
+            labels = pmeta.get("labels") or {}
+            if not all(labels.get(k) == v for k, v in selector.items()):
+                continue
+            if status.get("phase") != "Running" or \
+                    not status.get("podIP"):
+                continue
+            addresses.append({
+                "ip": status["podIP"],
+                "targetRef": {"kind": "Pod", "namespace": ns,
+                              "name": pmeta.get("name", "")}})
+        addresses.sort(key=lambda a: a["ip"])
+        subsets = [{"addresses": addresses}] if addresses else []
+        key = f"{ns}/{name}"
+        # Compare against the WATCHED endpoints cache: the no-change path
+        # costs nothing on the wire (one GET per service per sync would
+        # saturate a 5-QPS client at five services).
+        with self._lock:
+            current = self._endpoints.get(key)
+        if current is not None and current.get("subsets", []) == subsets:
+            return  # no-op sync: don't churn resourceVersions
+        if current is None:
+            try:
+                self.store.create("endpoints", {
+                    "metadata": {"name": name, "namespace": ns},
+                    "subsets": subsets})
+            except Exception:  # noqa: BLE001 — raced another writer
+                pass
+        else:
+            updated = dict(current)
+            updated["subsets"] = subsets
+            try:
+                from kubernetes_tpu.client import cas_update
+                cas_update(self.store, "endpoints", updated)
+            except Exception:  # noqa: BLE001 — next sync retries
+                pass

@@ -157,6 +157,19 @@ class HollowKubelet:
         status["phase"] = phase
         if reason:
             status["reason"] = reason
+        if phase == "Running" and not status.get("podIP"):
+            # The hollow runtime's IPAM: a deterministic pod IP (kubemark's
+            # fake runtime assigns one too) — the endpoints controller
+            # needs it to build service endpoints.  md5, not hash():
+            # str hashing is PYTHONHASHSEED-randomized per process.  The
+            # 10.0.0.0/8-sized space keeps birthday collisions negligible
+            # at hollow-fleet scales.
+            import hashlib
+            digest = hashlib.md5(
+                MemStore.object_key(obj).encode()).digest()
+            h = int.from_bytes(digest[:4], "big") % (254 * 254 * 254)
+            status["podIP"] = (f"10.{h // (254 * 254)}."
+                               f"{h // 254 % 254}.{h % 254 + 1}")
         try:
             # CAS on the watched rv: a concurrent writer (labels,
             # conditions) must win over this watch-stale copy; the watch

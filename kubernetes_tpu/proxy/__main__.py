@@ -1,0 +1,37 @@
+"""Hollow kube-proxy binary (cmd/kubemark --morph=proxy):
+
+    python -m kubernetes_tpu.proxy --api-server http://...
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from kubernetes_tpu.proxy.proxy import HollowProxy
+from kubernetes_tpu.utils.logging import configure, get_logger
+
+log = get_logger("kube-proxy")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kube-proxy (kubernetes_tpu, hollow)",
+                                description=__doc__)
+    p.add_argument("--api-server", required=True)
+    p.add_argument("--v", type=int, default=None)
+    opts = p.parse_args(argv)
+    configure(v=opts.v)
+    proxy = HollowProxy(opts.api_server).run()
+    log.info("hollow kube-proxy running")
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    proxy.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

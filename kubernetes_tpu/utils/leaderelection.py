@@ -89,20 +89,32 @@ class APIResourceLock:
     Endpoints object, CAS'd on resourceVersion."""
 
     def __init__(self, client, kind: str = "endpoints",
-                 name: str = "kube-scheduler"):
+                 name: str = "kube-scheduler",
+                 namespace: str = "kube-system"):
+        # Endpoints is a namespaced kind: the lock object lives at
+        # kube-system/kube-scheduler like the reference's EndpointsLock
+        # (server.go:147 uses the kube-system namespace).
         self.client = client
         self.kind = kind
         self.name = name
+        self.namespace = namespace
+
+    @property
+    def _key(self) -> str:
+        return f"{self.namespace}/{self.name}"
 
     def _ensure(self) -> dict:
-        obj = self.client.get(self.kind, self.name)
+        obj = self.client.get(self.kind, self._key)
         if obj is None:
             try:
-                self.client.create(self.kind, {"metadata": {"name": self.name}})
+                self.client.create(self.kind, {
+                    "metadata": {"name": self.name,
+                                 "namespace": self.namespace}})
             except Exception:  # noqa: BLE001 — lost the create race
                 pass
-            obj = self.client.get(self.kind, self.name) or \
-                {"metadata": {"name": self.name}}
+            obj = self.client.get(self.kind, self._key) or \
+                {"metadata": {"name": self.name,
+                              "namespace": self.namespace}}
         return obj
 
     def get(self) -> tuple[Optional[str], int]:
@@ -115,6 +127,7 @@ class APIResourceLock:
         try:
             self.client.update(self.kind, {
                 "metadata": {"name": self.name,
+                             "namespace": self.namespace,
                              "resourceVersion": str(expected_version),
                              "annotations": {LEADER_ANNOTATION_KEY: value}}})
             return True

@@ -226,3 +226,67 @@ def test_hollow_fleet_scale():
         scheduler.stop()
         for k in fleet:
             k.stop()
+
+
+def test_service_endpoints_and_proxy(plane):
+    """Service dataplane loop: RC replicas come up Running with pod IPs,
+    the endpoints controller publishes them, and the hollow kube-proxy
+    round-robins VIP resolution over live backends; a scale-down shrinks
+    the endpoints (pkg/controller/endpoint + pkg/proxy semantics)."""
+    from kubernetes_tpu.controller.endpoints import EndpointsController
+    from kubernetes_tpu.proxy.proxy import HollowProxy
+
+    store, _, _ = plane
+    ec = EndpointsController(store, sync_period=0.2).run()
+    proxy = HollowProxy(store).run()
+    try:
+        store.create("services", {
+            "metadata": {"name": "websvc", "namespace": "default"},
+            "spec": {"selector": {"run": "webrc"}}})
+        store.create("replicationcontrollers", _rc("webrc", 3))
+
+        def endpoints_full():
+            ep = store.get("endpoints", "default/websvc")
+            if not ep or not ep.get("subsets"):
+                return False
+            addrs = ep["subsets"][0]["addresses"]
+            return len(addrs) == 3 and all(a.get("ip") for a in addrs)
+        _wait(endpoints_full, msg="3 endpoint addresses")
+
+        def proxy_sees_three():
+            return len(proxy.backends("default", "websvc")) == 3
+        _wait(proxy_sees_three, msg="proxy synced 3 backends")
+        # Round-robin hits every backend.
+        picks = {proxy.resolve("default", "websvc") for _ in range(6)}
+        assert picks == set(proxy.backends("default", "websvc"))
+
+        # Scale down: endpoints shrink, proxy follows.
+        rc = store.get("replicationcontrollers", "default/webrc")
+        rc["spec"]["replicas"] = 1
+        store.update("replicationcontrollers", rc)
+        _wait(lambda: len(proxy.backends("default", "websvc")) == 1,
+              msg="proxy follows scale-down to 1 backend")
+        assert proxy.resolve("default", "websvc") == \
+            proxy.backends("default", "websvc")[0]
+
+        # Deleting the service garbage-collects its endpoints; the proxy
+        # stops resolving.
+        store.delete("services", "default/websvc")
+        _wait(lambda: store.get("endpoints", "default/websvc") is None,
+              msg="endpoints GC'd with the service")
+        _wait(lambda: proxy.resolve("default", "websvc") is None,
+              msg="proxy dropped the dead service")
+
+        # A selectorless service's manual endpoints are never touched.
+        store.create("services", {
+            "metadata": {"name": "extsvc", "namespace": "default"},
+            "spec": {}})
+        store.create("endpoints", {
+            "metadata": {"name": "extsvc", "namespace": "default"},
+            "subsets": [{"addresses": [{"ip": "192.168.9.9"}]}]})
+        time.sleep(1.0)  # several sync periods
+        ep = store.get("endpoints", "default/extsvc")
+        assert ep["subsets"][0]["addresses"][0]["ip"] == "192.168.9.9"
+    finally:
+        proxy.stop()
+        ec.stop()
