@@ -18,8 +18,24 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kube-apiserver (kubernetes_tpu)")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--token-auth-file", default="",
+                   help="CSV token,user,uid[,group1|group2] per line "
+                        "(tokenfile authenticator)")
+    p.add_argument("--authorization-policy-file", default="",
+                   help="ABAC policy file, one JSON object per line")
     opts = p.parse_args(argv)
-    server = serve(MemStore(), port=opts.port, host=opts.host)
+    auth = None
+    if opts.token_auth_file or opts.authorization_policy_file:
+        from kubernetes_tpu.apiserver.auth import (ABACAuthorizer,
+                                                   AuthConfig,
+                                                   TokenAuthenticator)
+        auth = AuthConfig(
+            authenticator=TokenAuthenticator.from_file(opts.token_auth_file)
+            if opts.token_auth_file else None,
+            authorizer=ABACAuthorizer.from_file(
+                opts.authorization_policy_file)
+            if opts.authorization_policy_file else None)
+    server = serve(MemStore(), port=opts.port, host=opts.host, auth=auth)
     print(f"apiserver listening on {server.server_address[0]}:"
           f"{server.server_address[1]}", file=sys.stderr, flush=True)
     stop = threading.Event()

@@ -1,0 +1,130 @@
+"""AuthN/Z for the apiserver write path (pkg/auth + plugin/pkg/auth,
+scheduler-relevant slice).
+
+Authentication: static token file, the reference's tokenfile authenticator
+(plugin/pkg/auth/authenticator/token/tokenfile) — CSV lines of
+``token,user,uid[,group1|group2]``; requests carry
+``Authorization: Bearer <token>``.
+
+Authorization: ABAC-lite (pkg/auth/authorizer/abac): an ordered list of
+policy dicts ``{"user": ..., "group": ..., "resource": ..., "readonly":
+bool}`` — empty/"*" fields match anything; a request is allowed if ANY
+policy matches (readonly policies only allow GET).  The file format is the
+reference's one-JSON-object-per-line policy file.
+
+Both are OFF unless configured — matching the reference's default
+insecure port — and wired in front of the handler chain
+(auth -> admission -> validation -> registry, pkg/apiserver).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class UserInfo:
+    name: str
+    uid: str = ""
+    groups: tuple[str, ...] = ()
+
+
+class AuthenticationError(Exception):
+    """No/unknown credentials -> 401."""
+
+
+class TokenAuthenticator:
+    """tokenfile.TokenAuthenticator: token -> UserInfo."""
+
+    def __init__(self, tokens: dict[str, UserInfo]):
+        self._tokens = dict(tokens)
+
+    @classmethod
+    def from_file(cls, path: str) -> "TokenAuthenticator":
+        tokens: dict[str, UserInfo] = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = [p.strip() for p in line.split(",")]
+                if len(parts) < 3:
+                    raise ValueError(
+                        f"token file line needs token,user,uid: {line!r}")
+                groups = tuple(g for g in parts[3].split("|")) \
+                    if len(parts) > 3 and parts[3] else ()
+                tokens[parts[0]] = UserInfo(name=parts[1], uid=parts[2],
+                                            groups=groups)
+        return cls(tokens)
+
+    def authenticate(self, authorization: str) -> UserInfo:
+        """``Authorization: Bearer <token>`` -> UserInfo or raises."""
+        scheme, _, token = authorization.partition(" ")
+        if scheme.lower() != "bearer" or not token.strip():
+            raise AuthenticationError("expected a bearer token")
+        user = self._tokens.get(token.strip())
+        if user is None:
+            raise AuthenticationError("unknown token")
+        return user
+
+
+@dataclass
+class ABACAuthorizer:
+    """abac.PolicyList.Authorize: any matching policy allows."""
+
+    policies: list[dict] = field(default_factory=list)
+
+    @classmethod
+    def from_file(cls, path: str) -> "ABACAuthorizer":
+        policies = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                policies.append(json.loads(line))
+        return cls(policies)
+
+    def authorize(self, user: UserInfo, verb: str, resource: str) -> bool:
+        readonly_verb = verb in ("GET", "HEAD")
+        for p in self.policies:
+            pu = p.get("user", "")
+            pg = p.get("group", "")
+            if pu and pu != "*" and pu != user.name:
+                continue
+            if pg and pg != "*" and pg not in user.groups:
+                continue
+            pr = p.get("resource", "")
+            if pr and pr != "*" and pr != resource:
+                continue
+            if p.get("readonly", False) and not readonly_verb:
+                continue
+            return True
+        return False
+
+
+@dataclass
+class AuthConfig:
+    """The chain the server consults; either part may be absent."""
+
+    authenticator: Optional[TokenAuthenticator] = None
+    authorizer: Optional[ABACAuthorizer] = None
+
+    def check(self, authorization: str, verb: str,
+              resource: str) -> Optional[tuple[int, str]]:
+        """None = allowed; else (status, message)."""
+        user = None
+        if self.authenticator is not None:
+            try:
+                user = self.authenticator.authenticate(authorization)
+            except AuthenticationError as err:
+                return 401, str(err)
+        if self.authorizer is not None:
+            if user is None:
+                user = UserInfo(name="system:anonymous")
+            if not self.authorizer.authorize(user, verb, resource):
+                return 403, (f"user {user.name!r} is not allowed to "
+                             f"{verb} {resource}")
+        return None

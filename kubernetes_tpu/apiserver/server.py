@@ -45,6 +45,7 @@ _STATUS_LINES = {
     200: b"HTTP/1.1 200 OK\r\n",
     201: b"HTTP/1.1 201 Created\r\n",
     400: b"HTTP/1.1 400 Bad Request\r\n",
+    401: b"HTTP/1.1 401 Unauthorized\r\n",
     403: b"HTTP/1.1 403 Forbidden\r\n",
     404: b"HTTP/1.1 404 Not Found\r\n",
     409: b"HTTP/1.1 409 Conflict\r\n",
@@ -53,7 +54,7 @@ _STATUS_LINES = {
 }
 
 
-def make_handler(store: MemStore):
+def make_handler(store: MemStore, auth=None):
     class Handler(socketserver.StreamRequestHandler):
         # Response header/body write pairs on keep-alive connections stall
         # ~40 ms under Nagle + the peer's delayed ACK; verbs are small.
@@ -88,6 +89,7 @@ def make_handler(store: MemStore):
                 except ValueError:
                     return
                 clen = 0
+                authz = ""
                 while True:
                     h = self.rfile.readline(65536)
                     if h in (b"\r\n", b"\n", b""):
@@ -97,6 +99,9 @@ def make_handler(store: MemStore):
                             clen = int(h[15:].strip())
                         except ValueError:
                             return
+                    elif auth is not None and \
+                            h[:14].lower() == b"authorization:":
+                        authz = h[14:].strip().decode(errors="replace")
                 # Bound the body: a negative length would read-to-EOF and
                 # an overstated one would block the thread until the peer
                 # gives up (mutual deadlock).
@@ -106,6 +111,29 @@ def make_handler(store: MemStore):
                 if len(raw) < clen:
                     return  # short body: peer lied or died
                 try:
+                    if auth is not None:
+                        # Auth runs FIRST in the chain (pkg/apiserver:
+                        # auth -> admission -> validation -> registry).
+                        target_s = target.decode()
+                        parts = [p for p in
+                                 target_s.split("?", 1)[0].split("/") if p]
+                        # Resource name for ABAC: the {kind} segment of
+                        # API paths; top-level paths (healthz, metrics)
+                        # are their own nameable resources.
+                        if len(parts) >= 5 and parts[2] == "namespaces":
+                            resource = parts[4]
+                        elif len(parts) >= 3 and parts[:2] == ["api", "v1"]:
+                            resource = parts[2]
+                        elif parts:
+                            resource = parts[0]
+                        else:
+                            resource = ""
+                        denied = auth.check(authz, method.decode(),
+                                            resource)
+                        if denied is not None:
+                            code, msg = denied
+                            self._send_json(code, {"error": msg})
+                            continue
                     if not self._dispatch(method.decode(), target.decode(),
                                           raw):
                         return  # watch served; connection consumed
@@ -312,8 +340,10 @@ class _Server(socketserver.ThreadingTCPServer):
 
 
 def serve(store: MemStore, port: int = 0,
-          host: str = "127.0.0.1") -> _Server:
-    server = _Server((host, port), make_handler(store))
+          host: str = "127.0.0.1", auth=None) -> _Server:
+    """``auth``: an apiserver.auth.AuthConfig; None = the reference's
+    insecure port (no authn/z)."""
+    server = _Server((host, port), make_handler(store, auth))
     t = threading.Thread(target=server.serve_forever, daemon=True,
                          name="apiserver-http")
     t.start()

@@ -42,9 +42,11 @@ class APIClient:
     _NAMESPACED = NAMESPACED_KINDS
 
     def __init__(self, base_url: str, qps: float = DEFAULT_QPS,
-                 burst: int = DEFAULT_BURST, timeout: float = 10.0):
+                 burst: int = DEFAULT_BURST, timeout: float = 10.0,
+                 token: str = ""):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.token = token  # bearer token (restclient.Config.BearerToken)
         self.limiter = TokenBucketRateLimiter(qps, burst)
         parsed = urllib.parse.urlparse(self.base_url)
         self._scheme = parsed.scheme or "http"
@@ -77,6 +79,8 @@ class APIClient:
         self.limiter.accept()
         data = json.dumps(obj).encode() if obj is not None else None
         headers = {"Content-Type": "application/json"} if data else {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
         for attempt in (0, 1):
             c = self._conn()
             try:
@@ -164,7 +168,7 @@ class APIClient:
         self.limiter.accept()
         return HTTPWatcher(
             f"{self.base_url}/api/v1/{kind}?watch=1&resourceVersion={from_rv}",
-            kind)
+            kind, token=self.token)
 
 
 # A healthy watch stream carries a server heartbeat every ~10 s
@@ -181,11 +185,13 @@ class HTTPWatcher:
     Reflector is transport-agnostic."""
 
     def __init__(self, url: str, kind: str,
-                 read_deadline: float = WATCH_READ_DEADLINE):
+                 read_deadline: float = WATCH_READ_DEADLINE,
+                 token: str = ""):
         self.kind = kind
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._stopped = threading.Event()
-        req = urllib.request.Request(url)
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        req = urllib.request.Request(url, headers=headers)
         try:
             # The timeout is the per-read socket deadline, not a stream
             # lifetime: heartbeats reset it, so it only fires when the

@@ -33,6 +33,7 @@ class EndpointsController:
         self._pods: dict[str, dict] = {}
         self._endpoints: dict[str, dict] = {}
         self._deleted_services: set[str] = set()
+        self._dirty: set[str] = set()  # service keys needing a sync
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._reflectors: list[Reflector] = []
@@ -69,14 +70,23 @@ class EndpointsController:
                     self._deleted_services.add(key)
             else:
                 self._services[key] = obj
+                self._dirty.add(key)
 
     def _on_pod(self, etype: str, obj: dict) -> None:
         key = MemStore.object_key(obj)
+        ns = (obj.get("metadata") or {}).get("namespace", "default")
         with self._lock:
             if etype == "DELETED":
                 self._pods.pop(key, None)
             else:
                 self._pods[key] = obj
+            # A pod event can affect any service in its namespace: mark
+            # them dirty rather than rescanning services x pods every
+            # sync (the reference controller is queue-driven the same
+            # way).
+            prefix = f"{ns}/"
+            self._dirty.update(k for k in self._services
+                               if k.startswith(prefix))
 
     def _on_endpoints(self, etype: str, obj: dict) -> None:
         key = MemStore.object_key(obj)
@@ -93,18 +103,32 @@ class EndpointsController:
             except Exception:  # noqa: BLE001 — HandleCrash analogue
                 log.exception("endpoints sync crashed; continuing")
 
-    def sync_all(self) -> None:
+    def sync_all(self, full: bool = False) -> None:
+        """Sync dirty services (event-driven); ``full`` rescans all."""
         with self._lock:
-            services = list(self._services.values())
+            if full:
+                dirty = set(self._services)
+            else:
+                dirty = self._dirty
+                self._dirty = set()
+            services = [self._services[k] for k in dirty
+                        if k in self._services]
             pods = list(self._pods.values())
             gone = list(self._deleted_services)
             self._deleted_services.clear()
         # GC endpoints of deleted selector-bearing services.
+        from kubernetes_tpu.client.http import APIError
         for key in gone:
             try:
                 self.store.delete("endpoints", key)
-            except Exception:  # noqa: BLE001 — already gone
-                pass
+            except Exception as err:  # noqa: BLE001
+                if isinstance(err, KeyError) or \
+                        (isinstance(err, APIError) and err.status == 404):
+                    continue  # already gone
+                # Transient failure (apiserver away): retry next sync —
+                # clearing the key here would leak the object forever.
+                with self._lock:
+                    self._deleted_services.add(key)
         for svc in services:
             self._sync_one(svc, pods)
 
@@ -144,18 +168,19 @@ class EndpointsController:
             current = self._endpoints.get(key)
         if current is not None and current.get("subsets", []) == subsets:
             return  # no-op sync: don't churn resourceVersions
-        if current is None:
-            try:
+        try:
+            if current is None:
                 self.store.create("endpoints", {
                     "metadata": {"name": name, "namespace": ns},
                     "subsets": subsets})
-            except Exception:  # noqa: BLE001 — raced another writer
-                pass
-        else:
-            updated = dict(current)
-            updated["subsets"] = subsets
-            try:
+            else:
+                updated = dict(current)
+                updated["subsets"] = subsets
                 from kubernetes_tpu.client import cas_update
                 cas_update(self.store, "endpoints", updated)
-            except Exception:  # noqa: BLE001 — next sync retries
-                pass
+        except Exception:  # noqa: BLE001 — raced another writer or a
+            # transient failure: RE-DIRTY so the event-driven sync
+            # retries (a lost write would otherwise wait for the next
+            # unrelated pod/service event).
+            with self._lock:
+                self._dirty.add(key)

@@ -242,6 +242,8 @@ def main(argv=None, out=sys.stdout) -> int:
                                 description=__doc__)
     p.add_argument("--server", "-s", required=True,
                    help="apiserver base URL")
+    p.add_argument("--token", default="",
+                   help="bearer token for an authenticated apiserver")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     g = sub.add_parser("get")
@@ -269,7 +271,7 @@ def main(argv=None, out=sys.stdout) -> int:
         v.add_argument("name")
 
     opts = p.parse_args(argv)
-    client = APIClient(opts.server, qps=0)
+    client = APIClient(opts.server, qps=0, token=opts.token)
     if opts.cmd == "get":
         return cmd_get(client, opts, out)
     if opts.cmd == "describe":

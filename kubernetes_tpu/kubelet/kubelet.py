@@ -158,18 +158,13 @@ class HollowKubelet:
         if reason:
             status["reason"] = reason
         if phase == "Running" and not status.get("podIP"):
-            # The hollow runtime's IPAM: a deterministic pod IP (kubemark's
-            # fake runtime assigns one too) — the endpoints controller
-            # needs it to build service endpoints.  md5, not hash():
-            # str hashing is PYTHONHASHSEED-randomized per process.  The
-            # 10.0.0.0/8-sized space keeps birthday collisions negligible
-            # at hollow-fleet scales.
-            import hashlib
-            digest = hashlib.md5(
-                MemStore.object_key(obj).encode()).digest()
-            h = int.from_bytes(digest[:4], "big") % (254 * 254 * 254)
-            status["podIP"] = (f"10.{h // (254 * 254)}."
-                               f"{h // 254 % 254}.{h % 254 + 1}")
+            # The hollow runtime's IPAM (kubemark's fake runtime assigns
+            # pod IPs too): a node-scoped /24 (md5 of the node name — NOT
+            # hash(), which is PYTHONHASHSEED-randomized) + a per-kubelet
+            # counter, so IPs are collision-free within a node by
+            # construction; cross-node collisions need a node-name hash
+            # collision in a 64k space (negligible at hollow-fleet sizes).
+            status["podIP"] = self._next_pod_ip()
         try:
             # CAS on the watched rv: a concurrent writer (labels,
             # conditions) must win over this watch-stale copy; the watch
@@ -178,6 +173,16 @@ class HollowKubelet:
             cas_update(self.store, "pods", obj)
         except Exception:  # noqa: BLE001 — a newer write wins; watch
             pass           # redelivers and the handler re-runs
+
+    def _next_pod_ip(self) -> str:
+        import hashlib
+        if not hasattr(self, "_ip_counter"):
+            digest = hashlib.md5(self.node.name.encode()).digest()
+            h = int.from_bytes(digest[:4], "big") % (254 * 254)
+            self._ip_prefix = f"10.{h // 254}.{h % 254}"
+            self._ip_counter = 0
+        self._ip_counter = self._ip_counter % 254 + 1
+        return f"{self._ip_prefix}.{self._ip_counter}"
 
     def running_pods(self) -> list[str]:
         with self._lock:

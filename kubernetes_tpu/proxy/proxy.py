@@ -48,6 +48,7 @@ class HollowProxy:
         with self._lock:
             if etype == "DELETED":
                 self._backends.pop(key, None)
+                self._rr.pop(key, None)
                 return
             ips = [a.get("ip", "")
                    for subset in obj.get("subsets") or ()

@@ -54,6 +54,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scheduler-name", default=api.DEFAULT_SCHEDULER_NAME)
     p.add_argument("--kube-api-qps", type=float, default=50.0)
     p.add_argument("--kube-api-burst", type=int, default=100)
+    p.add_argument("--kube-api-token", default="",
+                   help="bearer token for an authenticated apiserver")
     p.add_argument("--hard-pod-affinity-symmetric-weight", type=int,
                    default=None)
     p.add_argument("--leader-elect", action="store_true", default=False)
@@ -167,7 +169,9 @@ def main(argv=None) -> int:
 
     factory = ConfigFactory(source, policy=policy,
                             scheduler_name=opts.scheduler_name,
-                            qps=opts.kube_api_qps, burst=opts.kube_api_burst)
+                            qps=opts.kube_api_qps,
+                            burst=opts.kube_api_burst,
+                            token=opts.kube_api_token)
     mux = _status_mux(factory, configz, opts.port)
     log.info("status http on :%d (healthz, metrics, configz)",
              mux.server_address[1])

@@ -145,15 +145,16 @@ class ConfigFactory:
                  policy: Optional[Policy] = None,
                  scheduler_name: str = api.DEFAULT_SCHEDULER_NAME,
                  batched: bool = True,
-                 qps: float = 50.0, burst: int = 100):
+                 qps: float = 50.0, burst: int = 100, token: str = ""):
         if isinstance(store, str):
-            store = APIClient(store, qps=qps, burst=burst)
+            store = APIClient(store, qps=qps, burst=burst, token=token)
         self.store = store
         self.listers = Listers()
         self.algorithm = GenericScheduler(policy=policy, listers=self.listers)
         if isinstance(store, APIClient):
             binder = APIClientBinder(store)
-            events_client = APIClient(store.base_url, qps=0)
+            events_client = APIClient(store.base_url, qps=0,
+                                      token=store.token)
             from kubernetes_tpu.utils.events import async_sink
             recorder = EventRecorder(sink=async_sink(_throttled_sink(
                 make_event_sink(events_client), qps, burst)))
