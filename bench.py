@@ -25,6 +25,51 @@ import sys
 import time
 
 
+def _joint_quality(n_nodes: int = 500, n_pods: int = 6000) -> dict:
+    """Greedy vs LP-joint placement on an overcommitted mixed fleet."""
+    import numpy as np
+
+    from kubernetes_tpu.engine.generic_scheduler import GenericScheduler
+    from kubernetes_tpu.api import types as api
+
+    def build():
+        s = GenericScheduler()
+        rng = np.random.RandomState(7)
+        for i in range(n_nodes):
+            s.cache.add_node(api.Node(
+                name=f"jn-{i}", labels={api.HOSTNAME_LABEL: f"jn-{i}"},
+                allocatable_milli_cpu=int(rng.choice([1000, 2000])),
+                allocatable_memory=8 * 1024 ** 3, allocatable_pods=110,
+                conditions=[api.NodeCondition("Ready", "True")]))
+        pods = []
+        for i in range(n_pods):
+            cpu = int(rng.choice([100, 400, 700]))
+            pods.append(api.Pod(
+                name=f"jq-{i}", namespace="default",
+                containers=[api.Container(
+                    name="c", requests={"cpu": f"{cpu}m",
+                                        "memory": "64Mi"})]))
+        return s, pods
+
+    t0 = time.perf_counter()
+    s1, pods1 = build()
+    greedy = sum(1 for d in s1.schedule_batch(pods1) if d is not None)
+    s2, pods2 = build()
+    joint = sum(1 for d in s2.schedule_batch(pods2, joint=True)
+                if d is not None)
+    dt = time.perf_counter() - t0
+    print(f"joint quality {n_nodes} nodes x {n_pods} pods: greedy placed "
+          f"{greedy}, joint placed {joint} ({dt:.1f}s incl. compiles)",
+          file=sys.stderr)
+    return {
+        "metric": f"global batched assignment quality, {n_pods} pods onto "
+                  f"an overcommitted {n_nodes}-node fleet",
+        "greedy_placed": greedy,
+        "joint_placed": joint,
+        "joint_vs_greedy": round(joint / max(greedy, 1), 4),
+    }
+
+
 def main() -> None:
     n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
     n_pods = int(os.environ.get("BENCH_PODS", "30000"))
@@ -56,6 +101,16 @@ def main() -> None:
         except Exception as err:  # noqa: BLE001 — wire phase is additive
             print(f"wire phase failed: {err}", file=sys.stderr)
 
+    # Joint-assignment quality (BASELINE's last config: "global batched
+    # assignment ... solved jointly"): on a contended fleet, the
+    # LP-pricing solve should place more of the queue than greedy order.
+    joint = None
+    if os.environ.get("BENCH_JOINT", "1") != "0":
+        try:
+            joint = _joint_quality()
+        except Exception as err:  # noqa: BLE001 — quality phase is additive
+            print(f"joint phase failed: {err}", file=sys.stderr)
+
     baseline = 8.0  # test/e2e/density.go:48 MinPodsPerSecondThroughput
     out = {
         "metric": f"scheduler throughput, {n_pods} pods onto {n_nodes} nodes "
@@ -66,6 +121,8 @@ def main() -> None:
         "vs_baseline": round(result.pods_per_second / baseline, 1),
         "cold_compile_s": round(cold_compile_s, 1),
     }
+    if joint is not None:
+        out["joint"] = joint
     if wire is not None:
         out["wire"] = {
             "metric": "same shape over HTTP: apiserver as a separate "

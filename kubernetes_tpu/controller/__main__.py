@@ -27,16 +27,19 @@ def main(argv=None) -> int:
     p.add_argument("--api-server", required=True)
     p.add_argument("--node-monitor-grace-period", type=float, default=40.0)
     p.add_argument("--pod-eviction-timeout", type=float, default=60.0)
+    p.add_argument("--kube-api-token", default="",
+                   help="bearer token for an authenticated apiserver")
     p.add_argument("--v", type=int, default=None)
     opts = p.parse_args(argv)
     configure(v=opts.v)
 
-    rm = ReplicationManager(opts.api_server).run()
+    tok = opts.kube_api_token
+    rm = ReplicationManager(opts.api_server, token=tok).run()
     nc = NodeLifecycleController(
         opts.api_server,
         monitor_grace=opts.node_monitor_grace_period,
-        eviction_timeout=opts.pod_eviction_timeout).run()
-    ec = EndpointsController(opts.api_server).run()
+        eviction_timeout=opts.pod_eviction_timeout, token=tok).run()
+    ec = EndpointsController(opts.api_server, token=tok).run()
     log.info("controller-manager running (replication + node lifecycle "
              "+ endpoints)")
 

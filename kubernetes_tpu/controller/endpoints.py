@@ -24,9 +24,9 @@ SYNC_PERIOD = 1.0
 
 class EndpointsController:
     def __init__(self, source: Union[MemStore, APIClient, str],
-                 sync_period: float = SYNC_PERIOD):
+                 sync_period: float = SYNC_PERIOD, token: str = ""):
         if isinstance(source, str):
-            source = APIClient(source)
+            source = APIClient(source, token=token)
         self.store = source
         self.sync_period = sync_period
         self._services: dict[str, dict] = {}
@@ -93,6 +93,11 @@ class EndpointsController:
         with self._lock:
             if etype == "DELETED":
                 self._endpoints.pop(key, None)
+                # Out-of-band deletion of a managed service's endpoints:
+                # re-dirty the service so the object is recreated (the
+                # old full-rescan did this implicitly).
+                if key in self._services:
+                    self._dirty.add(key)
             else:
                 self._endpoints[key] = obj
 

@@ -66,9 +66,9 @@ class ReplicationManager:
     """controller-manager's replication controller loop."""
 
     def __init__(self, source: Union[MemStore, APIClient, str],
-                 sync_period: float = SYNC_PERIOD):
+                 sync_period: float = SYNC_PERIOD, token: str = ""):
         if isinstance(source, str):
-            source = APIClient(source)
+            source = APIClient(source, token=token)
         self.store = source
         self.sync_period = sync_period
         self._rcs: dict[str, dict] = {}

@@ -29,6 +29,8 @@ def main(argv=None) -> int:
     p.add_argument("--label", action="append", default=[],
                    metavar="K=V", help="node label (repeatable)")
     p.add_argument("--heartbeat-period", type=float, default=10.0)
+    p.add_argument("--kube-api-token", default="",
+                   help="bearer token for an authenticated apiserver")
     p.add_argument("--v", type=int, default=None)
     opts = p.parse_args(argv)
     configure(v=opts.v)
@@ -44,7 +46,8 @@ def main(argv=None) -> int:
         allocatable_pods=opts.pods,
         conditions=[api.NodeCondition("Ready", "True")])
     kubelet = HollowKubelet(opts.api_server, node,
-                            heartbeat_period=opts.heartbeat_period).run()
+                            heartbeat_period=opts.heartbeat_period,
+                            token=opts.kube_api_token).run()
     log.info("hollow kubelet %s running", opts.node_name)
 
     stop = threading.Event()

@@ -44,9 +44,10 @@ HEARTBEAT_PERIOD = 10.0  # kubelet nodeStatusUpdateFrequency
 class HollowKubelet:
     def __init__(self, source: Union[MemStore, APIClient, str],
                  node: api.Node,
-                 heartbeat_period: float = HEARTBEAT_PERIOD):
+                 heartbeat_period: float = HEARTBEAT_PERIOD,
+                 token: str = ""):
         if isinstance(source, str):
-            source = APIClient(source)
+            source = APIClient(source, token=token)
         self.store = source
         self.node = node
         self.heartbeat_period = heartbeat_period
@@ -126,6 +127,8 @@ class HollowKubelet:
         if etype == "DELETED":
             with self._lock:
                 self._running.pop(key, None)
+                if hasattr(self, "_ip_leases"):
+                    self._ip_leases.pop(key, None)  # free the IP lease
             return
         phase = (obj.get("status") or {}).get("phase", "")
         if phase in ("Running", "Failed", "Succeeded"):
@@ -160,11 +163,13 @@ class HollowKubelet:
         if phase == "Running" and not status.get("podIP"):
             # The hollow runtime's IPAM (kubemark's fake runtime assigns
             # pod IPs too): a node-scoped /24 (md5 of the node name — NOT
-            # hash(), which is PYTHONHASHSEED-randomized) + a per-kubelet
-            # counter, so IPs are collision-free within a node by
-            # construction; cross-node collisions need a node-name hash
-            # collision in a 64k space (negligible at hollow-fleet sizes).
-            status["podIP"] = self._next_pod_ip()
+            # hash(), which is PYTHONHASHSEED-randomized) with leased host
+            # octets, probed past addresses still held by running pods —
+            # collision-free within a node by construction.  Cross-node
+            # collisions need BOTH a node-prefix collision (64k space) and
+            # lease-cursor alignment (cursors start at a second per-node
+            # hash): negligible at hollow-fleet sizes.
+            status["podIP"] = self._lease_pod_ip(MemStore.object_key(obj))
         try:
             # CAS on the watched rv: a concurrent writer (labels,
             # conditions) must win over this watch-stale copy; the watch
@@ -174,15 +179,32 @@ class HollowKubelet:
         except Exception:  # noqa: BLE001 — a newer write wins; watch
             pass           # redelivers and the handler re-runs
 
-    def _next_pod_ip(self) -> str:
+    def _lease_pod_ip(self, key: str) -> str:
+        """Lease a host octet in the node's /24 (caller holds no lock;
+        this method takes it).  Leases free when the pod is deleted, and
+        the probe skips octets still leased, so churn can wrap the cursor
+        without ever reusing a live pod's address."""
         import hashlib
-        if not hasattr(self, "_ip_counter"):
-            digest = hashlib.md5(self.node.name.encode()).digest()
-            h = int.from_bytes(digest[:4], "big") % (254 * 254)
-            self._ip_prefix = f"10.{h // 254}.{h % 254}"
-            self._ip_counter = 0
-        self._ip_counter = self._ip_counter % 254 + 1
-        return f"{self._ip_prefix}.{self._ip_counter}"
+        with self._lock:
+            if not hasattr(self, "_ip_cursor"):
+                digest = hashlib.md5(self.node.name.encode()).digest()
+                h = int.from_bytes(digest[:4], "big") % (254 * 254)
+                self._ip_prefix = f"10.{h // 254}.{h % 254}"
+                self._ip_cursor = int.from_bytes(digest[4:6], "big") % 254
+                self._ip_leases: dict[str, int] = {}  # pod key -> octet
+            prior = self._ip_leases.get(key)
+            if prior is not None:  # redelivered admission: same IP
+                return f"{self._ip_prefix}.{prior}"
+            in_use = set(self._ip_leases.values())
+            for _ in range(254):
+                self._ip_cursor = self._ip_cursor % 254 + 1
+                if self._ip_cursor not in in_use:
+                    self._ip_leases[key] = self._ip_cursor
+                    return f"{self._ip_prefix}.{self._ip_cursor}"
+            # All 254 octets leased (over the 110-pod allocatable cap —
+            # can't happen through admission): reuse the cursor slot.
+            self._ip_leases[key] = self._ip_cursor
+            return f"{self._ip_prefix}.{self._ip_cursor}"
 
     def running_pods(self) -> list[str]:
         with self._lock:

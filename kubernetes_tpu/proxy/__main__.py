@@ -20,10 +20,12 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kube-proxy (kubernetes_tpu, hollow)",
                                 description=__doc__)
     p.add_argument("--api-server", required=True)
+    p.add_argument("--kube-api-token", default="",
+                   help="bearer token for an authenticated apiserver")
     p.add_argument("--v", type=int, default=None)
     opts = p.parse_args(argv)
     configure(v=opts.v)
-    proxy = HollowProxy(opts.api_server).run()
+    proxy = HollowProxy(opts.api_server, token=opts.kube_api_token).run()
     log.info("hollow kube-proxy running")
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

@@ -23,9 +23,10 @@ log = get_logger("kube-proxy")
 
 
 class HollowProxy:
-    def __init__(self, source: Union[MemStore, APIClient, str]):
+    def __init__(self, source: Union[MemStore, APIClient, str],
+                 token: str = ""):
         if isinstance(source, str):
-            source = APIClient(source)
+            source = APIClient(source, token=token)
         self.store = source
         self._backends: dict[str, list[str]] = {}  # "ns/svc" -> pod IPs
         self._rr: dict[str, int] = {}              # round-robin cursors
