@@ -51,6 +51,7 @@ _STATUS_LINES = {
     409: b"HTTP/1.1 409 Conflict\r\n",
     410: b"HTTP/1.1 410 Gone\r\n",
     422: b"HTTP/1.1 422 Unprocessable Entity\r\n",
+    501: b"HTTP/1.1 501 Not Implemented\r\n",
 }
 
 
@@ -90,6 +91,7 @@ def make_handler(store: MemStore, auth=None):
                     return
                 clen = 0
                 authz = ""
+                chunked = False
                 while True:
                     h = self.rfile.readline(65536)
                     if h in (b"\r\n", b"\n", b""):
@@ -99,9 +101,19 @@ def make_handler(store: MemStore, auth=None):
                             clen = int(h[15:].strip())
                         except ValueError:
                             return
+                    elif h[:18].lower() == b"transfer-encoding:":
+                        chunked = True
                     elif auth is not None and \
                             h[:14].lower() == b"authorization:":
                         authz = h[14:].strip().decode(errors="replace")
+                if chunked:
+                    # This loop only understands Content-Length framing.
+                    # Silently treating a chunked body as empty would make
+                    # the body bytes misparse as the next pipelined
+                    # request — reject and close instead.
+                    self._send_json(501, {"error":
+                                          "chunked requests unsupported"})
+                    return
                 # Bound the body: a negative length would read-to-EOF and
                 # an overstated one would block the thread until the peer
                 # gives up (mutual deadlock).
@@ -185,6 +197,13 @@ def make_handler(store: MemStore, auth=None):
                 except ValueError:
                     self._send_json(400, {"error": "bad json"})
                     return True
+                if not isinstance(body_obj, dict):
+                    self._send_json(400, {"error": "body must be an object"})
+                    return True
+                if body_obj.get("metadata") is None:
+                    # Normalize "metadata": null so downstream setdefault
+                    # paths never trip on None.
+                    body_obj["metadata"] = {}
             if method == "POST":
                 self._do_post(parts, body_obj)
             elif method == "PUT":
@@ -300,6 +319,11 @@ def make_handler(store: MemStore, auth=None):
             try:
                 if len(parts) == 6 and parts[2] == "namespaces":
                     kind = parts[4]
+                    # The path names the namespace; an object missing
+                    # metadata.namespace would otherwise key as
+                    # cluster-scoped and miss the stored object.
+                    body.setdefault("metadata", {}).setdefault(
+                        "namespace", parts[3])
                 elif len(parts) == 4 and parts[:2] == ["api", "v1"]:
                     kind = parts[2]
                 else:

@@ -135,9 +135,15 @@ class APIClient:
         return self._request("POST", f"/api/v1/{kind}", obj)
 
     def update(self, kind: str, obj: dict) -> dict:
-        key = (obj.get("metadata") or {}).get("namespace", "")
+        ns = (obj.get("metadata") or {}).get("namespace", "")
         name = (obj.get("metadata") or {}).get("name", "")
-        key = f"{key}/{name}" if key else name
+        if not ns and kind in self._NAMESPACED:
+            # Match the server's POST defaulting: a namespaced object
+            # without metadata.namespace lives in "default" — without
+            # this, _object_path would treat the bare name as the
+            # namespace and PUT to an empty object name.
+            ns = "default"
+        key = f"{ns}/{name}" if ns else name
         return self._request("PUT", self._object_path(kind, key), obj)
 
     def delete(self, kind: str, key: str) -> None:

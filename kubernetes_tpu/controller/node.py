@@ -50,6 +50,13 @@ class NodeLifecycleController:
         self._pods: dict[str, dict] = {}
         # Node -> when its heartbeat was first observed missing.
         self._silent_since: dict[str, float] = {}
+        # Node -> when this controller first saw it.  A node that has
+        # never heartbeated (created via `kubectl create -f`, or freshly
+        # registered) gets a startup grace from first observation — the
+        # reference grants nodeStartupGracePeriod from CreationTimestamp
+        # when no probe has ever landed (nodecontroller.go:740-744), so
+        # static nodes are never condemned on the first monitor sync.
+        self._first_seen: dict[str, float] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._reflectors: list[Reflector] = []
@@ -78,8 +85,10 @@ class NodeLifecycleController:
             if etype == "DELETED":
                 self._nodes.pop(name, None)
                 self._silent_since.pop(name, None)
+                self._first_seen.pop(name, None)
             else:
                 self._nodes[name] = obj
+                self._first_seen.setdefault(name, time.time())
 
     def _on_pod(self, etype: str, obj: dict) -> None:
         key = MemStore.object_key(obj)
@@ -113,6 +122,17 @@ class NodeLifecycleController:
             pods = list(self._pods.values())
         for name, node in nodes.items():
             hb = self._last_heartbeat(node)
+            if not hb:
+                # Never heartbeated: startup grace runs from first
+                # observation, not from epoch 0 (which would condemn the
+                # node on the very first sync).  Guarded on current
+                # membership so a concurrent DELETED (which popped the
+                # entry) isn't resurrected as a stale timestamp for a
+                # future re-creation of the same name.
+                with self._lock:
+                    if name not in self._nodes:
+                        continue
+                    hb = self._first_seen.setdefault(name, now)
             if hb and now - hb <= self.monitor_grace:
                 with self._lock:
                     self._silent_since.pop(name, None)

@@ -17,6 +17,7 @@ from __future__ import annotations
 import random
 import string
 import threading
+import time
 from typing import Union
 
 from kubernetes_tpu.api import types as api
@@ -77,6 +78,15 @@ class ReplicationManager:
         self._stop = threading.Event()
         self._reflectors: list[Reflector] = []
         self._rand = random.Random(0)
+        # Expectations (the reference's RCExpectations): pods this
+        # controller created/deleted whose watch event hasn't landed in
+        # the reflector cache yet.  Counting them toward `have` stops a
+        # lagging pod watch (one sync period in-process, longer over
+        # HTTP) from re-creating want-have replicas every sync and then
+        # deleting the transient extras.  rc key -> {pod name: deadline}.
+        self._pending_creates: dict[str, dict[str, float]] = {}
+        self._pending_deletes: dict[str, dict[str, float]] = {}
+        self._expectation_ttl = max(5.0, 5 * sync_period)
 
     def run(self) -> "ReplicationManager":
         import functools
@@ -106,6 +116,8 @@ class ReplicationManager:
         with self._lock:
             if etype == "DELETED":
                 self._rcs.pop(key, None)
+                self._pending_creates.pop(key, None)
+                self._pending_deletes.pop(key, None)
             else:
                 self._rcs[key] = obj
 
@@ -126,12 +138,13 @@ class ReplicationManager:
 
     def sync_all(self) -> None:
         with self._lock:
-            rcs = list(self._rcs.values())
+            rcs = list(self._rcs.items())
             pods = list(self._pods.values())
-        for rc in rcs:
-            self._sync_one(rc, pods)
+        for key, rc in rcs:
+            self._sync_one(rc, pods, rc_key=key)
 
-    def _sync_one(self, rc: dict, pods: list[dict]) -> None:
+    def _sync_one(self, rc: dict, pods: list[dict],
+                  rc_key: str | None = None) -> None:
         meta = rc.get("metadata") or {}
         spec = rc.get("spec") or {}
         ns = meta.get("namespace", "default")
@@ -154,24 +167,54 @@ class ReplicationManager:
         mine = [p for p in pods
                 if (p.get("metadata") or {}).get("namespace", "default")
                 == ns and _matches(selector, p) and _alive(p)]
-        have = len(mine)
+        # Settle expectations against the cache before diffing: a pending
+        # create is fulfilled once its pod shows up (or expires — the
+        # create may have failed); a pending delete is fulfilled once the
+        # pod is gone from the cache.
+        # The ledger key carries the kind (like the _rcs cache key): an RC
+        # and an RS sharing a ns/name must not read each other's
+        # expectations.
+        if rc_key is None:
+            rc_key = f"?:{ns}/{meta.get('name', '')}"
+        now = time.time()
+        cache_names = {(p.get("metadata") or {}).get("name", "")
+                       for p in mine}
+        creates = self._pending_creates.setdefault(rc_key, {})
+        deletes = self._pending_deletes.setdefault(rc_key, {})
+        for n in list(creates):
+            if n in cache_names or now > creates[n]:
+                creates.pop(n, None)
+        for n in list(deletes):
+            if n not in cache_names or now > deletes[n]:
+                deletes.pop(n, None)
+        have = len(mine) + len(creates) - len(deletes)
         if have < want:
             for _ in range(want - have):
-                self._create_replica(rc, ns, selector)
+                name = self._create_replica(rc, ns, selector)
+                if name:
+                    creates[name] = now + self._expectation_ttl
         elif have > want:
             # Prefer deleting unassigned pods first (the reference ranks
-            # not-running pods for deletion first).
+            # not-running pods for deletion first); never re-delete a pod
+            # whose delete is already in flight.
             mine.sort(key=lambda p: bool(
                 (p.get("spec") or {}).get("nodeName")))
-            for p in mine[: have - want]:
+            victims = [p for p in mine
+                       if (p.get("metadata") or {}).get("name", "")
+                       not in deletes]
+            for p in victims[: have - want]:
                 pmeta = p.get("metadata") or {}
+                pname = pmeta.get("name", "")
                 try:
-                    self.store.delete(
-                        "pods", f"{ns}/{pmeta.get('name', '')}")
+                    self.store.delete("pods", f"{ns}/{pname}")
+                    deletes[pname] = now + self._expectation_ttl
                 except Exception:  # noqa: BLE001 — already gone
                     pass
 
-    def _create_replica(self, rc: dict, ns: str, selector: dict) -> None:
+    def _create_replica(self, rc: dict, ns: str,
+                        selector: dict) -> str | None:
+        """Create one stamped replica; returns its name on success (for
+        the expectations ledger) or None."""
         meta = rc.get("metadata") or {}
         template = (rc.get("spec") or {}).get("template") or {}
         suffix = "".join(self._rand.choices(string.ascii_lowercase +
@@ -198,10 +241,12 @@ class ReplicationManager:
             log.warning("rc %s/%s: stamped replica would not match its "
                         "selector; refusing to create", ns,
                         meta.get("name"))
-            return
+            return None
         try:
             self.store.create("pods", pod)
             log.info("rc %s/%s created pod %s", ns, meta.get("name"),
                      pod["metadata"]["name"])
+            return pod["metadata"]["name"]
         except Exception:  # noqa: BLE001 — retried next sync
             log.debug("replica create failed; will retry", exc_info=True)
+            return None

@@ -85,6 +85,58 @@ class TestREST:
                        binding)
         assert code == 409
 
+    def test_chunked_request_rejected(self, rig):
+        """The hand-parsed loop only frames by Content-Length; a chunked
+        request must be rejected (501) and the connection closed — not
+        have its body misparsed as the next pipelined request."""
+        import socket
+        _, base = rig
+        host, port = base.replace("http://", "").split(":")
+        s = socket.create_connection((host, int(port)), timeout=5)
+        s.sendall(b"POST /api/v1/pods HTTP/1.1\r\n"
+                  b"Host: x\r\nTransfer-Encoding: chunked\r\n"
+                  b"Content-Type: application/json\r\n\r\n"
+                  b"5\r\n{\"a\":\r\n0\r\n\r\n")
+        data = s.recv(65536)
+        assert b"501" in data.split(b"\r\n", 1)[0], data
+        # Server closes: the next read yields EOF, never a misparse.
+        s.settimeout(5)
+        assert s.recv(65536) == b""
+        s.close()
+
+    def test_null_metadata_and_non_object_bodies(self, rig):
+        """"metadata": null must normalize (422 from validation, not a
+        dropped connection); a JSON array body is a clean 400."""
+        _, base = rig
+        code, _ = _req(base, "PUT", "/api/v1/namespaces/default/pods/x",
+                       {"metadata": None, "spec": {}})
+        assert code in (404, 422), code
+        data = json.dumps([1, 2]).encode()
+        req = urllib.request.Request(
+            base + "/api/v1/pods", data=data, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                code = resp.status
+        except urllib.error.HTTPError as err:
+            code = err.code
+        assert code == 400
+
+    def test_client_update_defaults_namespace(self, rig):
+        """APIClient.update on a namespaced object without
+        metadata.namespace must PUT to namespace 'default' (matching the
+        server's POST defaulting), not build a malformed path."""
+        from kubernetes_tpu.client.http import APIClient
+        store, base = rig
+        store.create("pods", _pod("nsless"))
+        c = APIClient(base, qps=1000, burst=1000)
+        obj = store.get("pods", "default/nsless")
+        del obj["metadata"]["namespace"]
+        obj["metadata"]["labels"] = {"touched": "yes"}
+        c.update("pods", obj)
+        assert store.get("pods", "default/nsless")["metadata"]["labels"] \
+            == {"touched": "yes"}
+
     def test_http_binder(self, rig):
         store, base = rig
         store.create("pods", _pod("hb"))
