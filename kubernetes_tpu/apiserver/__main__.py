@@ -35,7 +35,11 @@ def main(argv=None) -> int:
             authorizer=ABACAuthorizer.from_file(
                 opts.authorization_policy_file)
             if opts.authorization_policy_file else None)
-    server = serve(MemStore(), port=opts.port, host=opts.host, auth=auth)
+    # share_events: this process's only consumers are HTTP watch streams
+    # (read-only serializers), so events may reference stored objects
+    # directly — no per-write deepcopy (see MemStore.__init__).
+    server = serve(MemStore(share_events=True), port=opts.port,
+                   host=opts.host, auth=auth)
     print(f"apiserver listening on {server.server_address[0]}:"
           f"{server.server_address[1]}", file=sys.stderr, flush=True)
     stop = threading.Event()

@@ -25,6 +25,7 @@ nodes); copies go in and out so callers can't mutate store state.
 from __future__ import annotations
 
 import copy
+import json
 import queue
 import threading
 from dataclasses import dataclass
@@ -49,6 +50,18 @@ class Event:
     object: dict
     rv: int
 
+    def wire_line(self) -> bytes:
+        """The NDJSON watch-wire form, serialized once and shared by every
+        HTTP watch stream carrying this event (the same Event instance is
+        delivered to all watchers) — at density rates the per-stream
+        re-serialization was a measurable slice of apiserver GIL time."""
+        cached = self.__dict__.get("_wire")
+        if cached is None:
+            cached = (json.dumps({"type": self.type, "object": self.object},
+                                 separators=(",", ":")) + "\n").encode()
+            object.__setattr__(self, "_wire", cached)
+        return cached
+
 
 class Watcher:
     def __init__(self, store: "MemStore", kinds: tuple[str, ...]):
@@ -71,12 +84,20 @@ class Watcher:
 
 
 class MemStore:
-    def __init__(self) -> None:
+    def __init__(self, share_events: bool = False) -> None:
+        """``share_events=True`` lets events reference stored objects
+        directly instead of deep-copying a snapshot per write.  Safe ONLY
+        when every consumer is read-only — the standalone apiserver binary
+        qualifies (its watchers just serialize events to sockets, and no
+        store code mutates a stored object in place: bind is
+        copy-on-write).  In-process rigs keep the default: their reflector
+        handlers receive the event dicts and may mutate them."""
         self._lock = threading.Lock()
         self._objects: dict[str, dict[str, dict]] = {}   # kind -> key -> obj
         self._rv = 0
         self._events: list[Event] = []                   # ring window
         self._watchers: list[Watcher] = []
+        self._share_events = share_events
 
     # -- helpers ---------------------------------------------------------
 
@@ -86,32 +107,44 @@ class MemStore:
         ns = meta.get("namespace")
         return f"{ns}/{meta['name']}" if ns else meta["name"]
 
-    def _emit(self, etype: str, kind: str, key: str, obj: dict) -> None:
+    def _emit(self, etype: str, kind: str, key: str, obj: dict) -> Event:
         self._rv += 1
         obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
-        ev = Event(etype, kind, key, copy.deepcopy(obj), self._rv)
+        snapshot = obj if self._share_events else copy.deepcopy(obj)
+        ev = Event(etype, kind, key, snapshot, self._rv)
         self._events.append(ev)
         if len(self._events) > WATCH_WINDOW:
             self._events = self._events[-WATCH_WINDOW:]
         for w in self._watchers:
             if kind in w.kinds:
                 w._deliver(ev)
+        return ev
 
     # -- REST verbs ------------------------------------------------------
 
-    def create(self, kind: str, obj: dict) -> dict:
+    def create(self, kind: str, obj: dict, owned: bool = False) -> dict:
+        """``owned=True``: the caller transfers ownership of ``obj`` (the
+        HTTP handlers own their freshly parsed bodies) — the store keeps
+        it directly and returns the event's snapshot, skipping two of the
+        three deepcopies a defensive create pays.  Default semantics are
+        unchanged for in-process callers that may keep mutating theirs."""
         with self._lock:
             key = self.object_key(obj)
             bucket = self._objects.setdefault(kind, {})
             if key in bucket:
                 raise ConflictError(f"{kind} {key} already exists")
-            obj = copy.deepcopy(obj)
+            if not owned:
+                obj = copy.deepcopy(obj)
             bucket[key] = obj
-            self._emit("ADDED", kind, key, obj)
-            return copy.deepcopy(obj)
+            ev = self._emit("ADDED", kind, key, obj)
+            # The event snapshot is already shared read-only with every
+            # watcher; handing it to an owned caller (which serializes it
+            # and moves on) adds no new aliasing.
+            return ev.object if owned else copy.deepcopy(obj)
 
     def update(self, kind: str, obj: dict,
-               expected_rv: Optional[str] = None) -> dict:
+               expected_rv: Optional[str] = None,
+               owned: bool = False) -> dict:
         with self._lock:
             key = self.object_key(obj)
             bucket = self._objects.setdefault(kind, {})
@@ -121,10 +154,11 @@ class MemStore:
             if expected_rv is not None and \
                     current["metadata"].get("resourceVersion") != expected_rv:
                 raise ConflictError(f"{kind} {key} resourceVersion conflict")
-            obj = copy.deepcopy(obj)
+            if not owned:
+                obj = copy.deepcopy(obj)
             bucket[key] = obj
-            self._emit("MODIFIED", kind, key, obj)
-            return copy.deepcopy(obj)
+            ev = self._emit("MODIFIED", kind, key, obj)
+            return ev.object if owned else copy.deepcopy(obj)
 
     def delete(self, kind: str, key: str) -> None:
         with self._lock:
@@ -132,6 +166,10 @@ class MemStore:
             obj = bucket.pop(key, None)
             if obj is None:
                 raise KeyError(f"{kind} {key} not found")
+            # COW before the rv stamp: the popped dict may still be
+            # referenced by earlier in-flight events (share_events mode).
+            obj = dict(obj)
+            obj["metadata"] = dict(obj.get("metadata") or {})
             self._emit("DELETED", kind, key, obj)
 
     def get(self, kind: str, key: str) -> Optional[dict]:
@@ -173,13 +211,40 @@ class MemStore:
         """BindingREST.Create (etcd.go:286-330): CAS spec.nodeName while
         empty; MODIFIED event on success, ConflictError otherwise."""
         with self._lock:
-            key = f"{namespace}/{pod_name}"
-            pod = self._objects.get("pods", {}).get(key)
-            if pod is None:
-                raise KeyError(f"pod {key} not found")
-            if pod.setdefault("spec", {}).get("nodeName"):
-                raise ConflictError(
-                    f"pod {key} is already assigned to node "
-                    f"{pod['spec']['nodeName']}")
-            pod["spec"]["nodeName"] = node_name
-            self._emit("MODIFIED", "pods", key, pod)
+            self._bind_locked(namespace, pod_name, node_name)
+
+    def _bind_locked(self, namespace: str, pod_name: str,
+                     node_name: str) -> None:
+        key = f"{namespace}/{pod_name}"
+        pod = self._objects.get("pods", {}).get(key)
+        if pod is None:
+            raise KeyError(f"pod {key} not found")
+        if (pod.get("spec") or {}).get("nodeName"):
+            raise ConflictError(
+                f"pod {key} is already assigned to node "
+                f"{pod['spec']['nodeName']}")
+        # Copy-on-write (pod + the two sub-dicts this write touches): the
+        # previous version may still be referenced by in-flight events, so
+        # no stored object is ever mutated in place.
+        pod = dict(pod)
+        pod["spec"] = dict(pod.get("spec") or {})
+        pod["metadata"] = dict(pod.get("metadata") or {})
+        pod["spec"]["nodeName"] = node_name
+        self._objects["pods"][key] = pod
+        self._emit("MODIFIED", "pods", key, pod)
+
+    def bind_many(self, bindings: list[tuple[str, str, str]]
+                  ) -> list[Optional[str]]:
+        """Per-pod CAS under ONE lock acquisition: each (namespace, pod,
+        node) binds independently — a conflict on one never blocks the
+        rest, exactly as N sequential BindingREST.Create calls would
+        behave.  Returns a per-item error string (None = bound)."""
+        results: list[Optional[str]] = []
+        with self._lock:
+            for namespace, pod_name, node_name in bindings:
+                try:
+                    self._bind_locked(namespace, pod_name, node_name)
+                    results.append(None)
+                except (KeyError, ConflictError) as err:
+                    results.append(str(err))
+        return results

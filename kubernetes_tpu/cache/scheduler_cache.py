@@ -245,6 +245,21 @@ class SchedulerCache:
         self._pod_states[key] = _PodState(pod=pod, assumed=False, deadline=None)
 
     @_locked
+    def confirm_assumed(self, key: str, node_name: str) -> bool:
+        """Fast-path bind confirmation: an assumed pod whose watch event
+        agrees with the assumed node just flips to confirmed (TTL
+        cleared) — the attachment and aggregates are already correct, so
+        the full detach/attach of add_pod (and the pod JSON parse feeding
+        it) is skipped.  Returns False when the caller must fall back to
+        the full path (unknown pod, not assumed, or a different node)."""
+        st = self._pod_states.get(key)
+        if st is None or not st.assumed or st.pod.node_name != node_name:
+            return False
+        self._pod_states[key] = _PodState(pod=st.pod, assumed=False,
+                                          deadline=None)
+        return True
+
+    @_locked
     def update_pod(self, old: api.Pod, new: api.Pod) -> None:
         """UpdatePod (cache.go:188-206)."""
         st = self._pod_states.get(old.key)

@@ -157,6 +157,33 @@ class APIClient:
             "target": {"apiVersion": "v1", "kind": "Node",
                        "name": node_name}})
 
+    def bind_list(self, bindings: list[tuple[str, str, str]]
+                  ) -> list[Optional[str]]:
+        """Batch bindings: one POST carrying a Binding list; the server
+        runs the same per-pod CAS as N single POSTs and returns a
+        per-item error string (None = bound).  This is the wire-gap
+        lever: the engine decides in multi-thousand-pod chunks, and one
+        request per chunk replaces one request per pod."""
+        if not bindings:
+            return []
+        resp = self._request("POST", "/api/v1/namespaces/default/bindings", {
+            "kind": "BindingList",
+            "items": [{"metadata": {"name": pod, "namespace": ns},
+                       "target": {"kind": "Node", "name": node}}
+                      for ns, pod, node in bindings]})
+        return [None if r.get("code") == 201 else
+                r.get("error", f"HTTP {r.get('code')}")
+                for r in resp.get("results", [])]
+
+    def create_list(self, kind: str, objs: list[dict]) -> list[dict]:
+        """Batch create: one POST carrying a v1 List; per-item results
+        ({"code": 201, ...} or {"code": 4xx, "error": ...})."""
+        if not objs:
+            return []
+        resp = self._request("POST", f"/api/v1/{kind}",
+                             {"kind": "List", "items": objs})
+        return resp.get("results", [])
+
     # -- list + watch ----------------------------------------------------
 
     def list(self, kind: str,

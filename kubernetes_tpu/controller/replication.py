@@ -179,15 +179,25 @@ class ReplicationManager:
         now = time.time()
         cache_names = {(p.get("metadata") or {}).get("name", "")
                        for p in mine}
-        creates = self._pending_creates.setdefault(rc_key, {})
-        deletes = self._pending_deletes.setdefault(rc_key, {})
-        for n in list(creates):
-            if n in cache_names or now > creates[n]:
-                creates.pop(n, None)
-        for n in list(deletes):
-            if n not in cache_names or now > deletes[n]:
-                deletes.pop(n, None)
-        have = len(mine) + len(creates) - len(deletes)
+        with self._lock:
+            # Ledger access under the reflector lock, and only for a
+            # still-live controller: a DELETED event racing this sync
+            # must not have its cleanup undone by a setdefault here (the
+            # resurrected entry would leak, and a re-created same-name RC
+            # within the TTL would inherit stale expectations).  Direct
+            # callers (rc_key "?:...") always get a ledger.
+            if rc_key in self._rcs or rc_key.startswith("?:"):
+                creates = self._pending_creates.setdefault(rc_key, {})
+                deletes = self._pending_deletes.setdefault(rc_key, {})
+            else:
+                creates, deletes = {}, {}
+            for n in list(creates):
+                if n in cache_names or now > creates[n]:
+                    creates.pop(n, None)
+            for n in list(deletes):
+                if n not in cache_names or now > deletes[n]:
+                    deletes.pop(n, None)
+            have = len(mine) + len(creates) - len(deletes)
         if have < want:
             for _ in range(want - have):
                 name = self._create_replica(rc, ns, selector)

@@ -10,8 +10,12 @@ device solve (the TPU win).
 
 from __future__ import annotations
 
+import os
+import sys
+import time
 from dataclasses import dataclass, field
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -24,6 +28,7 @@ from kubernetes_tpu.cache.scheduler_cache import SchedulerCache
 from kubernetes_tpu.engine import solver as sv
 from kubernetes_tpu.engine.extender_client import ExtenderError, HTTPExtender
 from kubernetes_tpu.features import batch as fb
+from kubernetes_tpu.features import padcap
 from kubernetes_tpu.features.volumes import compile_volsvc
 from kubernetes_tpu.utils.logging import get_logger
 from kubernetes_tpu.utils.trace import Trace
@@ -122,11 +127,30 @@ class GenericScheduler:
         self.solver = sv.Solver(self.policy)
         self.extenders = [HTTPExtender(cfg) for cfg in self.policy.extenders]
         self.last_node_index = np.uint32(0)
+        # Monotonic compile state (features.padcap): table-axis capacities
+        # and the OR of all content flags seen, so a long-running daemon
+        # converges on ONE compiled scan per (chunk, cluster) shape
+        # instead of re-specializing whenever batch content wobbles.
+        self._axis_caps: dict[str, int] = {}
+        self._flags_seen: sv.BatchFlags | None = None
+
+    def _pinned_flags(self, batch) -> sv.BatchFlags:
+        """Content flags OR-ed monotonically (padcap's discipline for the
+        scan's boolean specialization): once a family has appeared, later
+        batches keep paying its (numerically no-op when empty) state
+        rather than minting a new compiled scan when it vanishes."""
+        flags = sv.batch_flags(batch)
+        if self._flags_seen is not None:
+            flags = sv.BatchFlags(*(a or b for a, b in
+                                    zip(flags, self._flags_seen)))
+        self._flags_seen = flags
+        return flags
 
     # -- compilation helpers --------------------------------------------
 
-    def _compile(self, pods: list[api.Pod]) -> tuple[fb.PodBatch, sv.DeviceBatch,
-                                                     sv.DeviceCluster, list[str]]:
+    def _compile(self, pods: list[api.Pod], device: bool = True
+                 ) -> tuple[fb.PodBatch, sv.DeviceBatch,
+                            sv.DeviceCluster, list[str]]:
         # The whole compile runs under the cache lock: cache mutators
         # (reflector handlers, async-bind forget_pod) update the aggregate
         # and existing-pod arrays IN PLACE, so every read — snapshot,
@@ -155,7 +179,10 @@ class GenericScheduler:
                 hard_pod_affinity_weight=(
                     self.policy.hard_pod_affinity_symmetric_weight),
                 volsvc=volsvc)
-            db = sv.device_batch(batch)
+            batch = padcap.apply_caps(batch, self._axis_caps)
+            # device=False keeps the batch pytree on host (the chunked
+            # drain slices it in numpy and transfers fixed-shape chunks).
+            db = sv.device_batch(batch) if device else sv.host_batch(batch)
             dc = sv.device_cluster(nt, agg, self.cache.space)
         return batch, db, dc, nt
 
@@ -168,7 +195,7 @@ class GenericScheduler:
         batch, db, dc, nt = self._compile([pod])
         trace.step("Computing predicates & priorities")
         feasible, scores = self.solver.evaluate(db, dc,
-                                                sv.batch_flags(batch))
+                                                self._pinned_flags(batch))
         trace.step("Selecting host")
         feasible_np = np.asarray(feasible[0])
         if not feasible_np.any():
@@ -243,7 +270,7 @@ class GenericScheduler:
             # restore (callers re-assume through the daemon).
             return self._schedule_batch_via_extenders(pods)
         batch, db, dc, nt = self._compile(pods)
-        flags = sv.batch_flags(batch)
+        flags = self._pinned_flags(batch)
         if log.isEnabledFor(10):
             log.debug("schedule_batch: %d pods (%d templates) x %d nodes, "
                       "joint=%s flags=%s", len(pods),
@@ -322,8 +349,23 @@ class GenericScheduler:
         if padded > p:
             all_pods += [api.Pod(name=f"__pad-{i}", namespace="__pad__")
                          for i in range(padded - p)]
-        batch, db, dc, nt = self._compile(all_pods)
-        flags = sv.batch_flags(batch)
+        t_c0 = time.perf_counter()
+        batch, hb, dc, nt = self._compile(all_pods, device=False)
+        flags = self._pinned_flags(batch)
+        if os.environ.get("KT_STREAM_DEBUG") == "1":
+            shapes = {f: tuple(getattr(hb, f).shape)
+                      for f in ("sel_required", "spread_node_counts",
+                                "avoid_rows")}
+            shapes.update({f: tuple(getattr(hb.aff, f).shape)
+                           for f in ("match_cnt", "decl_reach", "sym_cnt",
+                                     "node_dom")})
+            shapes.update({f: tuple(getattr(hb.volsvc, f).shape)
+                           for f in ("pd_pod_ebs", "pd_pod_gce", "vz_mask",
+                                     "sa_mask", "saa_score",
+                                     "nl_prio_rows")})
+            print(f"KT_STREAM compile({len(all_pods)} pods): "
+                  f"{time.perf_counter() - t_c0:.3f}s flags={tuple(flags)} "
+                  f"shapes={shapes}", file=sys.stderr)
         n = dc.alloc.shape[0]
         counter = jnp.uint32(self.last_node_index)
         carry = None
@@ -340,15 +382,27 @@ class GenericScheduler:
             return chunk_pods, placements
 
         from kubernetes_tpu.utils.profiling import device_trace
+        debug_t = os.environ.get("KT_STREAM_DEBUG") == "1"
         for start in range(0, padded, chunk_size):
-            db_k = sv.slice_pod_axis(db, start, start + chunk_size)
+            t0 = time.perf_counter() if debug_t else 0.0
+            # Host-slice (free numpy views), then one batched device_put of
+            # the fixed [chunk_size, ...] shapes: slicing ON DEVICE minted
+            # a dynamic_slice program per distinct drain length.
+            db_k = jax.device_put(
+                sv.slice_pod_axis(hb, start, start + chunk_size))
             live = jnp.asarray(live_np[start:start + chunk_size])
             with device_trace("solve_stream_chunk"):
                 choices_k, counter, carry = self.solver._solve_scan(
                     db_k, dc, counter, None, flags, carry, live)
+            if debug_t:
+                t1 = time.perf_counter()
             pending.append((start, choices_k))
             if len(pending) > 1:
                 yield emit(*pending.pop(0))
+            if debug_t:
+                print(f"KT_STREAM chunk@{start}: put+launch "
+                      f"{t1 - t0:.3f}s emit {time.perf_counter() - t1:.3f}s",
+                      file=sys.stderr)
         for start, choices_k in pending:
             yield emit(start, choices_k)
         self.last_node_index = np.uint32(counter)

@@ -225,16 +225,24 @@ def _pad_cols(a: np.ndarray, width: int) -> np.ndarray:
     return out
 
 
-def device_batch(b: PodBatch) -> DeviceBatch:
+def host_batch(b: PodBatch) -> DeviceBatch:
+    """The DeviceBatch pytree still holding host numpy arrays — the
+    chunked drain slices THIS (free numpy views with no dynamic_slice
+    programs; device slicing compiled one program per distinct drain
+    length) and device_puts each fixed-shape chunk."""
     parts = [getattr(b, f) for f in DeviceBatch._fields
              if f not in ("aff", "volsvc")]
     aff = DeviceAffinity(*[getattr(b.aff, f)
                            for f in DeviceAffinity._fields])
     volsvc = DeviceVolSvc(*[getattr(b.volsvc, f)
                             for f in DeviceVolSvc._fields])
+    return DeviceBatch(*parts, aff=aff, volsvc=volsvc)
+
+
+def device_batch(b: PodBatch) -> DeviceBatch:
     # One batched device_put for the whole pytree (~70 arrays): per-array
     # transfer calls dominate small-batch compiles otherwise.
-    return jax.device_put(DeviceBatch(*parts, aff=aff, volsvc=volsvc))
+    return jax.device_put(host_batch(b))
 
 
 def device_cluster(nt: NodeTensors, agg: NodeAggregates,

@@ -41,6 +41,7 @@ import numpy as np
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.features import compiler as fc
+from kubernetes_tpu.features.padcap import pad1 as _pad1, pow2 as _pow2
 
 # Resolved namespace marker: () after resolution means "all namespaces".
 _ALL_NS = ()
@@ -395,8 +396,13 @@ def compile_affinity(pods: Sequence[api.Pod],
     y_rows = [key_row(s) for s in y_tab.sigs]
     node_dom = dt.build()
 
-    sm, sd, sy = max(len(m_tab.sigs), 1), max(len(d_tab.sigs), 1), \
-        max(len(y_tab.sigs), 1)
+    # Sig-axis sizes are pow2-bucketed (padcap's discipline): live batches
+    # mint signatures freely, and every new count would otherwise be a
+    # fresh compiled scan shape (measured ~5-7 s recompiles per drain at
+    # density rates).  Padded rows are all-zero/inert — no pod references
+    # them.
+    sm, sd, sy = _pow2(len(m_tab.sigs)), _pow2(len(d_tab.sigs)), \
+        _pow2(len(y_tab.sigs))
 
     # -- match sig state from existing pods -----------------------------
     match_cnt = np.zeros((sm, n), np.float32)
@@ -481,14 +487,13 @@ def compile_affinity(pods: Sequence[api.Pod],
     return AffinityTensors(
         node_dom=node_dom,
         n_default=np.int32(dt.n_default),
-        match_key=np.asarray(m_rows or [-1], np.int32)[:sm],
+        match_key=_pad1(m_rows, sm, -1, np.int32),
         match_cnt=match_cnt, match_total=match_total, match_src=match_src,
         aff_need=aff_need, aff_self=aff_self, anti_need=anti_need,
         pref_w=pref_w,
-        decl_key=np.asarray(d_rows or [-1], np.int32)[:sd],
+        decl_key=_pad1(d_rows, sd, -1, np.int32),
         decl_reach=decl_reach, decl_match=decl_match, decl_src=decl_src,
-        sym_key=np.asarray(y_rows or [-1], np.int32)[:sy],
-        sym_w=np.asarray([s.weight for s in y_tab.sigs] or [0],
-                         np.float32)[:sy],
+        sym_key=_pad1(y_rows, sy, -1, np.int32),
+        sym_w=_pad1([s.weight for s in y_tab.sigs], sy, 0, np.float32),
         sym_cnt=sym_cnt, sym_match=sym_match, sym_src=sym_src,
         has_any=any_affinity)

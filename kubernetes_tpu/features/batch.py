@@ -24,6 +24,8 @@ import numpy as np
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.features import compiler as fc
 from kubernetes_tpu.features.affinity import AffinityTensors, compile_affinity
+from kubernetes_tpu.features.padcap import (pad_rows_pow2 as _pad_rows_pow2,
+                                            pow2 as _pow2)
 from kubernetes_tpu.features.volumes import (VolSvcTensors, compile_volsvc,
                                              empty_volsvc)
 
@@ -411,7 +413,12 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
         sel_group[i] = g
 
         # Spread group (services/RCs/RSs selecting this pod), if listers given.
-        if spread_selectors is not None and ep is not None:
+        # Pad rows (the stream drain's inert "__pad__" fill) must not mint
+        # a group: their distinct namespace would otherwise change S only
+        # on drains that happen to need padding — a new compiled shape for
+        # identical real content.
+        if spread_selectors is not None and ep is not None \
+                and pod.namespace != "__pad__":
             lkey = (pod.namespace, tuple(sorted(pod.labels.items())))
             sels = _sel_memo.get(lkey)
             if sels is None:
@@ -430,16 +437,30 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
                 spread_has_zone.append(any_zones and len(sels) > 0)
             spread_group[i] = sg
 
-    G = max(len(sel_rows), 1)
-    sel_required = np.stack(sel_rows) if sel_rows else np.ones((G, n), bool)
-    sel_pref = np.stack(pref_rows) if pref_rows else np.zeros((G, n), np.int32)
-    S = max(len(spread_node_rows), 1)
+    # Content-sized group axes are padded to powers of two (padcap's
+    # bucketing discipline): live batches vary these counts freely (every
+    # new selector signature, spread group, or avoid signature would
+    # otherwise be a fresh compiled shape).  Padding rows are never
+    # referenced by any pod index: sel pad rows are all-ones ("no
+    # constraint"), the rest zeros.
+    G = _pow2(len(sel_rows))
+    sel_required = np.ones((G, n), bool)
+    if sel_rows:
+        sel_required[:len(sel_rows)] = np.stack(sel_rows)
+    sel_pref = np.zeros((G, n), np.int32)
+    if pref_rows:
+        sel_pref[:len(pref_rows)] = np.stack(pref_rows)
+    S = _pow2(len(spread_node_rows))
     Z = max(num_zones, 1)
-    sp_n = np.stack(spread_node_rows) if spread_node_rows \
-        else np.zeros((S, n), np.float32)
-    sp_z = np.stack(spread_zone_rows) if spread_zone_rows \
-        else np.zeros((S, Z), np.float32)
-    sp_hz = np.array(spread_has_zone or [False], bool)
+    sp_n = np.zeros((S, n), np.float32)
+    if spread_node_rows:
+        sp_n[:len(spread_node_rows)] = np.stack(spread_node_rows)
+    sp_z = np.zeros((S, Z), np.float32)
+    if spread_zone_rows:
+        sp_z[:len(spread_zone_rows)] = np.stack(spread_zone_rows)
+    sp_hz = np.zeros(S, bool)
+    if spread_has_zone:
+        sp_hz[:len(spread_has_zone)] = spread_has_zone
 
     # In-batch increments: once pod i is placed it becomes an "existing pod"
     # for every later pod in the batch (the reference sees it via the assumed-
@@ -489,7 +510,8 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
         spread_node_counts=sp_n, spread_zone_counts=sp_z,
         spread_has_zones=sp_hz, spread_incr=spread_incr[tpl_idx],
         node_zone_id=node_zone_id, avoid_group=avoid_group[tpl_idx],
-        avoid_rows=np.stack(avoid_rows), aff=aff, volsvc=volsvc)
+        avoid_rows=_pad_rows_pow2(np.stack(avoid_rows)),
+        aff=aff, volsvc=volsvc)
 
 
 def _spread_counts(namespace: str, selectors: list,

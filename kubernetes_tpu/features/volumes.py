@@ -33,6 +33,14 @@ from kubernetes_tpu.api import types as api
 # modeled as an impossibly large new-volume count.
 INFEASIBLE_EXTRA = 1 << 20
 
+# Content-sized table axes are pow2-bucketed (features.padcap): a live
+# daemon mints volume ids / service signatures freely, and every new
+# count would otherwise re-specialize the compiled scan (a fresh XLA
+# compile on the scheduling clock).  Padded rows are inert — no pod
+# indexes them.
+from kubernetes_tpu.features.padcap import (pow2 as _pow2,  # noqa: E402
+                                            stack_pad as _stack_pad)
+
 
 class VolumeListers(Protocol):
     def get_pv(self, name: str) -> Optional[api.PersistentVolume]: ...
@@ -139,7 +147,7 @@ def _compile_pd_family(pods: Sequence[api.Pod],
             node_ids.append((nidx, ids))
             for vid in ids:
                 vocab.setdefault(vid, len(vocab))
-    w = max(len(vocab), 1)
+    w = _pow2(len(vocab))
     pod_m = np.zeros((len(pods), w), bool)
     node_m = np.zeros((n_nodes, w), bool)
     for i, ids in enumerate(pod_ids):
@@ -206,7 +214,7 @@ def _compile_volume_zone(pods: Sequence[api.Pod],
                     ok &= node_v == v
                 rows.append(ok | ~has_constraint)
         group[i] = g
-    mask = np.stack(rows) if rows else np.ones((1, n), bool)
+    mask = _stack_pad(rows, n, True)
     return group, mask
 
 
@@ -258,7 +266,7 @@ def _compile_service_affinity(pods: Sequence[api.Pod],
                     ok &= node_v == v
                 rows.append(ok)
         group[i] = g
-    mask = np.stack(rows) if rows else np.ones((1, n), bool)
+    mask = _stack_pad(rows, n, True)
     return group, mask
 
 
@@ -314,7 +322,7 @@ def _compile_service_anti_affinity(pods: Sequence[api.Pod],
                 per_label.append(np.zeros(n, np.float32))
             rows.append(per_label)
         group[i] = g
-    gcount = max(len(rows), 1)
+    gcount = _pow2(len(rows))
     out = np.zeros((L, gcount, n), np.float32)
     for g, per_label in enumerate(rows):
         for li, row in enumerate(per_label):

@@ -109,6 +109,14 @@ class Scheduler:
     # instead of one per queue length.
     _PAD_LIMIT = 4096
 
+    # Arrival-coalescing window (seconds): when a drain pops fewer pods
+    # than one stream chunk while more are clearly arriving, linger up to
+    # this long topping the batch up.  A trickle-fed drain otherwise pays
+    # a full padded chunk scan (plus ~250 ms launch overhead on a
+    # tunneled chip) for every fragment of the arrival race.  0 = off
+    # (the default: interactive paths keep their latency).
+    accumulate_s: float = 0.0
+
     def schedule_pending(self, wait_first: bool = True,
                          timeout: Optional[float] = None) -> int:
         """Drain the queue and solve it as one device batch.  Returns the
@@ -116,6 +124,16 @@ class Scheduler:
         pods = self.queue.pop_all(wait_first=wait_first, timeout=timeout)
         if not pods:
             return 0
+        chunk = self.stream_chunk_size()
+        if self.accumulate_s > 0 and len(pods) < chunk:
+            deadline = time.monotonic() + self.accumulate_s
+            idle_polls = 0
+            while len(pods) < chunk and idle_polls < 3 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.02)
+                more = self.queue.pop_all(wait_first=False)
+                idle_polls = 0 if more else idle_polls + 1
+                pods.extend(more)
         try:
             return self._solve_drain(pods)
         except Exception:  # noqa: BLE001 — HandleCrash analogue

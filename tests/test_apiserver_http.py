@@ -137,6 +137,52 @@ class TestREST:
         assert store.get("pods", "default/nsless")["metadata"]["labels"] \
             == {"touched": "yes"}
 
+    def test_batch_create_list(self, rig):
+        """POST a v1 List: per-item admission/validation/create with
+        partial success — one invalid item doesn't sink the batch."""
+        store, base = rig
+        items = [_pod(f"b{i}") for i in range(5)]
+        items[2] = {"metadata": {"name": "Bad Name!"},
+                    "spec": {"containers": [{"name": "c"}]}}
+        code, body = _req(base, "POST", "/api/v1/pods",
+                          {"kind": "List", "items": items})
+        assert code == 200 and body["created"] == 4
+        codes = [r["code"] for r in body["results"]]
+        assert codes == [201, 201, 422, 201, 201]
+        assert store.get("pods", "default/b0") is not None
+        assert store.get("pods", "default/Bad Name!") is None
+        # Duplicate create in a second batch reports 409 per item.
+        code, body = _req(base, "POST", "/api/v1/pods",
+                          {"kind": "List", "items": [_pod("b0")]})
+        assert body["results"][0]["code"] == 409
+
+    def test_batch_bind_cas(self, rig):
+        """Batch bindings keep the per-pod CAS observable: an
+        already-bound pod conflicts (409) without blocking the rest, and
+        a missing pod reports 404."""
+        store, base = rig
+        for i in range(3):
+            store.create("pods", _pod(f"bb{i}"))
+        store.bind("default", "bb1", "pre-bound")
+        code, body = _req(base, "POST",
+                          "/api/v1/namespaces/default/bindings",
+                          {"kind": "BindingList", "items": [
+                              {"metadata": {"name": "bb0"},
+                               "target": {"name": "n1"}},
+                              {"metadata": {"name": "bb1"},
+                               "target": {"name": "n2"}},
+                              {"metadata": {"name": "bb2"},
+                               "target": {"name": "n3"}},
+                              {"metadata": {"name": "ghost"},
+                               "target": {"name": "n4"}}]})
+        assert code == 200 and body["failed"] == 2
+        codes = [r["code"] for r in body["results"]]
+        assert codes == [201, 409, 201, 404]
+        assert store.get("pods", "default/bb0")["spec"]["nodeName"] == "n1"
+        assert store.get("pods", "default/bb1")["spec"]["nodeName"] == \
+            "pre-bound"
+        assert store.get("pods", "default/bb2")["spec"]["nodeName"] == "n3"
+
     def test_http_binder(self, rig):
         store, base = rig
         store.create("pods", _pod("hb"))
