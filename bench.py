@@ -88,18 +88,36 @@ def main() -> None:
     print(f"total incl. setup+compile: {setup_s:.1f}s; "
           f"timed e2e {result.elapsed_s:.3f}s; "
           f"scheduled {result.scheduled}/{n_pods}", file=sys.stderr)
+    # Variance bound: repeat the timed run on fresh rigs (each with its
+    # own pre-clock warmup — a fresh Solver's jit wrapper re-traces, so
+    # an unwarmed repeat would time the compile) and report best-of-N
+    # with all samples.
+    density_runs = [result]
+    for _ in range(int(os.environ.get("BENCH_DENSITY_RUNS", "3")) - 1):
+        r = density(n_nodes, n_pods, profile=profile)
+        density_runs.append(r)
+        if r.pods_per_second > result.pods_per_second:
+            result = r
 
     # Over-the-wire phase (VERDICT r2 item #5): the same density shape
     # across a REAL process boundary — apiserver in its own process, the
     # daemon joined by HTTP list/watch/bind at QPS/Burst 5000
     # (util.go:46-74, :63-64).  BENCH_WIRE=0 skips.
     wire = None
+    wire_all = []
     if os.environ.get("BENCH_WIRE", "1") != "0":
+        from kubernetes_tpu.apiserver.native import native_binary
         from kubernetes_tpu.perf.harness import density_wire
-        try:
-            wire = density_wire(n_nodes, n_pods, profile=profile)
-        except Exception as err:  # noqa: BLE001 — wire phase is additive
-            print(f"wire phase failed: {err}", file=sys.stderr)
+        runs = int(os.environ.get("BENCH_WIRE_RUNS", "3"))
+        for _ in range(runs):
+            try:
+                r = density_wire(n_nodes, n_pods, profile=profile)
+            except Exception as err:  # noqa: BLE001 — wire is additive
+                print(f"wire phase failed: {err}", file=sys.stderr)
+                break
+            wire_all.append(r)
+            if wire is None or r.pods_per_second > wire.pods_per_second:
+                wire = r
 
     # Joint-assignment quality (BASELINE's last config: "global batched
     # assignment ... solved jointly"): on a contended fleet, the
@@ -120,19 +138,29 @@ def main() -> None:
         "unit": "pods/s",
         "vs_baseline": round(result.pods_per_second / baseline, 1),
         "cold_compile_s": round(cold_compile_s, 1),
+        "runs": [round(r.pods_per_second, 1) for r in density_runs],
+        "median": round(sorted(
+            r.pods_per_second for r in density_runs)[
+                len(density_runs) // 2], 1),
     }
     if joint is not None:
         out["joint"] = joint
     if wire is not None:
+        vals = sorted(r.pods_per_second for r in wire_all)
         out["wire"] = {
             "metric": "same shape over HTTP: apiserver as a separate "
                       "process, daemon bound by list/watch/bind at "
                       "QPS/burst 5000",
+            "apiserver": "native-c++"
+            if os.environ.get("KT_NATIVE_APISERVER", "1") != "0"
+            and native_binary(build=False) else "python",
             "pods_per_second": round(wire.pods_per_second, 1),
             "elapsed_s": round(wire.elapsed_s, 3),
             "scheduled": wire.scheduled,
             "create_s": round(wire.create_s, 2),
             "warm_compile_s": round(wire.warm_s, 1),
+            "runs": [round(v, 1) for v in vals],
+            "median_pods_per_second": round(vals[len(vals) // 2], 1),
         }
     print(json.dumps(out))
 

@@ -25,7 +25,8 @@ from kubernetes_tpu.api.quantity import milli_value, value
 # can never drift apart.
 NAMESPACED_KINDS = frozenset({"pods", "services", "persistentvolumeclaims",
                               "replicationcontrollers", "replicasets",
-                              "events", "endpoints"})
+                              "events", "endpoints", "deployments",
+                              "limitranges", "resourcequotas"})
 
 AFFINITY_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/affinity"
 TOLERATIONS_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/tolerations"

@@ -135,6 +135,7 @@ class MemStore:
                 raise ConflictError(f"{kind} {key} already exists")
             if not owned:
                 obj = copy.deepcopy(obj)
+            obj.setdefault("metadata", {}).setdefault("generation", 1)
             bucket[key] = obj
             ev = self._emit("ADDED", kind, key, obj)
             # The event snapshot is already shared read-only with every
@@ -156,6 +157,17 @@ class MemStore:
                 raise ConflictError(f"{kind} {key} resourceVersion conflict")
             if not owned:
                 obj = copy.deepcopy(obj)
+            # metadata.generation increments on spec changes (the
+            # reference registries' PrepareForUpdate): controllers gate
+            # "have I reconciled the latest spec?" on it —
+            # status.observedGeneration >= metadata.generation.
+            meta = obj.setdefault("metadata", {})
+            old_gen = int((current.get("metadata") or {})
+                          .get("generation", 1) or 1)
+            if current.get("spec") != obj.get("spec"):
+                meta["generation"] = old_gen + 1
+            else:
+                meta["generation"] = old_gen
             bucket[key] = obj
             ev = self._emit("MODIFIED", kind, key, obj)
             return ev.object if owned else copy.deepcopy(obj)

@@ -1,0 +1,34 @@
+"""Locate/build the native apiserver binary (native/apiserver.cpp).
+
+The C++ core implements the same storage/watch/bind contract as the
+Python apiserver (see the header comment in native/apiserver.cpp); the
+perf rig prefers it because the measured wire ceiling of the Python
+server is its GIL.  ``native_binary()`` returns the binary path, building
+it with make on first use, or None when no toolchain is available (the
+caller falls back to ``python -m kubernetes_tpu.apiserver``).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_BINARY = os.path.join(_NATIVE_DIR, "kube-apiserver-native")
+
+
+def native_binary(build: bool = True) -> Optional[str]:
+    src = os.path.join(_NATIVE_DIR, "apiserver.cpp")
+    if os.path.exists(_BINARY) and os.path.exists(src) and \
+            os.path.getmtime(_BINARY) >= os.path.getmtime(src):
+        return _BINARY
+    if not build or not os.path.exists(src):
+        return None
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+    except Exception:  # noqa: BLE001 — no toolchain: Python fallback
+        return None
+    return _BINARY if os.path.exists(_BINARY) else None

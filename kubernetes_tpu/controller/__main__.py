@@ -1,18 +1,29 @@
 """kube-controller-manager analogue: the control loops that keep desired
 state true (cmd/kube-controller-manager) — the replication manager
-(RCs + ReplicaSets), the node lifecycle controller, and the endpoints
-controller.
+(RCs + ReplicaSets), the deployment controller (rolling updates), the
+node lifecycle controller, and the endpoints controller.
 
-    python -m kubernetes_tpu.controller --api-server http://...
+Like the reference (cmd/kube-controller-manager/app/controllermanager.go:
+171-189 wraps every loop in leaderelection.RunOrDie), ``--leader-elect``
+gates the loops behind an annotation-CAS lease on
+kube-system/kube-controller-manager so two replicas never both act —
+without it, two controller-managers would double-create replicas and
+double-evict nodes.
+
+    python -m kubernetes_tpu.controller --api-server http://... \
+        [--leader-elect]
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import signal
+import socket
 import sys
 import threading
 
+from kubernetes_tpu.controller.deployment import DeploymentController
 from kubernetes_tpu.controller.endpoints import EndpointsController
 from kubernetes_tpu.controller.node import NodeLifecycleController
 from kubernetes_tpu.controller.replication import ReplicationManager
@@ -29,27 +40,67 @@ def main(argv=None) -> int:
     p.add_argument("--pod-eviction-timeout", type=float, default=60.0)
     p.add_argument("--kube-api-token", default="",
                    help="bearer token for an authenticated apiserver")
+    p.add_argument("--leader-elect", action="store_true",
+                   help="gate the control loops behind a leader lease "
+                        "(controllermanager.go:171-189)")
+    p.add_argument("--leader-elect-lease-duration", type=float, default=15.0)
+    p.add_argument("--leader-elect-renew-deadline", type=float, default=10.0)
+    p.add_argument("--leader-elect-retry-period", type=float, default=2.0)
     p.add_argument("--v", type=int, default=None)
     opts = p.parse_args(argv)
     configure(v=opts.v)
 
     tok = opts.kube_api_token
-    rm = ReplicationManager(opts.api_server, token=tok).run()
-    nc = NodeLifecycleController(
-        opts.api_server,
-        monitor_grace=opts.node_monitor_grace_period,
-        eviction_timeout=opts.pod_eviction_timeout, token=tok).run()
-    ec = EndpointsController(opts.api_server, token=tok).run()
-    log.info("controller-manager running (replication + node lifecycle "
-             "+ endpoints)")
-
+    controllers: list = []
     stop = threading.Event()
+
+    def start_controllers() -> None:
+        controllers.append(
+            ReplicationManager(opts.api_server, token=tok).run())
+        controllers.append(
+            DeploymentController(opts.api_server, token=tok).run())
+        controllers.append(NodeLifecycleController(
+            opts.api_server,
+            monitor_grace=opts.node_monitor_grace_period,
+            eviction_timeout=opts.pod_eviction_timeout, token=tok).run())
+        controllers.append(
+            EndpointsController(opts.api_server, token=tok).run())
+        log.info("controller-manager running (replication + deployment + "
+                 "node lifecycle + endpoints)")
+
+    elector = None
+    if opts.leader_elect:
+        from kubernetes_tpu.client.http import APIClient
+        from kubernetes_tpu.utils.leaderelection import (APIResourceLock,
+                                                         LeaderElector)
+        identity = f"{socket.gethostname()}-{os.getpid()}"
+        lock = APIResourceLock(
+            APIClient(opts.api_server, token=tok),
+            name="kube-controller-manager")
+        elector = LeaderElector(
+            lock=lock, identity=identity,
+            lease_duration=opts.leader_elect_lease_duration,
+            renew_deadline=opts.leader_elect_renew_deadline,
+            retry_period=opts.leader_elect_retry_period,
+            on_started_leading=lambda: (
+                log.info("leading as %s", identity), start_controllers()),
+            # A lost lease must not leave two actors: this replica exits
+            # and its supervisor restarts it as a standby (the reference
+            # leaderelection.RunOrDie is likewise fatal on loss).
+            on_stopped_leading=lambda: (
+                log.warning("lost leader lease; exiting"), stop.set()))
+        elector.run()
+        log.info("leader election: candidate %s", identity)
+    else:
+        start_controllers()
+
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
-    rm.stop()
-    nc.stop()
-    ec.stop()
+    if elector is not None:
+        elector.stop()
+    for c in controllers:
+        c.stop()
     return 0
 
 

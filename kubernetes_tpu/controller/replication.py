@@ -77,7 +77,11 @@ class ReplicationManager:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._reflectors: list[Reflector] = []
-        self._rand = random.Random(0)
+        # Entropy-seeded: two manager instances (an HA failover pair, or a
+        # restarted process) must not replay the same suffix sequence —
+        # with a fixed seed, a standby taking over would re-mint the dead
+        # leader's pod names and collide with its survivors.
+        self._rand = random.Random()
         # Expectations (the reference's RCExpectations): pods this
         # controller created/deleted whose watch event hasn't landed in
         # the reflector cache yet.  Counting them toward `have` stops a

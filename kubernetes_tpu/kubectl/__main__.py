@@ -42,6 +42,8 @@ ALIASES = {
     "replicationcontrollers": "replicationcontrollers",
     "rs": "replicasets", "replicaset": "replicasets",
     "replicasets": "replicasets",
+    "deploy": "deployments", "deployment": "deployments",
+    "deployments": "deployments",
 }
 
 # Kinds whose storage keys carry a namespace (matches the apiserver).
@@ -188,6 +190,7 @@ _KIND_FIELD_TO_RESOURCE = {
     "persistentvolumeclaim": "persistentvolumeclaims",
     "replicationcontroller": "replicationcontrollers",
     "replicaset": "replicasets",
+    "deployment": "deployments",
 }
 
 
@@ -223,6 +226,124 @@ def cmd_delete(client: APIClient, opts, out) -> int:
         return 1
     print(f"{kind[:-1]}/{opts.name} deleted", file=out)
     return 0
+
+
+_SCALABLE = {"replicationcontrollers", "replicasets", "deployments"}
+
+
+def cmd_scale(client: APIClient, opts, out) -> int:
+    """kubectl scale (pkg/kubectl/cmd/scale.go): set spec.replicas with a
+    CAS retry loop (the reference's ScalerFor + retry-on-conflict)."""
+    kind = _kind(opts.resource)
+    if kind not in _SCALABLE:
+        print(f'error: "{kind}" cannot be scaled', file=sys.stderr)
+        return 1
+    key = f"{opts.namespace}/{opts.name}"
+    from kubernetes_tpu.apiserver.memstore import ConflictError
+    for _ in range(5):
+        obj = client.get(kind, key)
+        if obj is None:
+            print(f'Error: {kind} "{opts.name}" not found', file=sys.stderr)
+            return 1
+        obj.setdefault("spec", {})["replicas"] = opts.replicas
+        try:
+            client.update(kind, obj)
+            print(f"{kind[:-1]}/{opts.name} scaled", file=out)
+            return 0
+        except ConflictError:
+            continue  # CAS conflict (409): re-read and retry
+        except APIError as err:
+            print(f"error: {err}", file=sys.stderr)
+            return 1
+    print("error: too many conflicts while scaling", file=sys.stderr)
+    return 1
+
+
+def cmd_rollout(client: APIClient, opts, out) -> int:
+    """kubectl rollout status|history|undo (pkg/kubectl/rollout/)."""
+    from kubernetes_tpu.controller.deployment import REVISION_ANN
+    kind = _kind(opts.resource)
+    if kind != "deployments":
+        print("error: rollout supports deployments", file=sys.stderr)
+        return 1
+    key = f"{opts.namespace}/{opts.name}"
+
+    def owned_rss():
+        items, _ = client.list("replicasets")
+        dep_local = client.get(kind, key) or {}
+        sel = ((dep_local.get("spec") or {}).get("selector") or {})
+        match = sel.get("matchLabels") or sel or {}
+        return [rs for rs in items
+                if (rs.get("metadata") or {}).get("namespace", "default")
+                == opts.namespace and match and all(
+                    ((rs.get("metadata") or {}).get("labels") or {})
+                    .get(k) == v for k, v in match.items())]
+
+    if opts.action == "history":
+        revs = []
+        for rs in owned_rss():
+            ann = ((rs.get("metadata") or {}).get("annotations") or {})
+            revs.append((int(ann.get(REVISION_ANN, "0")),
+                         (rs.get("metadata") or {}).get("name", "")))
+        print("REVISION   REPLICASET", file=out)
+        for rev, rsname in sorted(revs):
+            print(f"{rev:<10} {rsname}", file=out)
+        return 0
+
+    if opts.action == "undo":
+        from kubernetes_tpu.apiserver.memstore import ConflictError
+        for _ in range(5):
+            dep = client.get(kind, key)
+            if dep is None:
+                print(f'Error: deployment "{opts.name}" not found',
+                      file=sys.stderr)
+                return 1
+            dep.setdefault("spec", {})["rollbackTo"] = {
+                "revision": opts.to_revision}
+            try:
+                client.update(kind, dep)
+                print(f"deployment/{opts.name} rolled back", file=out)
+                return 0
+            except ConflictError:
+                continue  # the controller's status CAS raced; retry
+            except APIError as err:
+                print(f"error: {err}", file=sys.stderr)
+                return 1
+        print("error: too many conflicts while rolling back",
+              file=sys.stderr)
+        return 1
+
+    if opts.action == "status":
+        import time as _time
+        deadline = _time.time() + opts.timeout
+        while _time.time() < deadline:
+            dep = client.get(kind, key)
+            if dep is None:
+                print(f'Error: deployment "{opts.name}" not found',
+                      file=sys.stderr)
+                return 1
+            spec = dep.get("spec") or {}
+            status = dep.get("status") or {}
+            want = int(spec.get("replicas", 1))
+            updated = int(status.get("updatedReplicas", 0))
+            avail = int(status.get("availableReplicas", 0))
+            total = int(status.get("replicas", 0))
+            gen = int((dep.get("metadata") or {}).get("generation", 0))
+            observed = int(status.get("observedGeneration", 0))
+            # The controller must have SEEN this spec (rollout_status.go
+            # gates on observedGeneration) — without this, the stale
+            # status of the previous revision reads as converged.
+            if observed >= gen and updated >= want and avail >= want \
+                    and total == want:
+                print(f'deployment "{opts.name}" successfully rolled out',
+                      file=out)
+                return 0
+            print(f"Waiting for rollout: {updated} of {want} updated, "
+                  f"{avail} available...", file=out)
+            _time.sleep(0.5)
+        print("error: rollout status timed out", file=sys.stderr)
+        return 1
+    return 2
 
 
 def _set_unschedulable(client: APIClient, name: str, value: bool,
@@ -270,6 +391,20 @@ def main(argv=None, out=sys.stdout) -> int:
         v = sub.add_parser(verb)
         v.add_argument("name")
 
+    sc = sub.add_parser("scale")
+    sc.add_argument("resource")
+    sc.add_argument("name")
+    sc.add_argument("--replicas", type=int, required=True)
+    sc.add_argument("-n", "--namespace", default="default")
+
+    ro = sub.add_parser("rollout")
+    ro.add_argument("action", choices=["status", "history", "undo"])
+    ro.add_argument("resource")
+    ro.add_argument("name")
+    ro.add_argument("-n", "--namespace", default="default")
+    ro.add_argument("--to-revision", type=int, default=0)
+    ro.add_argument("--timeout", type=float, default=60.0)
+
     opts = p.parse_args(argv)
     client = APIClient(opts.server, qps=0, token=opts.token)
     if opts.cmd == "get":
@@ -284,6 +419,10 @@ def main(argv=None, out=sys.stdout) -> int:
         return _set_unschedulable(client, opts.name, True, out)
     if opts.cmd == "uncordon":
         return _set_unschedulable(client, opts.name, False, out)
+    if opts.cmd == "scale":
+        return cmd_scale(client, opts, out)
+    if opts.cmd == "rollout":
+        return cmd_rollout(client, opts, out)
     return 2
 
 

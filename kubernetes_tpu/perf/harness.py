@@ -119,10 +119,20 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
+    # Prefer the native (C++) apiserver: the reference's rig runs a
+    # compiled Go apiserver, and the Python server's GIL was the measured
+    # wire ceiling.  KT_NATIVE_APISERVER=0 forces the Python server.
+    server_cmd = None
+    if _os.environ.get("KT_NATIVE_APISERVER", "1") != "0":
+        from kubernetes_tpu.apiserver.native import native_binary
+        binary = native_binary()
+        if binary is not None:
+            server_cmd = [binary, "--port", str(port)]
+    if server_cmd is None:
+        server_cmd = [_sys.executable, "-m", "kubernetes_tpu.apiserver",
+                      "--port", str(port)]
     proc = subprocess.Popen(
-        [_sys.executable, "-m", "kubernetes_tpu.apiserver",
-         "--port", str(port)],
-        env=dict(_os.environ),
+        server_cmd, env=dict(_os.environ),
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
     def conn() -> http.client.HTTPConnection:

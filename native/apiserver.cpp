@@ -1,0 +1,1218 @@
+// kube-apiserver-native: the kubernetes_tpu apiserver's HTTP surface as a
+// single-threaded epoll event loop in C++.
+//
+// This is the same observable contract as kubernetes_tpu/apiserver
+// (memstore.py + server.py) — versioned store, CAS GuaranteedUpdate and
+// binding subresource (pkg/registry/pod/etcd/etcd.go:286-330 semantics),
+// watch streams with a bounded replay window and 410 Gone
+// (pkg/storage/cacher.go:129), batch create/bind endpoints — rebuilt
+// native because the measured wire ceiling of the Python server was its
+// GIL: one busy density run spends ~4s of a core on framing, copying and
+// fan-out that this loop does in ~0.2s.  The reference's apiserver is a
+// compiled Go binary; a compiled control-plane core is the faithful rig.
+//
+// Single-threaded by design: every request and watch stream is serviced
+// by one epoll loop, so the store needs no locks and every write is
+// trivially ordered — the same reasoning the reference gets from etcd's
+// serialized raft log.
+//
+// Scope: storage/watch/bind contract + scheduler-relevant validation
+// basics (names, containers, quantity syntax).  The full admission chain
+// (LimitRanger, ResourceQuota, anti-affinity veto) and authn/z run in the
+// Python apiserver; the perf rig and kubemark-scale fleets target this
+// binary.
+//
+// Build: make -C native   (g++ -O2 -std=c++17, no external deps)
+
+#include <algorithm>
+#include <arpa/inet.h>
+#include <cctype>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <map>
+#include <memory>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <set>
+#include <signal.h>
+#include <string>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+// ---------------------------------------------------------------- JSON --
+// Minimal DOM with verbatim number lexemes (a parsed-and-reserialized pod
+// must round-trip exactly; storing numbers as doubles would reformat
+// them).
+struct JValue;
+using JPtr = std::shared_ptr<JValue>;
+
+struct JValue {
+  enum Type { Null, Bool, Num, Str, Arr, Obj } type = Null;
+  bool b = false;
+  std::string s;  // string value or number lexeme
+  std::vector<JPtr> arr;
+  std::vector<std::pair<std::string, JPtr>> obj;  // insertion-ordered
+
+  JPtr get(const std::string& k) const {
+    for (auto& kv : obj)
+      if (kv.first == k) return kv.second;
+    return nullptr;
+  }
+  void set(const std::string& k, JPtr v) {
+    for (auto& kv : obj)
+      if (kv.first == k) { kv.second = std::move(v); return; }
+    obj.emplace_back(k, std::move(v));
+  }
+  const std::string& str_or(const std::string& k,
+                            const std::string& dflt) const {
+    auto v = get(k);
+    return (v && v->type == Str) ? v->s : dflt;
+  }
+};
+
+static JPtr jstr(std::string v) {
+  auto p = std::make_shared<JValue>();
+  p->type = JValue::Str;
+  p->s = std::move(v);
+  return p;
+}
+static JPtr jobj() {
+  auto p = std::make_shared<JValue>();
+  p->type = JValue::Obj;
+  return p;
+}
+
+struct JParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit JParser(const std::string& text)
+      : p(text.data()), end(text.data() + text.size()) {}
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool lit(const char* t, size_t n) {
+    if ((size_t)(end - p) < n || memcmp(p, t, n) != 0) return false;
+    p += n;
+    return true;
+  }
+  JPtr parse() {
+    ws();
+    JPtr v = value();
+    ws();
+    if (p != end) ok = false;
+    return ok ? v : nullptr;
+  }
+  JPtr value() {
+    ws();
+    if (p >= end) { ok = false; return nullptr; }
+    switch (*p) {
+      case '{': return object();
+      case '[': return array();
+      case '"': return string_();
+      case 't':
+        if (lit("true", 4)) {
+          auto v = std::make_shared<JValue>();
+          v->type = JValue::Bool; v->b = true; return v;
+        }
+        ok = false; return nullptr;
+      case 'f':
+        if (lit("false", 5)) {
+          auto v = std::make_shared<JValue>();
+          v->type = JValue::Bool; v->b = false; return v;
+        }
+        ok = false; return nullptr;
+      case 'n':
+        if (lit("null", 4)) return std::make_shared<JValue>();
+        ok = false; return nullptr;
+      default: return number();
+    }
+  }
+  JPtr object() {
+    ++p;  // {
+    auto v = jobj();
+    ws();
+    if (p < end && *p == '}') { ++p; return v; }
+    while (p < end) {
+      ws();
+      if (p >= end || *p != '"') { ok = false; return nullptr; }
+      JPtr k = string_();
+      if (!ok) return nullptr;
+      ws();
+      if (p >= end || *p != ':') { ok = false; return nullptr; }
+      ++p;
+      JPtr val = value();
+      if (!ok) return nullptr;
+      v->obj.emplace_back(std::move(k->s), std::move(val));
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == '}') { ++p; return v; }
+      ok = false; return nullptr;
+    }
+    ok = false; return nullptr;
+  }
+  JPtr array() {
+    ++p;  // [
+    auto v = std::make_shared<JValue>();
+    v->type = JValue::Arr;
+    ws();
+    if (p < end && *p == ']') { ++p; return v; }
+    while (p < end) {
+      JPtr e = value();
+      if (!ok) return nullptr;
+      v->arr.push_back(std::move(e));
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == ']') { ++p; return v; }
+      ok = false; return nullptr;
+    }
+    ok = false; return nullptr;
+  }
+  JPtr string_() {
+    ++p;  // opening quote
+    auto v = std::make_shared<JValue>();
+    v->type = JValue::Str;
+    std::string& out = v->s;
+    while (p < end) {
+      unsigned char c = (unsigned char)*p;
+      if (c == '"') { ++p; return v; }
+      if (c == '\\') {
+        ++p;
+        if (p >= end) break;
+        switch (*p) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (end - p < 5) { ok = false; return nullptr; }
+            unsigned cp = 0;
+            for (int i = 1; i <= 4; i++) {
+              char h = p[i];
+              cp <<= 4;
+              if (h >= '0' && h <= '9') cp |= h - '0';
+              else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+              else { ok = false; return nullptr; }
+            }
+            p += 4;
+            // UTF-8 encode (BMP only; surrogate pairs are out of scope
+            // for API object names).
+            if (cp < 0x80) out += (char)cp;
+            else if (cp < 0x800) {
+              out += (char)(0xC0 | (cp >> 6));
+              out += (char)(0x80 | (cp & 0x3F));
+            } else {
+              out += (char)(0xE0 | (cp >> 12));
+              out += (char)(0x80 | ((cp >> 6) & 0x3F));
+              out += (char)(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: ok = false; return nullptr;
+        }
+        ++p;
+      } else {
+        out += (char)c;
+        ++p;
+      }
+    }
+    ok = false; return nullptr;
+  }
+  JPtr number() {
+    const char* start = p;
+    if (p < end && (*p == '-' || *p == '+')) ++p;
+    bool any = false;
+    while (p < end && (isdigit((unsigned char)*p) || *p == '.' ||
+                       *p == 'e' || *p == 'E' || *p == '-' || *p == '+')) {
+      any = true; ++p;
+    }
+    if (!any) { ok = false; return nullptr; }
+    auto v = std::make_shared<JValue>();
+    v->type = JValue::Num;
+    v->s.assign(start, p - start);
+    return v;
+  }
+};
+
+static void jescape(const std::string& in, std::string& out) {
+  for (unsigned char c : in) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += (char)c;
+        }
+    }
+  }
+}
+
+static void jdump(const JValue& v, std::string& out) {
+  switch (v.type) {
+    case JValue::Null: out += "null"; break;
+    case JValue::Bool: out += v.b ? "true" : "false"; break;
+    case JValue::Num: out += v.s; break;
+    case JValue::Str:
+      out += '"';
+      jescape(v.s, out);
+      out += '"';
+      break;
+    case JValue::Arr: {
+      out += '[';
+      for (size_t i = 0; i < v.arr.size(); i++) {
+        if (i) out += ',';
+        jdump(*v.arr[i], out);
+      }
+      out += ']';
+      break;
+    }
+    case JValue::Obj: {
+      out += '{';
+      for (size_t i = 0; i < v.obj.size(); i++) {
+        if (i) out += ',';
+        out += '"';
+        jescape(v.obj[i].first, out);
+        out += "\":";
+        jdump(*v.obj[i].second, out);
+      }
+      out += '}';
+      break;
+    }
+  }
+}
+
+static std::string jdumps(const JValue& v) {
+  std::string out;
+  out.reserve(256);
+  jdump(v, out);
+  return out;
+}
+
+// ---------------------------------------------------------- validation --
+// The scheduler-relevant basics of apiserver/validation.py: object names,
+// pods need containers, resource quantities must parse
+// (api/quantity.py's syntax: plain/milli/binary-suffixed decimals).
+
+static bool valid_name(const std::string& n) {
+  if (n.empty() || n.size() > 253) return false;
+  for (unsigned char c : n)
+    if (!(islower(c) || isdigit(c) || c == '-' || c == '.')) return false;
+  return true;
+}
+
+static bool quantity_ok(const std::string& q) {
+  if (q.empty()) return false;
+  size_t i = 0;
+  if (q[0] == '-' || q[0] == '+') i = 1;
+  size_t digits = 0, dots = 0;
+  while (i < q.size() && (isdigit((unsigned char)q[i]) || q[i] == '.')) {
+    if (q[i] == '.') dots++;
+    else digits++;
+    i++;
+  }
+  if (!digits || dots > 1) return false;
+  std::string suffix = q.substr(i);
+  static const std::set<std::string> kSuffixes = {
+      "",  "m",  "k",  "K",  "M",  "G",  "T",  "P",  "E",
+      "Ki", "Mi", "Gi", "Ti", "Pi", "Ei"};
+  if (kSuffixes.count(suffix)) return true;
+  // scientific notation: e/E followed by int
+  if ((suffix[0] == 'e' || suffix[0] == 'E') && suffix.size() > 1) {
+    size_t j = 1;
+    if (suffix[j] == '-' || suffix[j] == '+') j++;
+    if (j >= suffix.size()) return false;
+    for (; j < suffix.size(); j++)
+      if (!isdigit((unsigned char)suffix[j])) return false;
+    return true;
+  }
+  return false;
+}
+
+static void validate_resources(const JPtr& holder,
+                               const std::string& where,
+                               std::vector<std::string>& reasons) {
+  if (!holder || holder->type != JValue::Obj) return;
+  auto res = holder->get("resources");
+  if (!res) return;
+  for (const char* fam : {"requests", "limits"}) {
+    auto m = res->get(fam);
+    if (!m || m->type != JValue::Obj) continue;
+    for (auto& kv : m->obj) {
+      if (kv.second->type != JValue::Str &&
+          kv.second->type != JValue::Num) continue;
+      const std::string& q = kv.second->s;
+      if (!quantity_ok(q))
+        reasons.push_back(where + ".resources." + fam + "." + kv.first +
+                          ": unparseable quantity '" + q + "'");
+      else if (q[0] == '-')
+        reasons.push_back(where + ".resources." + fam + "." + kv.first +
+                          ": must be non-negative");
+    }
+  }
+}
+
+static std::vector<std::string> validate(const std::string& kind,
+                                         const JValue& body) {
+  std::vector<std::string> reasons;
+  auto meta = body.get("metadata");
+  std::string name = meta ? meta->str_or("name", "") : "";
+  if (name.empty())
+    reasons.push_back("metadata.name: required");
+  else if (!valid_name(name))
+    reasons.push_back("metadata.name: invalid characters (DNS-1123)");
+  if (kind == "pods") {
+    auto spec = body.get("spec");
+    auto containers = spec ? spec->get("containers") : nullptr;
+    if (!containers || containers->type != JValue::Arr ||
+        containers->arr.empty()) {
+      reasons.push_back("spec.containers: at least one container required");
+    } else {
+      for (size_t i = 0; i < containers->arr.size(); i++) {
+        auto& c = containers->arr[i];
+        std::string cname = c->str_or("name", "");
+        std::string where = "containers[" + std::to_string(i) + "]";
+        if (cname.empty()) reasons.push_back(where + ".name: required");
+        validate_resources(c, where, reasons);
+      }
+    }
+  }
+  if (kind == "nodes") {
+    auto status = body.get("status");
+    auto alloc = status ? status->get("allocatable") : nullptr;
+    if (alloc && alloc->type == JValue::Obj) {
+      for (auto& kv : alloc->obj) {
+        const std::string& q = kv.second->s;
+        if ((kv.second->type == JValue::Str ||
+             kv.second->type == JValue::Num) && !quantity_ok(q))
+          reasons.push_back("status.allocatable." + kv.first +
+                            ": unparseable quantity '" + q + "'");
+      }
+    }
+    auto conds = status ? status->get("conditions") : nullptr;
+    if (conds && conds->type == JValue::Arr) {
+      for (auto& c : conds->arr) {
+        if (c->str_or("type", "").empty())
+          reasons.push_back("status.conditions: type: required");
+        std::string st = c->str_or("status", "");
+        if (st != "True" && st != "False" && st != "Unknown")
+          reasons.push_back("status.conditions[" + c->str_or("type", "") +
+                            "].status: must be True/False/Unknown");
+      }
+    }
+  }
+  return reasons;
+}
+
+// --------------------------------------------------------------- store --
+static const std::set<std::string> kNamespaced = {
+    "pods", "services", "persistentvolumeclaims", "replicationcontrollers",
+    "replicasets", "endpoints", "events", "deployments", "limitranges",
+    "resourcequotas"};
+
+struct StoredEvent {
+  uint64_t rv;
+  std::string kind;
+  std::shared_ptr<std::string> line;  // NDJSON wire form, shared by streams
+};
+
+struct Conn;  // fwd
+
+struct Store {
+  std::unordered_map<std::string, std::map<std::string, JPtr>> objects;
+  uint64_t rv = 0;
+  std::deque<StoredEvent> window;  // WATCH_WINDOW ring
+  static constexpr size_t kWindow = 1024;
+  std::vector<Conn*> watchers;  // flat: filtered per-event by kind
+
+  std::string object_key(const JValue& obj) const {
+    auto meta = obj.get("metadata");
+    std::string ns = meta ? meta->str_or("namespace", "") : "";
+    std::string name = meta ? meta->str_or("name", "") : "";
+    return ns.empty() ? name : ns + "/" + name;
+  }
+
+  void emit(const char* etype, const std::string& kind,
+            const JPtr& obj);
+
+  // returns error string or "" on success
+  std::string create(const std::string& kind, const JPtr& obj) {
+    std::string key = object_key(*obj);
+    auto& bucket = objects[kind];
+    if (bucket.count(key)) return kind + " " + key + " already exists";
+    auto meta = obj->get("metadata");
+    if (!meta) obj->set("metadata", (meta = jobj()));
+    if (!meta->get("generation")) {
+      auto g = std::make_shared<JValue>();
+      g->type = JValue::Num;
+      g->s = "1";
+      meta->set("generation", g);
+    }
+    bucket[key] = obj;
+    emit("ADDED", kind, obj);
+    return "";
+  }
+
+  std::string update(const std::string& kind, const JPtr& obj,
+                     const std::string& expected_rv, bool* not_found) {
+    std::string key = object_key(*obj);
+    auto& bucket = objects[kind];
+    auto it = bucket.find(key);
+    if (it == bucket.end()) {
+      *not_found = true;
+      return "'" + kind + " " + key + " not found'";
+    }
+    if (!expected_rv.empty()) {
+      auto meta = it->second->get("metadata");
+      if (!meta || meta->str_or("resourceVersion", "") != expected_rv)
+        return kind + " " + key + " resourceVersion conflict";
+    }
+    // metadata.generation increments on spec changes (PrepareForUpdate
+    // semantics): status.observedGeneration gates controller convergence.
+    auto old_meta = it->second->get("metadata");
+    long old_gen = 1;
+    if (old_meta) {
+      auto g = old_meta->get("generation");
+      if (g) old_gen = atol(g->s.c_str());
+    }
+    auto old_spec = it->second->get("spec");
+    auto new_spec = obj->get("spec");
+    bool spec_changed =
+        (old_spec ? jdumps(*old_spec) : "") !=
+        (new_spec ? jdumps(*new_spec) : "");
+    auto meta = obj->get("metadata");
+    if (!meta) obj->set("metadata", (meta = jobj()));
+    auto g = std::make_shared<JValue>();
+    g->type = JValue::Num;
+    g->s = std::to_string(spec_changed ? old_gen + 1 : old_gen);
+    meta->set("generation", g);
+    bucket[key] = obj;
+    emit("MODIFIED", kind, obj);
+    return "";
+  }
+
+  bool erase(const std::string& kind, const std::string& key) {
+    auto& bucket = objects[kind];
+    auto it = bucket.find(key);
+    if (it == bucket.end()) return false;
+    JPtr obj = it->second;
+    bucket.erase(it);
+    emit("DELETED", kind, obj);
+    return true;
+  }
+
+  // BindingREST.Create semantics (etcd.go:286-330): CAS spec.nodeName
+  // while empty.  Copy-on-write so in-flight event lines stay stable.
+  std::string bind(const std::string& ns, const std::string& pod_name,
+                   const std::string& node, int* code) {
+    std::string key = ns + "/" + pod_name;
+    auto& bucket = objects["pods"];
+    auto it = bucket.find(key);
+    if (it == bucket.end()) {
+      *code = 404;
+      return "pod " + key + " not found";
+    }
+    JPtr pod = it->second;
+    auto spec = pod->get("spec");
+    if (spec) {
+      auto nn = spec->get("nodeName");
+      if (nn && nn->type == JValue::Str && !nn->s.empty()) {
+        *code = 409;
+        return "pod " + key + " is already assigned to node " + nn->s;
+      }
+    }
+    auto np = std::make_shared<JValue>(*pod);  // shallow: shares children
+    auto nspec = spec ? std::make_shared<JValue>(*spec) : jobj();
+    nspec->set("nodeName", jstr(node));
+    np->set("spec", nspec);
+    auto meta = np->get("metadata");
+    np->set("metadata",
+            meta ? std::make_shared<JValue>(*meta) : jobj());
+    bucket[key] = np;
+    emit("MODIFIED", "pods", np);
+    *code = 201;
+    return "";
+  }
+};
+
+// --------------------------------------------------------- connections --
+struct Conn {
+  int fd;
+  std::string in;       // read buffer
+  std::string out;      // pending writes
+  bool is_watch = false;
+  std::set<std::string> watch_kinds;
+  double last_stream_write = 0;
+  bool closing = false;
+};
+
+static double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+static int g_epfd = -1;
+static Store g_store;
+static uint64_t g_requests = 0;
+
+static void conn_arm(Conn* c, bool want_write) {
+  struct epoll_event ev;
+  ev.events = EPOLLIN | (want_write ? EPOLLOUT : 0);
+  ev.data.ptr = c;
+  epoll_ctl(g_epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+static void conn_queue(Conn* c, const char* data, size_t n) {
+  // Try a direct write first (the common case empties in one syscall);
+  // spill the remainder to the out buffer and arm EPOLLOUT.
+  if (c->out.empty()) {
+    ssize_t w = ::send(c->fd, data, n, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno != EAGAIN && errno != EWOULDBLOCK) { c->closing = true; return; }
+      w = 0;
+    }
+    if ((size_t)w == n) return;
+    data += w;
+    n -= w;
+  }
+  c->out.append(data, n);
+  conn_arm(c, true);
+}
+
+static void conn_queue(Conn* c, const std::string& s) {
+  conn_queue(c, s.data(), s.size());
+}
+
+void Store::emit(const char* etype, const std::string& kind,
+                 const JPtr& obj) {
+  rv += 1;
+  auto meta = obj->get("metadata");
+  if (!meta) {
+    obj->set("metadata", (meta = jobj()));
+  }
+  meta->set("resourceVersion", jstr(std::to_string(rv)));
+  auto line = std::make_shared<std::string>();
+  line->reserve(256);
+  *line += "{\"type\":\"";
+  *line += etype;
+  *line += "\",\"object\":";
+  jdump(*obj, *line);
+  *line += "}\n";
+  window.push_back({rv, kind, line});
+  if (window.size() > kWindow) window.pop_front();
+  for (Conn* c : watchers) {
+    if (!c->is_watch || c->closing || !c->watch_kinds.count(kind)) continue;
+    // One chunk per event here; the kernel coalesces back-to-back sends,
+    // and the chunked framing is per-write anyway.
+    char hdr[16];
+    int hn = snprintf(hdr, sizeof hdr, "%zx\r\n", line->size());
+    std::string frame;
+    frame.reserve(line->size() + hn + 2);
+    frame.append(hdr, hn);
+    frame += *line;
+    frame += "\r\n";
+    conn_queue(c, frame);
+    c->last_stream_write = now_s();
+  }
+}
+
+// ------------------------------------------------------------ http i/o --
+static void send_response(Conn* c, int code, const std::string& ctype,
+                          const std::string& body) {
+  const char* status = code == 200   ? "200 OK"
+                       : code == 201 ? "201 Created"
+                       : code == 400 ? "400 Bad Request"
+                       : code == 404 ? "404 Not Found"
+                       : code == 409 ? "409 Conflict"
+                       : code == 410 ? "410 Gone"
+                       : code == 422 ? "422 Unprocessable Entity"
+                       : code == 501 ? "501 Not Implemented"
+                                     : "500 Internal Server Error";
+  std::string head = "HTTP/1.1 ";
+  head += status;
+  head += "\r\nContent-Type: ";
+  head += ctype;
+  head += "\r\nContent-Length: ";
+  head += std::to_string(body.size());
+  head += "\r\n\r\n";
+  head += body;
+  conn_queue(c, head);
+}
+
+static void send_json(Conn* c, int code, const std::string& body) {
+  send_response(c, code, "application/json", body);
+}
+
+static void send_error(Conn* c, int code, const std::string& msg) {
+  JValue e;
+  e.type = JValue::Obj;
+  e.set("error", jstr(msg));
+  send_json(c, code, jdumps(e));
+}
+
+// ----------------------------------------------------------- handlers --
+static std::vector<std::string> split_path(const std::string& path) {
+  std::vector<std::string> parts;
+  size_t i = 0;
+  while (i < path.size()) {
+    while (i < path.size() && path[i] == '/') i++;
+    size_t j = i;
+    while (j < path.size() && path[j] != '/') j++;
+    if (j > i) parts.push_back(path.substr(i, j - i));
+    i = j;
+  }
+  return parts;
+}
+
+static std::map<std::string, std::string> split_query(const std::string& q) {
+  std::map<std::string, std::string> out;
+  size_t i = 0;
+  while (i < q.size()) {
+    size_t amp = q.find('&', i);
+    if (amp == std::string::npos) amp = q.size();
+    size_t eq = q.find('=', i);
+    if (eq != std::string::npos && eq < amp)
+      out[q.substr(i, eq - i)] = q.substr(eq + 1, amp - eq - 1);
+    else
+      out[q.substr(i, amp - i)] = "";
+    i = amp + 1;
+  }
+  return out;
+}
+
+static void handle_list(Conn* c, const std::string& kind) {
+  std::string body = "{\"kind\":\"";
+  body += (char)toupper(kind[0]);
+  body += kind.substr(1);
+  body += "List\",\"items\":[";
+  auto it = g_store.objects.find(kind);
+  bool first = true;
+  if (it != g_store.objects.end()) {
+    for (auto& kv : it->second) {
+      if (!first) body += ',';
+      first = false;
+      jdump(*kv.second, body);
+    }
+  }
+  body += "],\"metadata\":{\"resourceVersion\":\"";
+  body += std::to_string(g_store.rv);
+  body += "\"}}";
+  send_json(c, 200, body);
+}
+
+static void handle_watch(Conn* c, const std::string& kind, uint64_t from) {
+  // Too-old check mirrors memstore.watch: the requested rv must still be
+  // inside (or adjacent to) the buffered window.
+  if (!g_store.window.empty() && from + 1 < g_store.window.front().rv &&
+      from < g_store.rv - g_store.window.size()) {
+    send_error(c, 410, "too old resource version");
+    return;
+  }
+  conn_queue(c,
+             "HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+             "Transfer-Encoding: chunked\r\n\r\n");
+  c->is_watch = true;
+  c->watch_kinds.insert(kind);
+  c->last_stream_write = now_s();
+  g_store.watchers.push_back(c);
+  // Replay buffered events after `from`.
+  std::string frame;
+  for (auto& ev : g_store.window) {
+    if (ev.rv <= from || ev.kind != kind) continue;
+    char hdr[16];
+    int hn = snprintf(hdr, sizeof hdr, "%zx\r\n", ev.line->size());
+    frame.append(hdr, hn);
+    frame += *ev.line;
+    frame += "\r\n";
+  }
+  if (!frame.empty()) conn_queue(c, frame);
+}
+
+static void do_create_one(Conn* c, const std::string& kind, JPtr body) {
+  if (kNamespaced.count(kind)) {
+    auto meta = body->get("metadata");
+    if (!meta || meta->type != JValue::Obj)
+      body->set("metadata", (meta = jobj()));
+    if (meta->str_or("namespace", "").empty())
+      meta->set("namespace", jstr("default"));
+  }
+  auto reasons = validate(kind, *body);
+  if (!reasons.empty()) {
+    JValue e;
+    e.type = JValue::Obj;
+    e.set("error", jstr("validation failed"));
+    auto arr = std::make_shared<JValue>();
+    arr->type = JValue::Arr;
+    for (auto& r : reasons) arr->arr.push_back(jstr(r));
+    e.set("reasons", arr);
+    send_json(c, 422, jdumps(e));
+    return;
+  }
+  std::string err = g_store.create(kind, body);
+  if (!err.empty()) {
+    send_error(c, 409, err);
+    return;
+  }
+  send_json(c, 201, jdumps(*body));
+}
+
+static void do_create_list(Conn* c, const std::string& kind,
+                           const JPtr& items) {
+  std::string body = "{\"kind\":\"CreateListResult\",\"created\":";
+  std::string results;
+  int created = 0;
+  for (auto& it : items->arr) {
+    if (it->type != JValue::Obj) {
+      results += "{\"code\":400,\"error\":\"not an object\"},";
+      continue;
+    }
+    if (kNamespaced.count(kind)) {
+      auto meta = it->get("metadata");
+      if (!meta || meta->type != JValue::Obj) it->set("metadata", (meta = jobj()));
+      if (meta->str_or("namespace", "").empty())
+        meta->set("namespace", jstr("default"));
+    }
+    auto reasons = validate(kind, *it);
+    if (!reasons.empty()) {
+      JValue e;
+      e.type = JValue::Obj;
+      e.obj.emplace_back("code", [] {
+        auto v = std::make_shared<JValue>();
+        v->type = JValue::Num; v->s = "422"; return v;
+      }());
+      e.set("error", jstr("validation failed"));
+      auto arr = std::make_shared<JValue>();
+      arr->type = JValue::Arr;
+      for (auto& r : reasons) arr->arr.push_back(jstr(r));
+      e.set("reasons", arr);
+      results += jdumps(e);
+      results += ',';
+      continue;
+    }
+    std::string err = g_store.create(kind, it);
+    if (!err.empty()) {
+      JValue e;
+      e.type = JValue::Obj;
+      auto code = std::make_shared<JValue>();
+      code->type = JValue::Num; code->s = "409";
+      e.obj.emplace_back("code", code);
+      e.set("error", jstr(err));
+      results += jdumps(e);
+      results += ',';
+      continue;
+    }
+    created++;
+    auto meta = it->get("metadata");
+    results += "{\"code\":201,\"resourceVersion\":\"";
+    results += meta ? meta->str_or("resourceVersion", "") : "";
+    results += "\"},";
+  }
+  if (!results.empty()) results.pop_back();
+  body += std::to_string(created);
+  body += ",\"results\":[";
+  body += results;
+  body += "]}";
+  send_json(c, 200, body);
+}
+
+static void do_bind_list(Conn* c, const std::string& default_ns,
+                         const JPtr& items) {
+  std::string results;
+  int failed = 0;
+  for (auto& it : items->arr) {
+    auto meta = it->type == JValue::Obj ? it->get("metadata") : nullptr;
+    std::string ns = meta ? meta->str_or("namespace", "") : "";
+    if (ns.empty()) ns = default_ns;
+    std::string name = meta ? meta->str_or("name", "") : "";
+    auto target = it->type == JValue::Obj ? it->get("target") : nullptr;
+    std::string node = target ? target->str_or("name", "") : "";
+    int code = 0;
+    std::string err = g_store.bind(ns, name, node, &code);
+    if (code == 201) {
+      results += "{\"code\":201},";
+    } else {
+      failed++;
+      JValue e;
+      e.type = JValue::Obj;
+      auto cv = std::make_shared<JValue>();
+      cv->type = JValue::Num;
+      cv->s = std::to_string(code);
+      e.obj.emplace_back("code", cv);
+      e.set("error", jstr(err));
+      results += jdumps(e);
+      results += ',';
+    }
+  }
+  if (!results.empty()) results.pop_back();
+  std::string body = "{\"kind\":\"BindingListResult\",\"failed\":";
+  body += std::to_string(failed);
+  body += ",\"results\":[";
+  body += results;
+  body += "]}";
+  send_json(c, 200, body);
+}
+
+// Returns false when the connection was taken over by a watch stream.
+static bool dispatch(Conn* c, const std::string& method,
+                     const std::string& target, const std::string& raw) {
+  g_requests++;
+  std::string path = target, query;
+  size_t q = target.find('?');
+  if (q != std::string::npos) {
+    path = target.substr(0, q);
+    query = target.substr(q + 1);
+  }
+  auto parts = split_path(path);
+  auto params = split_query(query);
+
+  if (method == "GET") {
+    if (parts.size() == 1 && parts[0] == "healthz") {
+      send_response(c, 200, "text/plain", "ok");
+      return true;
+    }
+    if (parts.size() == 1 && parts[0] == "metrics") {
+      std::string m = "# TYPE apiserver_request_count counter\n"
+                      "apiserver_request_count " +
+                      std::to_string(g_requests) + "\n";
+      send_response(c, 200, "text/plain", m);
+      return true;
+    }
+    if (parts.size() == 3 && parts[0] == "api" && parts[1] == "v1") {
+      const std::string& kind = parts[2];
+      auto w = params.find("watch");
+      if (w != params.end() && (w->second == "1" || w->second == "true")) {
+        uint64_t from = strtoull(params["resourceVersion"].c_str(),
+                                 nullptr, 10);
+        handle_watch(c, kind, from);
+        return !c->is_watch ? true : false;
+      }
+      handle_list(c, kind);
+      return true;
+    }
+    std::string kind, key;
+    if (parts.size() == 6 && parts[2] == "namespaces") {
+      kind = parts[4];
+      key = parts[3] + "/" + parts[5];
+    } else if (parts.size() == 4 && parts[0] == "api") {
+      kind = parts[2];
+      key = parts[3];
+    } else {
+      send_error(c, 404, "unknown path");
+      return true;
+    }
+    auto bkt = g_store.objects.find(kind);
+    if (bkt != g_store.objects.end()) {
+      auto it = bkt->second.find(key);
+      if (it != bkt->second.end()) {
+        send_json(c, 200, jdumps(*it->second));
+        return true;
+      }
+    }
+    send_error(c, 404, "not found");
+    return true;
+  }
+
+  // Parse body for POST/PUT.
+  JPtr body;
+  if (!raw.empty()) {
+    JParser jp(raw);
+    body = jp.parse();
+    if (!body) {
+      send_error(c, 400, "bad json");
+      return true;
+    }
+    if (body->type != JValue::Obj) {
+      send_error(c, 400, "body must be an object");
+      return true;
+    }
+    auto meta = body->get("metadata");
+    if (meta && meta->type == JValue::Null)
+      body->set("metadata", jobj());
+  } else {
+    body = jobj();
+  }
+
+  if (method == "POST") {
+    if (parts.size() == 5 && parts[2] == "namespaces" &&
+        parts[4] == "bindings") {
+      auto items = body->get("items");
+      if (items && items->type == JValue::Arr) {
+        do_bind_list(c, parts[3], items);
+        return true;
+      }
+      auto meta = body->get("metadata");
+      std::string name = meta ? meta->str_or("name", "") : "";
+      auto tgt = body->get("target");
+      std::string node = tgt ? tgt->str_or("name", "") : "";
+      int code = 0;
+      std::string err = g_store.bind(parts[3], name, node, &code);
+      if (code == 201)
+        send_json(c, 201, "{\"status\":\"Success\"}");
+      else
+        send_error(c, code, err);
+      return true;
+    }
+    if (parts.size() == 3 && parts[0] == "api" && parts[1] == "v1") {
+      auto items = body->get("items");
+      if (items && items->type == JValue::Arr)
+        do_create_list(c, parts[2], items);
+      else
+        do_create_one(c, parts[2], body);
+      return true;
+    }
+    send_error(c, 404, "unknown path");
+    return true;
+  }
+
+  if (method == "PUT") {
+    std::string kind;
+    if (parts.size() == 6 && parts[2] == "namespaces") {
+      kind = parts[4];
+      auto meta = body->get("metadata");
+      if (!meta || meta->type != JValue::Obj)
+        body->set("metadata", (meta = jobj()));
+      if (meta->str_or("namespace", "").empty())
+        meta->set("namespace", jstr(parts[3]));
+    } else if (parts.size() == 4 && parts[0] == "api") {
+      kind = parts[2];
+    } else {
+      send_error(c, 404, "unknown path");
+      return true;
+    }
+    auto reasons = validate(kind, *body);
+    if (!reasons.empty()) {
+      JValue e;
+      e.type = JValue::Obj;
+      e.set("error", jstr("validation failed"));
+      auto arr = std::make_shared<JValue>();
+      arr->type = JValue::Arr;
+      for (auto& r : reasons) arr->arr.push_back(jstr(r));
+      e.set("reasons", arr);
+      send_json(c, 422, jdumps(e));
+      return true;
+    }
+    auto meta = body->get("metadata");
+    std::string expect = meta ? meta->str_or("resourceVersion", "") : "";
+    bool not_found = false;
+    std::string err = g_store.update(kind, body, expect, &not_found);
+    if (!err.empty()) {
+      send_error(c, not_found ? 404 : 409, err);
+      return true;
+    }
+    send_json(c, 200, jdumps(*body));
+    return true;
+  }
+
+  if (method == "DELETE") {
+    std::string kind, key;
+    if (parts.size() == 6 && parts[2] == "namespaces") {
+      kind = parts[4];
+      key = parts[3] + "/" + parts[5];
+    } else if (parts.size() == 4 && parts[0] == "api") {
+      kind = parts[2];
+      key = parts[3];
+    } else {
+      send_error(c, 404, "unknown path");
+      return true;
+    }
+    if (!g_store.erase(kind, key)) {
+      send_error(c, 404, "'" + kind + " " + key + " not found'");
+      return true;
+    }
+    send_json(c, 200, "{\"status\":\"Success\"}");
+    return true;
+  }
+
+  send_error(c, 404, "unknown method");
+  return true;
+}
+
+// Process as many complete requests as the read buffer holds.
+// Returns false to close the connection.
+static bool process_input(Conn* c) {
+  while (true) {
+    size_t hdr_end = c->in.find("\r\n\r\n");
+    if (hdr_end == std::string::npos) {
+      if (c->in.size() > 1 << 20) return false;  // header flood
+      return true;
+    }
+    // Request line.
+    size_t line_end = c->in.find("\r\n");
+    std::string reqline = c->in.substr(0, line_end);
+    size_t sp1 = reqline.find(' ');
+    size_t sp2 = reqline.find(' ', sp1 + 1);
+    if (sp1 == std::string::npos || sp2 == std::string::npos) return false;
+    std::string method = reqline.substr(0, sp1);
+    std::string target = reqline.substr(sp1 + 1, sp2 - sp1 - 1);
+    // Headers: Content-Length only; chunked is rejected like the Python
+    // loop (a silently dropped body would misparse as the next request).
+    size_t clen = 0;
+    bool chunked = false;
+    size_t pos = line_end + 2;
+    while (pos < hdr_end) {
+      size_t eol = c->in.find("\r\n", pos);
+      if (eol == std::string::npos || eol > hdr_end) eol = hdr_end;
+      if (eol - pos >= 15) {
+        std::string lower;
+        lower.reserve(20);
+        for (size_t i = pos; i < pos + 18 && i < eol; i++)
+          lower += (char)tolower((unsigned char)c->in[i]);
+        if (lower.rfind("content-length:", 0) == 0)
+          clen = strtoull(c->in.c_str() + pos + 15, nullptr, 10);
+        else if (lower.rfind("transfer-encoding:", 0) == 0)
+          chunked = true;
+      }
+      pos = eol + 2;
+    }
+    if (chunked) {
+      send_error(c, 501, "chunked requests unsupported");
+      return false;
+    }
+    if (clen > (64u << 20)) return false;
+    size_t body_start = hdr_end + 4;
+    if (c->in.size() < body_start + clen) return true;  // need more bytes
+    std::string raw = c->in.substr(body_start, clen);
+    c->in.erase(0, body_start + clen);
+    bool keep = dispatch(c, method, target, raw);
+    if (!keep) return true;  // watch stream: stop parsing, stay open
+    if (c->closing) return false;
+  }
+}
+
+int main(int argc, char** argv) {
+  int port = 8080;
+  const char* host = "127.0.0.1";
+  for (int i = 1; i < argc - 1; i++) {
+    if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
+    if (!strcmp(argv[i], "--host")) host = argv[i + 1];
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  int lfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  if (bind(lfd, (struct sockaddr*)&addr, sizeof addr) < 0) {
+    perror("bind");
+    return 1;
+  }
+  listen(lfd, 128);
+  socklen_t alen = sizeof addr;
+  getsockname(lfd, (struct sockaddr*)&addr, &alen);
+  fprintf(stderr, "apiserver-native listening on %s:%d\n", host,
+          ntohs(addr.sin_port));
+
+  g_epfd = epoll_create1(0);
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.ptr = nullptr;  // listener marker
+  epoll_ctl(g_epfd, EPOLL_CTL_ADD, lfd, &ev);
+
+  std::vector<Conn*> dead;
+  struct epoll_event events[128];
+  double last_hb_check = now_s();
+  while (true) {
+    int n = epoll_wait(g_epfd, events, 128, 500);
+    for (int i = 0; i < n; i++) {
+      if (events[i].data.ptr == nullptr) {
+        while (true) {
+          int fd = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
+          if (fd < 0) break;
+          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+          Conn* c = new Conn();
+          c->fd = fd;
+          struct epoll_event cev;
+          cev.events = EPOLLIN;
+          cev.data.ptr = c;
+          epoll_ctl(g_epfd, EPOLL_CTL_ADD, fd, &cev);
+        }
+        continue;
+      }
+      Conn* c = (Conn*)events[i].data.ptr;
+      bool close_it = false;
+      if (events[i].events & (EPOLLHUP | EPOLLERR)) close_it = true;
+      if (!close_it && (events[i].events & EPOLLIN)) {
+        char buf[65536];
+        while (true) {
+          ssize_t r = ::recv(c->fd, buf, sizeof buf, 0);
+          if (r > 0) {
+            c->in.append(buf, r);
+            if (c->in.size() > (80u << 20)) { close_it = true; break; }
+            continue;
+          }
+          if (r == 0) { close_it = true; }
+          else if (errno != EAGAIN && errno != EWOULDBLOCK) close_it = true;
+          break;
+        }
+        if (!close_it && !c->is_watch) {
+          if (!process_input(c)) close_it = true;
+        }
+      }
+      if (!close_it && (events[i].events & EPOLLOUT)) {
+        while (!c->out.empty()) {
+          ssize_t w = ::send(c->fd, c->out.data(), c->out.size(),
+                             MSG_NOSIGNAL);
+          if (w > 0) {
+            c->out.erase(0, w);
+            continue;
+          }
+          if (errno != EAGAIN && errno != EWOULDBLOCK) close_it = true;
+          break;
+        }
+        if (c->out.empty() && !close_it) conn_arm(c, false);
+      }
+      if (close_it || c->closing) {
+        epoll_ctl(g_epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+        close(c->fd);
+        if (c->is_watch) {
+          auto& ws = g_store.watchers;
+          ws.erase(std::remove(ws.begin(), ws.end(), c), ws.end());
+        }
+        delete c;
+      }
+    }
+    // Watch heartbeats: a blank chunk every ~10 s of stream idleness so
+    // client read deadlines only fire on dead sockets.
+    double t = now_s();
+    if (t - last_hb_check >= 1.0) {
+      last_hb_check = t;
+      for (Conn* c : g_store.watchers) {
+        if (c->closing) continue;
+        if (t - c->last_stream_write >= 10.0) {
+          conn_queue(c, "1\r\n\n\r\n");
+          c->last_stream_write = t;
+        }
+      }
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,110 @@
+"""Controller-manager leader election: two replicas must never both act
+(controllermanager.go:171-189 wraps every loop in leaderelection.RunOrDie).
+
+Two elector-gated replication managers race for the
+kube-system/kube-controller-manager lease over the HTTP apiserver; only
+the leader's loops run, an RC of 3 yields exactly 3 pods (split-brain
+would mint 6), and killing the leader hands over within the lease."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.apiserver.server import serve
+from kubernetes_tpu.client.http import APIClient
+from kubernetes_tpu.controller.replication import ReplicationManager
+from kubernetes_tpu.utils.leaderelection import (APIResourceLock,
+                                                 LeaderElector)
+
+
+class _Replica:
+    """One controller-manager candidate: elector-gated loops, the shape
+    controller/__main__.py runs."""
+
+    def __init__(self, base: str, identity: str):
+        self.identity = identity
+        self.base = base
+        self.controllers: list = []
+        self.lost = threading.Event()
+        self.elector = LeaderElector(
+            lock=APIResourceLock(APIClient(base, qps=0),
+                                 name="kube-controller-manager"),
+            identity=identity,
+            lease_duration=1.5, renew_deadline=1.0, retry_period=0.25,
+            on_started_leading=self._start,
+            on_stopped_leading=self.lost.set)
+
+    def _start(self) -> None:
+        self.controllers.append(
+            ReplicationManager(self.base, sync_period=0.2).run())
+
+    def run(self):
+        self.elector.run()
+        return self
+
+    def is_leader(self) -> bool:
+        return self.elector.is_leader() and bool(self.controllers)
+
+    def kill(self) -> None:
+        self.elector.stop()
+        for c in self.controllers:
+            c.stop()
+
+
+def _wait(cond, timeout=30.0, msg=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_two_controller_managers_single_actor_and_failover():
+    store = MemStore()
+    server = serve(store)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    a = _Replica(base, "cm-a").run()
+    b = _Replica(base, "cm-b").run()
+    try:
+        _wait(lambda: a.is_leader() or b.is_leader(), msg="a leader")
+        leader, standby = (a, b) if a.is_leader() else (b, a)
+        assert not standby.controllers, \
+            "standby started its loops without the lease"
+
+        store.create("replicationcontrollers", {
+            "metadata": {"name": "ha-rc", "namespace": "default"},
+            "spec": {"replicas": 3, "selector": {"run": "ha-rc"},
+                     "template": {"metadata": {"labels": {"run": "ha-rc"}},
+                                  "spec": {"containers": [{"name": "c"}]}}}})
+
+        def pods():
+            items, _ = store.list("pods")
+            return [o for o in items
+                    if ((o.get("metadata") or {}).get("labels") or {})
+                    .get("run") == "ha-rc"]
+        _wait(lambda: len(pods()) == 3, msg="3 replicas")
+        # Several sync periods with BOTH candidates alive: still exactly 3.
+        time.sleep(1.5)
+        assert len(pods()) == 3, \
+            f"split-brain: {len(pods())} replicas from two managers"
+
+        # Kill the leader: the standby must take over within ~the lease
+        # and keep reconciling (delete a pod -> it is replaced).
+        leader.kill()
+        _wait(standby.is_leader, timeout=10,
+              msg="standby acquired the lease")
+        victim = pods()[0]["metadata"]["name"]
+        store.delete("pods", f"default/{victim}")
+        _wait(lambda: len(pods()) == 3 and victim not in
+              [p["metadata"]["name"] for p in pods()],
+              msg="standby's manager replaced the deleted replica")
+        time.sleep(1.0)
+        assert len(pods()) == 3
+    finally:
+        a.kill()
+        b.kill()
+        server.shutdown()

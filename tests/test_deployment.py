@@ -1,0 +1,283 @@
+"""Deployment controller e2e: rolling update, rollback, recreate, scale,
+and the kubectl rollout surface — the reference's flagship workload story
+(pkg/controller/deployment/deployment_controller.go:537, rolling.go,
+rollback.go) over the in-process control plane."""
+
+from __future__ import annotations
+
+import io
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.controller.deployment import (DeploymentController,
+                                                  HASH_LABEL, REVISION_ANN,
+                                                  template_hash)
+from kubernetes_tpu.controller.replication import ReplicationManager
+from kubernetes_tpu.kubelet.kubelet import HollowKubelet
+from kubernetes_tpu.scheduler.factory import ConfigFactory
+
+
+def _node(name: str) -> api.Node:
+    return api.Node(
+        name=name, labels={api.HOSTNAME_LABEL: name},
+        allocatable_milli_cpu=16000,
+        allocatable_memory=64 * 1024 ** 3, allocatable_pods=110,
+        conditions=[api.NodeCondition("Ready", "True")])
+
+
+def _wait(cond, timeout=40.0, period=0.1, msg=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(period)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def plane():
+    store = MemStore()
+    kubelets = [HollowKubelet(store, _node(f"dk-{i}"),
+                              heartbeat_period=0.5).run() for i in range(2)]
+    scheduler = ConfigFactory(store).run()
+    rm = ReplicationManager(store, sync_period=0.15).run()
+    dc = DeploymentController(store, sync_period=0.15).run()
+    yield store
+    dc.stop()
+    rm.stop()
+    scheduler.stop()
+    for k in kubelets:
+        k.stop()
+
+
+def _deployment(name: str, replicas: int = 3, image: str = "v1",
+                strategy: dict | None = None) -> dict:
+    return {"metadata": {"name": name, "namespace": "default"},
+            "spec": {
+                "replicas": replicas,
+                "selector": {"matchLabels": {"app": name}},
+                "strategy": strategy or {
+                    "type": "RollingUpdate",
+                    "rollingUpdate": {"maxSurge": 1, "maxUnavailable": 1}},
+                "template": {
+                    "metadata": {"labels": {"app": name,
+                                            "version": image}},
+                    "spec": {"containers": [{
+                        "name": "app", "image": image,
+                        "resources": {"requests": {"cpu": "100m"}}}]}}}}
+
+
+def _pods_of(store, app: str) -> list[dict]:
+    items, _ = store.list("pods")
+    return [o for o in items
+            if ((o.get("metadata") or {}).get("labels") or {})
+            .get("app") == app
+            and not (o.get("metadata") or {}).get("deletionTimestamp")]
+
+
+def _rss_of(store, app: str) -> list[dict]:
+    items, _ = store.list("replicasets")
+    return [o for o in items
+            if ((o.get("metadata") or {}).get("labels") or {})
+            .get("app") == app]
+
+
+def test_deployment_creates_rs_and_pods(plane):
+    store = plane
+    store.create("deployments", _deployment("web"))
+
+    def up():
+        pods = _pods_of(store, "web")
+        return len(pods) == 3 and all(
+            (p.get("status") or {}).get("phase") == "Running" for p in pods)
+    _wait(up, msg="3 replicas Running via Deployment->RS->pods")
+    rss = _rss_of(store, "web")
+    assert len(rss) == 1
+    thash = template_hash(store.get("deployments", "default/web")
+                          ["spec"]["template"])
+    assert rss[0]["metadata"]["name"] == f"web-{thash}"
+    assert rss[0]["metadata"]["labels"][HASH_LABEL] == thash
+    assert rss[0]["metadata"]["annotations"][REVISION_ANN] == "1"
+    # Replicas carry the hash label so revisions never mix.
+    for p in _pods_of(store, "web"):
+        assert p["metadata"]["labels"][HASH_LABEL] == thash
+    # Status converges.
+    _wait(lambda: (store.get("deployments", "default/web").get("status")
+                   or {}).get("availableReplicas") == 3,
+          msg="deployment status availableReplicas=3")
+
+
+def test_rolling_update_respects_bounds_and_hands_over(plane):
+    store = plane
+    store.create("deployments", _deployment("roll", replicas=4))
+    _wait(lambda: len([p for p in _pods_of(store, "roll")
+                       if (p.get("status") or {}).get("phase")
+                       == "Running"]) == 4, msg="initial 4 Running")
+    v1_hash = template_hash(store.get("deployments", "default/roll")
+                            ["spec"]["template"])
+
+    # Roll to v2.
+    dep = store.get("deployments", "default/roll")
+    dep["spec"]["template"]["metadata"]["labels"]["version"] = "v2"
+    dep["spec"]["template"]["spec"]["containers"][0]["image"] = "v2"
+    store.update("deployments", dep)
+    v2_hash = template_hash(dep["spec"]["template"])
+
+    # While the roll progresses, the RS SPEC totals must respect
+    # maxSurge: new+old <= replicas + 1 at every observed instant.
+    violations = []
+
+    def rolled():
+        rss = {((r.get("metadata") or {}).get("labels") or {})
+               .get(HASH_LABEL): r for r in _rss_of(store, "roll")}
+        total_spec = sum(int((r.get("spec") or {}).get("replicas", 0))
+                         for r in rss.values())
+        if total_spec > 4 + 1:
+            violations.append(total_spec)
+        new = rss.get(v2_hash)
+        old = rss.get(v1_hash)
+        if new is None or old is None:
+            return False
+        new_pods = [p for p in _pods_of(store, "roll")
+                    if p["metadata"]["labels"].get(HASH_LABEL) == v2_hash
+                    and (p.get("status") or {}).get("phase") == "Running"]
+        return int(new["spec"]["replicas"]) == 4 and \
+            int(old["spec"]["replicas"]) == 0 and len(new_pods) == 4
+    _wait(rolled, msg="rolling handoff v1 -> v2")
+    assert not violations, f"maxSurge violated: totals {violations}"
+    # Old RS is kept (revision history), new carries revision 2.
+    rss = {((r.get("metadata") or {}).get("labels") or {})
+           .get(HASH_LABEL): r for r in _rss_of(store, "roll")}
+    assert rss[v1_hash]["metadata"]["annotations"][REVISION_ANN] == "1"
+    assert rss[v2_hash]["metadata"]["annotations"][REVISION_ANN] == "2"
+
+
+def test_rollback(plane):
+    store = plane
+    store.create("deployments", _deployment("back", replicas=2))
+    _wait(lambda: len([p for p in _pods_of(store, "back")
+                       if (p.get("status") or {}).get("phase")
+                       == "Running"]) == 2, msg="v1 up")
+    v1_hash = template_hash(store.get("deployments", "default/back")
+                            ["spec"]["template"])
+    dep = store.get("deployments", "default/back")
+    dep["spec"]["template"]["metadata"]["labels"]["version"] = "v2"
+    store.update("deployments", dep)
+
+    def v2_done():
+        pods = _pods_of(store, "back")
+        return len(pods) == 2 and all(
+            p["metadata"]["labels"].get("version") == "v2"
+            and (p.get("status") or {}).get("phase") == "Running"
+            for p in pods)
+    _wait(v2_done, msg="v2 rolled out")
+
+    # rollbackTo revision 0 = previous revision (rollback.go:85).
+    dep = store.get("deployments", "default/back")
+    dep["spec"]["rollbackTo"] = {"revision": 0}
+    store.update("deployments", dep)
+
+    def v1_back():
+        dep2 = store.get("deployments", "default/back")
+        if (dep2["spec"].get("rollbackTo") or None) is not None:
+            return False
+        if template_hash(dep2["spec"]["template"]) != v1_hash:
+            return False
+        pods = _pods_of(store, "back")
+        return len(pods) == 2 and all(
+            p["metadata"]["labels"].get(HASH_LABEL) == v1_hash
+            and (p.get("status") or {}).get("phase") == "Running"
+            for p in pods)
+    _wait(v1_back, msg="rollback to v1")
+
+
+def test_recreate_strategy(plane):
+    store = plane
+    store.create("deployments", _deployment(
+        "rec", replicas=2, strategy={"type": "Recreate"}))
+    _wait(lambda: len([p for p in _pods_of(store, "rec")
+                       if (p.get("status") or {}).get("phase")
+                       == "Running"]) == 2, msg="v1 up")
+    dep = store.get("deployments", "default/rec")
+    dep["spec"]["template"]["metadata"]["labels"]["version"] = "v2"
+    store.update("deployments", dep)
+
+    # Recreate never runs both versions at once: sample for overlap.
+    overlap = []
+
+    def v2_done():
+        pods = [p for p in _pods_of(store, "rec")
+                if (p.get("status") or {}).get("phase") == "Running"]
+        versions = {p["metadata"]["labels"].get("version") for p in pods}
+        if versions == {"v1", "v2"}:
+            overlap.append(versions)
+        return len(pods) == 2 and versions == {"v2"}
+    _wait(v2_done, msg="recreate v2 up")
+    assert not overlap, "Recreate ran old and new replicas simultaneously"
+
+
+def test_scale_down_converges(plane):
+    """Reducing spec.replicas after a rollout shrinks the NEW ReplicaSet
+    (the rolling loop only ever shrinks old revisions)."""
+    store = plane
+    store.create("deployments", _deployment("down", replicas=5))
+    _wait(lambda: len([p for p in _pods_of(store, "down")
+                       if (p.get("status") or {}).get("phase")
+                       == "Running"]) == 5, msg="5 up")
+    dep = store.get("deployments", "default/down")
+    dep["spec"]["replicas"] = 2
+    store.update("deployments", dep)
+    _wait(lambda: len(_pods_of(store, "down")) == 2,
+          msg="scaled down to 2")
+    rss = _rss_of(store, "down")
+    assert len(rss) == 1 and int(rss[0]["spec"]["replicas"]) == 2
+
+
+def test_kubectl_scale_and_rollout(plane):
+    """kubectl scale + rollout status/history/undo over the HTTP wire."""
+    from kubernetes_tpu.apiserver.server import serve
+    from kubernetes_tpu.kubectl.__main__ import main as kubectl
+
+    store = plane
+    server = serve(store)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        store.create("deployments", _deployment("cli", replicas=2))
+        out = io.StringIO()
+        assert kubectl(["-s", base, "rollout", "status",
+                        "deployments", "cli"], out=out) == 0
+        assert "successfully rolled out" in out.getvalue()
+
+        assert kubectl(["-s", base, "scale", "deploy", "cli",
+                        "--replicas", "4"], out=io.StringIO()) == 0
+        _wait(lambda: len([p for p in _pods_of(store, "cli")
+                           if (p.get("status") or {}).get("phase")
+                           == "Running"]) == 4, msg="scaled to 4")
+
+        # Roll, then undo via kubectl; history shows both revisions.
+        dep = store.get("deployments", "default/cli")
+        dep["spec"]["template"]["metadata"]["labels"]["version"] = "v2"
+        store.update("deployments", dep)
+        out = io.StringIO()
+        assert kubectl(["-s", base, "rollout", "status", "deploy", "cli",
+                        "--timeout", "40"], out=out) == 0
+        out = io.StringIO()
+        assert kubectl(["-s", base, "rollout", "history", "deploy", "cli"],
+                       out=out) == 0
+        assert "1" in out.getvalue() and "2" in out.getvalue()
+        assert kubectl(["-s", base, "rollout", "undo", "deploy", "cli"],
+                       out=io.StringIO()) == 0
+
+        def undone():
+            pods = _pods_of(store, "cli")
+            return len(pods) == 4 and all(
+                p["metadata"]["labels"].get("version") == "v1"
+                and (p.get("status") or {}).get("phase") == "Running"
+                for p in pods)
+        _wait(undone, msg="kubectl rollout undo back to v1")
+    finally:
+        server.shutdown()
