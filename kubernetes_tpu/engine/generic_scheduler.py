@@ -361,7 +361,7 @@ class GenericScheduler:
                                      "node_dom")})
             shapes.update({f: tuple(getattr(hb.volsvc, f).shape)
                            for f in ("pd_pod_ebs", "pd_pod_gce", "vz_mask",
-                                     "sa_mask", "saa_score",
+                                     "sa_mask", "saa_cnt",
                                      "nl_prio_rows")})
             print(f"KT_STREAM compile({len(all_pods)} pods): "
                   f"{time.perf_counter() - t_c0:.3f}s flags={tuple(flags)} "

@@ -58,13 +58,11 @@ PASSTHROUGH_PREDICATES = ()
 
 STATIC_PRIORITIES = ("NodeAffinityPriority", "TaintTolerationPriority",
                      "ImageLocalityPriority", "NodePreferAvoidPodsPriority",
-                     "EqualPriority", "NodeLabelPriority",
-                     # Static-in-batch: peer counts are not yet updated by
-                     # in-batch placements (single-pod path is exact).
-                     "ServiceAntiAffinityPriority")
+                     "EqualPriority", "NodeLabelPriority")
 DYNAMIC_PRIORITIES = ("LeastRequestedPriority", "MostRequestedPriority",
                       "BalancedResourceAllocation", "SelectorSpreadPriority",
-                      "ServiceSpreadingPriority", "InterPodAffinityPriority")
+                      "ServiceSpreadingPriority", "InterPodAffinityPriority",
+                      "ServiceAntiAffinityPriority")
 PASSTHROUGH_PRIORITIES = ()
 
 # lax.scan unroll for the sequential solve: measured on v5e at 30k x 5k,
@@ -116,7 +114,11 @@ class DeviceVolSvc(NamedTuple):
     sa_group: jnp.ndarray
     sa_mask: jnp.ndarray
     saa_group: jnp.ndarray
-    saa_score: jnp.ndarray
+    saa_src: jnp.ndarray
+    saa_dom: jnp.ndarray
+    saa_labeled: jnp.ndarray
+    saa_cnt: jnp.ndarray
+    saa_num: jnp.ndarray
     nl_pred_row: jnp.ndarray
     nl_prio_rows: jnp.ndarray
 
@@ -168,6 +170,7 @@ class BatchFlags(NamedTuple):
     any_affinity_prio: bool   # pref_w/sym content
     any_spread: bool          # spread_incr content (placements move counts)
     any_spread_zones: bool    # some spread group blends zone counts
+    any_saa: bool             # saa_src content (placements move peer counts)
 
 
 def batch_flags(b) -> BatchFlags:
@@ -195,10 +198,11 @@ def batch_flags(b) -> BatchFlags:
         # the fast schedule and costs ~5% per step.
         any_spread=True,
         any_spread_zones=bool(np.asarray(b.spread_has_zones).any()
-                              or np.asarray(b.spread_zone_counts).any()))
+                              or np.asarray(b.spread_zone_counts).any()),
+        any_saa=bool(np.asarray(vs.saa_src).any()))
 
 
-ALL_ON_FLAGS = BatchFlags(*([True] * 8))
+ALL_ON_FLAGS = BatchFlags(*([True] * 9))
 
 
 class DeviceCluster(NamedTuple):
@@ -316,6 +320,23 @@ def _predicate_mask(name: str, b: DeviceBatch, c: DeviceCluster,
     raise KeyError(f"unknown predicate {name!r}")
 
 
+def saa_plane(cnt: jnp.ndarray, num: jnp.ndarray, dom: jnp.ndarray,
+              labeled: jnp.ndarray) -> jnp.ndarray:
+    """CalculateAntiAffinityPriority score (selector_spreading.go:236-250):
+    int(10*(num-count)/num) on ready nodes carrying the label, 10 when the
+    service has no pods, 0 on unlabeled nodes.  ``cnt`` [P,D] per-domain
+    peer counts of each pod's service group, ``num`` [P,1] peer totals,
+    ``dom`` [N] node domain ids, ``labeled`` [N]."""
+    per = jnp.take(cnt, dom, axis=1)          # [P, N]
+    # prio._trunc, not raw floor: XLA's reciprocal-approximated f32 divide
+    # can land an exact quotient (440/110 == 4.0) an ulp low, and the
+    # truncation would eat a whole point.
+    score = jnp.where(num > 0.0,
+                      prio._trunc(10.0 * (num - per) / jnp.maximum(num, 1.0)),
+                      10.0)
+    return jnp.where(labeled[None, :], score, 0.0)
+
+
 def _priority_plane(name: str, b: DeviceBatch, c: DeviceCluster,
                     n_nodes: int, extra: dict) -> jnp.ndarray:
     p = b.request.shape[0]
@@ -347,7 +368,11 @@ def _priority_plane(name: str, b: DeviceBatch, c: DeviceCluster,
     if name == "NodeLabelPriority":
         return prio.node_label(p, b.volsvc.nl_prio_rows[extra.get("aux", 0)])
     if name == "ServiceAntiAffinityPriority":
-        return b.volsvc.saa_score[extra.get("aux", 0)][b.volsvc.saa_group]
+        vs = b.volsvc
+        return saa_plane(vs.saa_cnt[extra.get("aux", 0)][vs.saa_group],
+                         vs.saa_num[vs.saa_group][:, None],
+                         vs.saa_dom[extra.get("aux", 0)],
+                         vs.saa_labeled[extra.get("aux", 0)])
     if name == "EqualPriority":
         return prio.equal_priority(p, n_nodes)
     raise KeyError(f"unknown priority {name!r}")
@@ -543,19 +568,25 @@ class Solver:
                 in_scan = flags.any_spread
             elif name == "InterPodAffinityPriority":
                 in_scan = flags.any_affinity_prio
+            elif name == "ServiceAntiAffinityPriority":
+                # No batch pod joins any scored service group: counts are
+                # provably constant, the batch-start plane is exact.
+                in_scan = flags.any_saa
             if in_scan:
-                dynamic_prios.append((name, weight))
+                dynamic_prios.append((name, weight, aux))
             else:
                 static_score += jnp.float32(weight) * \
                     _priority_plane(name, b, c, n, {"aux": aux})
         dynamic_prios = tuple(dynamic_prios)
         use_interpod_prio = any(nm == "InterPodAffinityPriority"
-                                for nm, _ in dynamic_prios)
+                                for nm, _, _ in dynamic_prios)
         track_affinity = use_interpod or use_interpod_prio
         track_spread = any(nm in ("SelectorSpreadPriority",
                                   "ServiceSpreadingPriority")
-                           for nm, _ in dynamic_prios)
+                           for nm, _, _ in dynamic_prios)
         track_spread_zones = track_spread and flags.any_spread_zones
+        track_saa = any(nm == "ServiceAntiAffinityPriority"
+                        for nm, _, _ in dynamic_prios)
 
         fits_pods_alloc = c.alloc[:, RES_PODS]
         zone_ids = b.node_zone_id  # [N]
@@ -616,7 +647,7 @@ class Solver:
 
             # Dynamic priorities against current aggregates.
             score = xs["sscore"]
-            for name, weight in dynamic_prios:
+            for name, weight, aux in dynamic_prios:
                 w = f32(weight)
                 if name == "LeastRequestedPriority":
                     score = score + w * prio.least_requested(
@@ -646,6 +677,15 @@ class Solver:
                         xs["sym_match"][None], a.sym_w, state["sym_cnt"])
                     score = score + w * interpod.priority_score(
                         counts, c.schedulable, prio._trunc)[0]
+                elif name == "ServiceAntiAffinityPriority":
+                    # Live per-domain peer counts (selector_spreading.go
+                    # would re-list the service's pods on every decision;
+                    # the scan carries the counts instead).
+                    score = score + w * saa_plane(
+                        state["saa_cnt"][aux][xs["saa_g"]][None],
+                        state["saa_num"][xs["saa_g"]][None, None],
+                        b.volsvc.saa_dom[aux],
+                        b.volsvc.saa_labeled[aux])[0]
 
             # selectHost (generic_scheduler.go:124-141): round-robin among
             # max-score feasible nodes; counter bumps only on success.
@@ -694,6 +734,20 @@ class Solver:
             if use_max_gce:
                 new_state["pd_gce"] = state["pd_gce"] | \
                     (onehot[:, None] & xs["pd_pod_gce"][None, :])
+            if track_saa:
+                # The placed pod joins every matching service's peer set:
+                # totals bump for each joined group, the domain count only
+                # when the chosen node carries the label.
+                src = xs["saa_src"].astype(f32) * placed.astype(f32)  # [Gy]
+                new_state["saa_num"] = state["saa_num"] + src
+                j = jnp.clip(choice, 0)
+                dom_j = b.volsvc.saa_dom[:, j]                  # [L]
+                lab_j = b.volsvc.saa_labeled[:, j] & placed     # [L]
+                n_dom = state["saa_cnt"].shape[2]
+                domoh = ((jnp.arange(n_dom, dtype=jnp.int32)[None, :]
+                          == dom_j[:, None]) & lab_j[:, None]).astype(f32)
+                new_state["saa_cnt"] = state["saa_cnt"] + \
+                    domoh[:, None, :] * src[None, :, None]
             if track_affinity:
                 (new_state["match_cnt"], new_state["match_total"],
                  new_state["decl_reach"], new_state["sym_cnt"]) = \
@@ -736,6 +790,11 @@ class Solver:
                       match_src=a.match_src, decl_src=a.decl_src,
                       pref_w=a.pref_w, sym_match=a.sym_match,
                       sym_src=a.sym_src)
+        if track_saa:
+            init["saa_cnt"] = b.volsvc.saa_cnt
+            init["saa_num"] = b.volsvc.saa_num
+            xs["saa_g"] = b.volsvc.saa_group
+            xs["saa_src"] = b.volsvc.saa_src
         if use_max_ebs:
             init["pd_ebs"] = b.volsvc.pd_node_ebs
             xs["pd_pod_ebs"] = b.volsvc.pd_pod_ebs
@@ -843,7 +902,8 @@ _AFF_POD_AXIS_FIELDS = ("match_src", "aff_need", "aff_self", "anti_need",
                         "pref_w", "decl_match", "decl_src", "sym_match",
                         "sym_src")
 _VS_POD_AXIS_FIELDS = ("pd_pod_ebs", "pd_extra_ebs", "pd_pod_gce",
-                       "pd_extra_gce", "vz_group", "sa_group", "saa_group")
+                       "pd_extra_gce", "vz_group", "sa_group", "saa_group",
+                       "saa_src")
 
 
 def slice_pod_axis(b: DeviceBatch, start: int, stop: int) -> DeviceBatch:

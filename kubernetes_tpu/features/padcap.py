@@ -42,7 +42,10 @@ AXES: dict[str, list[tuple[str, str, int, object]]] = {
               ("volsvc", "pd_node_gce", 1, False)],
     "vs_vz": [("volsvc", "vz_mask", 0, True)],
     "vs_sa": [("volsvc", "sa_mask", 0, True)],
-    "vs_saa": [("volsvc", "saa_score", 1, 0.0)],
+    "vs_saa_g": [("volsvc", "saa_src", 1, False),
+                 ("volsvc", "saa_cnt", 1, 0.0),
+                 ("volsvc", "saa_num", 0, 0.0)],
+    "vs_saa_d": [("volsvc", "saa_cnt", 2, 0.0)],
     "b_sel": [("", "sel_required", 0, True),
               ("", "sel_pref_counts", 0, 0)],
     "b_spread": [("", "spread_node_counts", 0, 0.0),
@@ -109,9 +112,12 @@ def apply_caps(batch, caps: dict[str, int]):
             continue
         for container, field, axis, fill in fields:
             src = batch if container == "" else getattr(batch, container)
-            padded = _pad_axis(getattr(src, field), axis, cap, fill)
-            (batch_updates if container == "" else
-             aff_updates if container == "aff" else vs_updates)[field] = padded
+            updates = (batch_updates if container == "" else
+                       aff_updates if container == "aff" else vs_updates)
+            # A field listed under two axes (saa_cnt: group AND domain)
+            # must pad its already-padded copy, not the original.
+            arr = updates.get(field, getattr(src, field))
+            updates[field] = _pad_axis(arr, axis, cap, fill)
     if aff_updates:
         batch_updates["aff"] = batch.aff._replace(**aff_updates)
     if vs_updates:

@@ -69,9 +69,16 @@ class VolSvcTensors(NamedTuple):
     # ServiceAffinity groups.
     sa_group: np.ndarray      # [P] int32
     sa_mask: np.ndarray       # [Gs, N] bool
-    # ServiceAntiAffinity per-label score rows.
-    saa_group: np.ndarray     # [P] int32
-    saa_score: np.ndarray     # [L, Gy, N] f32 (0-10 ints)
+    # ServiceAntiAffinity (selector_spreading.go:193-253) carried state:
+    # the solver's scan carries per-(label, group) per-domain peer counts so
+    # every in-batch placement moves the live score — the same visibility
+    # the reference's one-at-a-time loop gets through its pod lister.
+    saa_group: np.ndarray     # [P] int32 — pod's (ns, first-svc-sel) group
+    saa_src: np.ndarray       # [P, Gy] bool — groups a placed pod joins
+    saa_dom: np.ndarray       # [L, N] int32 — node's label-value domain id
+    saa_labeled: np.ndarray   # [L, N] bool — has label & schedulable
+    saa_cnt: np.ndarray       # [L, Gy, D] f32 — batch-start domain counts
+    saa_num: np.ndarray       # [Gy] f32 — batch-start peer totals
     # CheckNodeLabelPresence / NodeLabelPriority policy-arg rows
     # (predicates.go:586-621, priorities.go:160-197) — pod-independent.
     nl_pred_row: np.ndarray   # [N] bool
@@ -275,14 +282,45 @@ def _compile_service_anti_affinity(pods: Sequence[api.Pod],
                                    schedulable: np.ndarray,
                                    labels_cfg: tuple[str, ...],
                                    listers: Optional[VolumeListers],
-                                   service_peers) -> tuple[np.ndarray, np.ndarray]:
+                                   service_peers
+                                   ) -> tuple[np.ndarray, np.ndarray,
+                                              np.ndarray, np.ndarray,
+                                              np.ndarray, np.ndarray]:
     """CalculateAntiAffinityPriority (selector_spreading.go:193-253):
     int(10 * (numServicePods - countsOnLabelValue) / numServicePods) on
-    ready nodes carrying the label, 0 elsewhere, 10 when no service pods."""
+    ready nodes carrying the label, 0 elsewhere, 10 when no service pods.
+
+    Emits carried state rather than baked scores: (group [P], src [P,Gy],
+    dom [L,N], labeled [L,N], cnt [L,Gy,D], num [Gy]).  The solver scores
+    from (cnt, num) and updates both per in-batch placement; `src[i, g]`
+    marks every group whose namespace+selector pod i joins when placed
+    (a pod counts toward EVERY matching service's spread, not just the
+    first service it reads its own score from)."""
     n = len(nodes)
     L = max(len(labels_cfg), 1)
+    name_to_idx = {nd.name: j for j, nd in enumerate(nodes)}
+    # Per-label node domains: distinct label values interned per label.
+    dom = np.zeros((L, n), np.int32)
+    labeled = np.zeros((L, n), bool)
+    n_doms = 1
+    for li, lb in enumerate(labels_cfg):
+        values: dict[str, int] = {}
+        for j, nd in enumerate(nodes):
+            v = nd.labels.get(lb)
+            if v is None:
+                continue
+            labeled[li, j] = bool(schedulable[j])
+            d = values.get(v)
+            if d is None:
+                d = len(values)
+                values[v] = d
+            dom[li, j] = d
+        n_doms = max(n_doms, len(values))
+    D = _pow2(n_doms)
+
     groups: dict = {}
-    rows: list[list[np.ndarray]] = []
+    sigs: list = []          # group -> (ns, selector dict or None)
+    peer_lists: list = []    # group -> peer node-name list
     group = np.zeros(len(pods), np.int32)
     for i, pod in enumerate(pods):
         svc = listers.first_service(pod) if listers is not None else None
@@ -290,44 +328,32 @@ def _compile_service_anti_affinity(pods: Sequence[api.Pod],
                if svc is not None else None)
         g = groups.get(sig)
         if g is None:
-            g = len(rows)
+            g = len(sigs)
             groups[sig] = g
-            peer_nodes = service_peers(pod.namespace, svc.selector) \
-                if svc is not None else []
-            num = len(peer_nodes)
-            per_label: list[np.ndarray] = []
-            for lb in labels_cfg:
-                node_v = [nd.labels.get(lb) if lb in nd.labels else None
-                          for nd in nodes]
-                labeled = np.array(
-                    [v is not None and s for v, s in zip(node_v, schedulable)],
-                    bool)
-                counts: dict[str, int] = {}
-                for pn in peer_nodes:
-                    idx = next((j for j, nd in enumerate(nodes)
-                                if nd.name == pn), None)
-                    if idx is not None and labeled[idx]:
-                        counts[node_v[idx]] = counts.get(node_v[idx], 0) + 1
-                score = np.zeros(n, np.float32)
-                for j in range(n):
-                    if not labeled[j]:
-                        continue
-                    if num > 0:
-                        score[j] = float(int(
-                            10.0 * (num - counts.get(node_v[j], 0)) / num))
-                    else:
-                        score[j] = 10.0
-                per_label.append(score)
-            if not labels_cfg:
-                per_label.append(np.zeros(n, np.float32))
-            rows.append(per_label)
+            sigs.append((pod.namespace,
+                         dict(svc.selector) if svc is not None else None))
+            peer_lists.append(service_peers(pod.namespace, svc.selector)
+                              if svc is not None else [])
         group[i] = g
-    gcount = _pow2(len(rows))
-    out = np.zeros((L, gcount, n), np.float32)
-    for g, per_label in enumerate(rows):
-        for li, row in enumerate(per_label):
-            out[li, g] = row
-    return group, out
+    gcount = _pow2(len(sigs))
+    cnt = np.zeros((L, gcount, D), np.float32)
+    num = np.zeros(gcount, np.float32)
+    for g, peer_nodes in enumerate(peer_lists):
+        num[g] = len(peer_nodes)
+        for pn in peer_nodes:
+            j = name_to_idx.get(pn)
+            if j is None:
+                continue
+            for li in range(L):
+                if labeled[li, j]:
+                    cnt[li, g, dom[li, j]] += 1.0
+    src = np.zeros((len(pods), gcount), bool)
+    for i, pod in enumerate(pods):
+        for g, (ns, sel) in enumerate(sigs):
+            if sel is not None and pod.namespace == ns and \
+                    all(pod.labels.get(k) == v for k, v in sel.items()):
+                src[i, g] = True
+    return group, src, dom, labeled, cnt, num
 
 
 def empty_volsvc(p: int, n: int) -> VolSvcTensors:
@@ -343,8 +369,11 @@ def empty_volsvc(p: int, n: int) -> VolSvcTensors:
         pd_node_err_gce=np.zeros(n, bool),
         vz_group=np.zeros(p, np.int32), vz_mask=np.ones((1, n), bool),
         sa_group=np.zeros(p, np.int32), sa_mask=np.ones((1, n), bool),
-        saa_group=np.zeros(p, np.int32),
-        saa_score=np.zeros((1, 1, n), np.float32),
+        saa_group=np.zeros(p, np.int32), saa_src=np.zeros((p, 1), bool),
+        saa_dom=np.zeros((1, n), np.int32),
+        saa_labeled=np.zeros((1, n), bool),
+        saa_cnt=np.zeros((1, 1, 1), np.float32),
+        saa_num=np.zeros(1, np.float32),
         nl_pred_row=np.ones(n, bool), nl_prio_rows=np.zeros((1, n), bool))
 
 
@@ -395,12 +424,17 @@ def compile_volsvc(pods: Sequence[api.Pod],
         sa_mask = np.ones((1, n), bool)
 
     if service_anti_affinity_labels:
-        saa_group, saa_score = _compile_service_anti_affinity(
+        (saa_group, saa_src, saa_dom, saa_labeled, saa_cnt,
+         saa_num) = _compile_service_anti_affinity(
             pods, nodes, schedulable, service_anti_affinity_labels, listers,
             service_peers)
     else:
         saa_group = np.zeros(p, np.int32)
-        saa_score = np.zeros((1, 1, n), np.float32)
+        saa_src = np.zeros((p, 1), bool)
+        saa_dom = np.zeros((1, n), np.int32)
+        saa_labeled = np.zeros((1, n), bool)
+        saa_cnt = np.zeros((1, 1, 1), np.float32)
+        saa_num = np.zeros(1, np.float32)
 
     # CheckNodeLabelPresence: with presence=True every listed label must be
     # on the node; with False none may be (predicates.go:599-621).
@@ -422,5 +456,6 @@ def compile_volsvc(pods: Sequence[api.Pod],
         pd_node_extra_gce=nxg, pd_node_err_gce=neg,
         vz_group=vz_group, vz_mask=vz_mask,
         sa_group=sa_group, sa_mask=sa_mask,
-        saa_group=saa_group, saa_score=saa_score,
+        saa_group=saa_group, saa_src=saa_src, saa_dom=saa_dom,
+        saa_labeled=saa_labeled, saa_cnt=saa_cnt, saa_num=saa_num,
         nl_pred_row=nl_pred_row, nl_prio_rows=nl_prio_rows)

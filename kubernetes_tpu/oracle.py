@@ -16,6 +16,8 @@ import math
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
+import numpy as np
+
 from kubernetes_tpu.api import types as api
 
 MAX_PRIORITY = 10
@@ -518,12 +520,19 @@ def selector_spread(pod: api.Pod, cluster: ClusterState) -> dict[str, int]:
                 counts_by_zone[zone] = counts_by_zone.get(zone, 0) + count
     have_zones = len(counts_by_zone) != 0
     max_zone = max(counts_by_zone.values()) if have_zones else 0.0
+    # The reference's fScore is a Go float32 (selector_spreading.go:139);
+    # the blend must round through float32 or edge values truncate
+    # differently than both the reference and the tensor engine (observed:
+    # a blend that is exactly 6.0 in f32 lands at 5.9999996 in f64 and
+    # int-truncates to 5).
+    f32 = np.float32
     result = {}
     for node in nodes:
-        f = float(MAX_PRIORITY)
+        f = f32(MAX_PRIORITY)
         if max_count > 0:
-            f = MAX_PRIORITY * ((max_count - counts.get(node.name, 0))
-                                / max_count)
+            f = f32(MAX_PRIORITY) * ((f32(max_count)
+                                      - f32(counts.get(node.name, 0)))
+                                     / f32(max_count))
         if have_zones and max_zone > 0:
             # The reference divides unguarded (selector_spreading.go:160);
             # with zero matches everywhere that's 0/0 -> NaN whose int
@@ -532,9 +541,10 @@ def selector_spread(pod: api.Pod, cluster: ClusterState) -> dict[str, int]:
             # zone signal, keep the node score.
             zone = node.zone_key()
             if zone:
-                zscore = MAX_PRIORITY * ((max_zone - counts_by_zone.get(zone, 0))
-                                         / max_zone)
-                f = f * (1 - 2 / 3) + (2 / 3) * zscore
+                zscore = f32(MAX_PRIORITY) * (
+                    (f32(max_zone) - f32(counts_by_zone.get(zone, 0)))
+                    / f32(max_zone))
+                f = f * f32(1 - 2 / 3) + f32(2 / 3) * zscore
         result[node.name] = int(f)
     return result
 

@@ -91,7 +91,7 @@ _VS_NODE_FIELDS = {"pd_node_ebs", "pd_node_gce", "nl_pred_row",
                    "pd_node_extra_gce", "pd_node_err_gce"}
 _VS_NODE_LAST_FIELDS = {"vz_mask", "sa_mask", "nl_prio_rows"}
 _VS_POD_FIELDS = {"pd_pod_ebs", "pd_pod_gce", "pd_extra_ebs", "pd_extra_gce",
-                  "vz_group", "sa_group", "saa_group"}
+                  "vz_group", "sa_group", "saa_group", "saa_src"}
 
 
 def _shard_volsvc(v: DeviceVolSvc, mesh: Mesh,
@@ -102,8 +102,8 @@ def _shard_volsvc(v: DeviceVolSvc, mesh: Mesh,
             spec = P(NODE_AXIS) if arr.ndim == 1 else P(NODE_AXIS, None)
         elif name in _VS_NODE_LAST_FIELDS:
             spec = P(None, NODE_AXIS)
-        elif name == "saa_score":
-            spec = P(None, None, NODE_AXIS)
+        elif name in ("saa_dom", "saa_labeled"):
+            spec = P(None, NODE_AXIS)
         elif name in _VS_POD_FIELDS and shard_pods:
             spec = P(BATCH_AXIS) if arr.ndim == 1 else P(BATCH_AXIS, None)
         else:

@@ -163,25 +163,27 @@ def run_parity(n_nodes: int, n_pods: int, seed: int = 0,
                 if saa_label:
                     # oracle.prioritize is DefaultProvider-only: add the
                     # ServiceAntiAffinity term explicitly.  The engine
-                    # scored with BATCH-START peer counts
-                    # (engine/solver.py:59-64); the live view uses counts
-                    # after the engine's prior placements.
+                    # carries LIVE per-domain peer counts through the scan
+                    # (engine/solver.py saa_cnt/saa_num state), so it is
+                    # judged against the live oracle view; the batch-start
+                    # (stale) view is replayed alongside to record what the
+                    # pre-r4 static scoring would have flipped.
                     live = oracle.service_anti_affinity(pod, cluster,
                                                         saa_label)
                     start = saa_start[_first_service_sig(pod, services)]
                     drift = max(abs(live[nm] - start[nm]) for nm in onames)
                     saa_drifts.append(drift)
-                    eng_view = {nm: scores[nm] + start[nm] for nm in onames}
+                    stale_view = {nm: scores[nm] + start[nm] for nm in onames}
                     live_view = {nm: scores[nm] + live[nm] for nm in onames}
                     live_best = {nm for nm in onames
                                  if live_view[nm] == max(live_view[nm2]
                                                          for nm2 in onames)}
-                    eng_best = {nm for nm in onames
-                                if eng_view[nm] == max(eng_view[nm2]
-                                                       for nm2 in onames)}
-                    if not (eng_best & live_best):
+                    stale_best = {nm for nm in onames
+                                  if stale_view[nm] == max(stale_view[nm2]
+                                                           for nm2 in onames)}
+                    if not (stale_best & live_best):
                         saa_flips += 1
-                    scores = eng_view
+                    scores = live_view
                 best = max(scores[nm] for nm in onames)
                 if scores[dest] == best:
                     agreements += 1
@@ -218,10 +220,12 @@ def run_parity(n_nodes: int, n_pods: int, seed: int = 0,
     if saa_label:
         rec["service_anti_affinity"] = {
             "label": saa_label,
-            "max_score_drift": max(saa_drifts) if saa_drifts else 0,
-            "mean_score_drift": round(float(np.mean(saa_drifts)), 3)
-            if saa_drifts else 0.0,
-            "argmax_flips": saa_flips,
+            "scoring": "live in-batch peer counts (scan-carried)",
+            "max_score_drift_vs_batch_start": max(saa_drifts)
+            if saa_drifts else 0,
+            "mean_score_drift_vs_batch_start": round(
+                float(np.mean(saa_drifts)), 3) if saa_drifts else 0.0,
+            "stale_scoring_would_flip": saa_flips,
             "samples": len(saa_drifts),
         }
     return rec
