@@ -48,7 +48,8 @@ class _EvalResult:
     parsed node dicts (node names suffice; responses join item_bytes)."""
 
     __slots__ = ("pod", "node_names", "feasible", "scores", "solver",
-                 "db", "dc", "nt", "item_bytes", "_filter_parts")
+                 "db", "dc", "nt", "item_bytes", "_filter_parts",
+                 "resp_filter", "resp_prioritize")
 
     def __init__(self, pod, node_names, feasible, scores, solver, db, dc,
                  nt, item_bytes):
@@ -62,6 +63,11 @@ class _EvalResult:
         self.nt = nt
         self.item_bytes = item_bytes
         self._filter_parts = None
+        # Rendered wire responses, cached with the result: a 5k-node
+        # HostPriorityList json.dumps costs ~6 ms and a filter item join
+        # ~5 ms — on memo hits the verb becomes parse + memcpy.
+        self.resp_filter: bytes | None = None
+        self.resp_prioritize: bytes | None = None
 
     def filter_parts(self) -> tuple[np.ndarray, dict[str, str]]:
         """Feasible indices + per-node failure reasons (cached: the masks
@@ -110,13 +116,15 @@ class ExtenderCore:
         # (features/batch.py pod_template_key).
         self._TPL_MEMO_MAX = 32   # per engine
         self._inflight = 0        # concurrent handle() calls (refreeze gate)
-        # Wire-path memos: a raw-body digest memo (the prioritize call that
-        # follows filter carries byte-identical ExtenderArgs, so it should
-        # cost zero parsing), and the previous request's node-list byte span
+        # Wire-path memos: the previous request's raw body with its result
+        # (the prioritize call that follows filter carries byte-identical
+        # ExtenderArgs, recognized by one memcmp — retaining the ~2 MB body
+        # is the price of not sha256-ing it per request, ~6 ms at 5k
+        # nodes), and the previous request's node-list byte span
         # (a 5k-node list is ~2 MB of JSON that rarely changes between
         # verbs — recognizing it by substring match replaces a ~60 ms parse
         # with a sub-ms memcmp).
-        self._raw_memo: tuple | None = None   # (digest, result, item_bytes, err)
+        self._raw_memo: tuple | None = None   # (raw_body, result, item_bytes, err)
         self._span_cache: tuple | None = None  # (span_bytes, nkey, item_bytes)
 
     @staticmethod
@@ -279,7 +287,16 @@ class ExtenderCore:
         sp = self._span_cache
         if allow_fast and sp is not None:
             span_bytes, nkey, item_bytes = sp
-            at = raw.find(span_bytes)
+            # The node list is usually the LAST member ({"Pod":..,"Nodes":..}
+            # — Go marshals ExtenderArgs in struct order), so try one tail
+            # memcmp (~0.2 ms on 2 MB) before the general substring search
+            # (~6 ms: find() restarts a 2 MB needle at every offset).
+            tail_at = len(raw) - len(span_bytes) - 1
+            if tail_at >= 0 and raw.endswith(b"}") and \
+                    raw[tail_at:-1] == span_bytes:
+                at = tail_at
+            else:
+                at = raw.find(span_bytes)
             if at >= 0:
                 with self._lock:
                     have_engine = nkey in self._engines
@@ -312,8 +329,8 @@ class ExtenderCore:
 
     def handle(self, verb: str, raw: bytes) -> bytes:
         """Serve one wire verb from raw request bytes to raw response bytes.
-        Identical bodies (the filter→prioritize pair for one pod) hit a
-        digest memo and cost no parsing or solving at all."""
+        Identical bodies (the filter→prioritize pair for one pod) hit the
+        raw-body memo and cost no parsing or solving at all."""
         with self._lock:
             self._inflight += 1
         try:
@@ -323,11 +340,13 @@ class ExtenderCore:
                 self._inflight -= 1
 
     def _handle(self, verb: str, raw: bytes) -> bytes:
-        dig = hashlib.sha256(raw).digest()
+        # Recognize the filter->prioritize pair's identical body by direct
+        # bytes equality (length check + memcmp, ~0.2 ms for a 2 MB body)
+        # rather than hashing it (sha256 of 2 MB was ~6 ms per request).
         memo = self._raw_memo
         item_bytes = None
         result = err = None
-        if memo is not None and memo[0] == dig:
+        if memo is not None and memo[0] == raw:
             _, result, item_bytes, err = memo
         else:
             try:
@@ -344,24 +363,37 @@ class ExtenderCore:
                                                    item_bytes)
             except Exception as e:  # noqa: BLE001 — wire contract: Error field
                 # str(e), not e: a stored exception pins its traceback
-                # frames (and with them the multi-MB request body) until
-                # the memo is replaced.
+                # frames (whole call stacks of locals) until the memo is
+                # replaced; only the message is part of the wire contract.
                 err = str(e) or type(e).__name__
-            self._raw_memo = (dig, result, item_bytes, err)
+            self._raw_memo = (raw, result, item_bytes, err)
         if verb == "filter":
             if err is None:
                 # Response building includes filter_parts (a device masks
                 # computation): failures there must still answer the wire
                 # contract's Error field, not drop the exchange.
                 try:
-                    return self._filter_response(result, item_bytes)
+                    if result.resp_filter is not None:
+                        return result.resp_filter
+                    if item_bytes is None:
+                        item_bytes = result.item_bytes
+                    resp = self._filter_response(result, item_bytes)
+                    if item_bytes is not None:
+                        # Only cache the full-echo form; a nodes-absent
+                        # request renders a minimal name-only echo that
+                        # must not shadow later full responses.
+                        result.resp_filter = resp
+                    return resp
                 except Exception as e:  # noqa: BLE001 — wire contract
                     err = str(e) or type(e).__name__
             return json.dumps({"nodes": {"items": []}, "failedNodes": {},
                                "error": str(err)}).encode()
         if err is None:
             try:
-                return json.dumps(self._priority_list(result)).encode()
+                if result.resp_prioritize is None:
+                    result.resp_prioritize = json.dumps(
+                        self._priority_list(result)).encode()
+                return result.resp_prioritize
             except Exception as e:  # noqa: BLE001 — prioritize is ignorable
                 err = str(e) or type(e).__name__
         # Prioritize errors are ignorable (api/types.go:128-130): answer
