@@ -35,11 +35,23 @@ from kubernetes_tpu.api.types import NAMESPACED_KINDS as _NAMESPACED
 from kubernetes_tpu.apiserver.memstore import (ConflictError, MemStore,
                                                TooOldError)
 from kubernetes_tpu.apiserver.validation import (AdmissionError,
-                                                 admit_and_validate)
+                                                 admit_and_validate,
+                                                 store_admission)
 
 # Idle watch streams carry a blank heartbeat chunk this often so clients'
 # read deadlines only fire on genuinely dead sockets.
 WATCH_HEARTBEAT_PERIOD = 10.0
+
+
+class _NullGate:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_GATE = _NullGate()
 
 _STATUS_LINES = {
     200: b"HTTP/1.1 200 OK\r\n",
@@ -56,6 +68,15 @@ _STATUS_LINES = {
 
 
 def make_handler(store: MemStore, auth=None):
+    # Store-aware admission chain (anti-affinity veto -> LimitRanger
+    # defaulting -> ResourceQuota), built once per server.  Pod
+    # admit+create pairs serialize under one gate: ResourceQuota is
+    # check-then-act against the stored pod list, and two concurrent
+    # creates racing the same quota headroom must not both pass before
+    # either lands (the reference serializes via CAS on quota status).
+    admission_chain = store_admission(store)
+    pod_write_gate = threading.Lock()
+
     class Handler(socketserver.StreamRequestHandler):
         # Response header/body write pairs on keep-alive connections stall
         # ~40 ms under Nagle + the peer's delayed ACK; verbs are small.
@@ -167,12 +188,13 @@ def make_handler(store: MemStore, auth=None):
                 + str(len(body)).encode() + b"\r\n\r\n" + body)
             self.wfile.flush()
 
-        def _admit(self, kind: str, body: dict) -> bool:
+        def _admit(self, kind: str, body: dict,
+                   op: str = "create") -> bool:
             """Write-path chain (pkg/apiserver: admission -> validation):
             403 on an admission veto, 422 with collected reasons on a
             structurally invalid object.  True = proceed to the store."""
             try:
-                errors = admit_and_validate(kind, body)
+                errors = admit_and_validate(kind, body, admission_chain, op)
             except AdmissionError as err:
                 self._send_json(403, {"error": str(err)})
                 return False
@@ -317,11 +339,17 @@ def make_handler(store: MemStore, auth=None):
                     if kind in _NAMESPACED:
                         body.setdefault("metadata", {}).setdefault(
                             "namespace", "default")
-                    if not self._admit(kind, body):
-                        return
-                    # owned: the handler's parsed body dies with this
-                    # request — the store may keep it without copying.
-                    created = store.create(kind, body, owned=True)
+                    if kind == "pods":
+                        with pod_write_gate:
+                            if not self._admit(kind, body):
+                                return
+                            created = store.create(kind, body, owned=True)
+                    else:
+                        if not self._admit(kind, body):
+                            return
+                        # owned: the handler's parsed body dies with this
+                        # request — the store may keep it without copying.
+                        created = store.create(kind, body, owned=True)
                     self._send_json(201, created)
                     return
             except ConflictError as err:
@@ -368,21 +396,25 @@ def make_handler(store: MemStore, auth=None):
                     it["metadata"] = {}
                 if kind in _NAMESPACED:
                     it["metadata"].setdefault("namespace", "default")
-                try:
-                    errors = admit_and_validate(kind, it)
-                except AdmissionError as err:
-                    results.append({"code": 403, "error": str(err)})
-                    continue
-                if errors:
-                    results.append({"code": 422,
-                                    "error": "validation failed",
-                                    "reasons": errors})
-                    continue
-                try:
-                    obj = store.create(kind, it, owned=True)
-                except ConflictError as err:
-                    results.append({"code": 409, "error": str(err)})
-                    continue
+                gate = pod_write_gate if kind == "pods" else \
+                    _NULL_GATE
+                with gate:
+                    try:
+                        errors = admit_and_validate(kind, it,
+                                                    admission_chain)
+                    except AdmissionError as err:
+                        results.append({"code": 403, "error": str(err)})
+                        continue
+                    if errors:
+                        results.append({"code": 422,
+                                        "error": "validation failed",
+                                        "reasons": errors})
+                        continue
+                    try:
+                        obj = store.create(kind, it, owned=True)
+                    except ConflictError as err:
+                        results.append({"code": 409, "error": str(err)})
+                        continue
                 created += 1
                 results.append({"code": 201, "resourceVersion":
                                 obj["metadata"]["resourceVersion"]})
@@ -403,13 +435,16 @@ def make_handler(store: MemStore, auth=None):
                 else:
                     self._send_json(404, {"error": "unknown path"})
                     return
-                if not self._admit(kind, body):
-                    return
-                # GuaranteedUpdate semantics: a submitted resourceVersion is
-                # a CAS precondition (pkg/storage/etcd/etcd_helper.go).
-                rv = (body.get("metadata") or {}).get("resourceVersion")
-                updated = store.update(kind, body, expected_rv=rv,
-                                       owned=True)
+                gate = pod_write_gate if kind == "pods" else _NULL_GATE
+                with gate:
+                    if not self._admit(kind, body, op="update"):
+                        return
+                    # GuaranteedUpdate semantics: a submitted
+                    # resourceVersion is a CAS precondition
+                    # (pkg/storage/etcd/etcd_helper.go).
+                    rv = (body.get("metadata") or {}).get("resourceVersion")
+                    updated = store.update(kind, body, expected_rv=rv,
+                                           owned=True)
                 self._send_json(200, updated)
             except ConflictError as err:
                 self._send_json(409, {"error": str(err)})

@@ -100,7 +100,47 @@ def validate_node(obj: dict) -> list[str]:
     return errors
 
 
-VALIDATORS = {"pods": validate_pod, "nodes": validate_node}
+def validate_limit_range(obj: dict) -> list[str]:
+    """ValidateLimitRange: every quantity in every limit item parseable —
+    a stored garbage quantity would poison every later pod admission in
+    the namespace."""
+    errors: list[str] = []
+    _check_name(obj.get("metadata") or {}, errors, "limitrange")
+    items = ((obj.get("spec") or {}).get("limits")) or []
+    if not isinstance(items, list):
+        return ["limitrange.spec.limits: not a list"]
+    for i, item in enumerate(items):
+        if not isinstance(item, dict):
+            errors.append(f"limitrange.spec.limits[{i}]: not an object")
+            continue
+        for fieldname in ("default", "defaultRequest", "min", "max"):
+            vals = item.get(fieldname) or {}
+            if not isinstance(vals, dict):
+                errors.append(
+                    f"limitrange.spec.limits[{i}].{fieldname}: not a map")
+                continue
+            for rname, val in vals.items():
+                _check_quantity(
+                    val, f"limitrange.spec.limits[{i}].{fieldname}"
+                    f"[{rname}]", errors)
+    return errors
+
+
+def validate_resource_quota(obj: dict) -> list[str]:
+    """ValidateResourceQuota: hard caps parseable and non-negative."""
+    errors: list[str] = []
+    _check_name(obj.get("metadata") or {}, errors, "resourcequota")
+    hard = ((obj.get("spec") or {}).get("hard")) or {}
+    if not isinstance(hard, dict):
+        return ["resourcequota.spec.hard: not a map"]
+    for rname, val in hard.items():
+        _check_quantity(val, f"resourcequota.spec.hard[{rname}]", errors)
+    return errors
+
+
+VALIDATORS = {"pods": validate_pod, "nodes": validate_node,
+              "limitranges": validate_limit_range,
+              "resourcequotas": validate_resource_quota}
 
 
 class AdmissionError(Exception):
@@ -114,7 +154,7 @@ class LimitPodHardAntiAffinityTopology:
 
     name = "LimitPodHardAntiAffinityTopology"
 
-    def admit(self, kind: str, obj: dict) -> None:
+    def admit(self, kind: str, obj: dict, op: str = "create") -> None:
         if kind != "pods":
             return
         import json as _json
@@ -136,14 +176,276 @@ class LimitPodHardAntiAffinityTopology:
                     f"key {key!r} is not allowed (hostname only)")
 
 
+def _pod_containers(obj: dict) -> list[dict]:
+    spec = obj.get("spec") or {}
+    cs = spec.get("containers")
+    return [c for c in cs if isinstance(c, dict)] \
+        if isinstance(cs, list) else []
+
+
+def _milli(val) -> int | None:
+    """Quantity -> milli-units for comparison (requestLimitEnforcedValues
+    does milli-precision comparison when values allow).  None for garbage:
+    admission runs BEFORE validation in the chain, so an unparseable
+    quantity must fall through to the validator's 422, not crash the
+    connection — and a stored-by-other-means garbage LimitRange/quota
+    value must not brick the namespace."""
+    try:
+        return int(parse_quantity(val) * 1000)
+    except (ValueError, TypeError, ArithmeticError):
+        return None
+
+
+class LimitRanger:
+    """plugin/pkg/admission/limitranger/admission.go: apply the namespace's
+    LimitRange Container-type defaults to unset container requests/limits
+    (defaultContainerResourceRequirements :190-209, merge :212-247), then
+    enforce Min/Max constraints (PodLimitFunc :422-520).  Runs BEFORE
+    ResourceQuota, as in the reference plugin order — quota must count the
+    post-default requests.
+
+    On a real cluster most pods get their scheduler-visible requests HERE,
+    not from their authors; without this plugin the scheduler packs by the
+    100m/200Mi nonzero fallback instead of namespace policy."""
+
+    name = "LimitRanger"
+
+    def __init__(self, store=None):
+        self._store = store
+
+    def _ranges(self, namespace: str) -> list[dict]:
+        if self._store is None:
+            return []
+        items, _ = self._store.list("limitranges")
+        return [it for it in items
+                if (it.get("metadata") or {}).get(
+                    "namespace", "default") == namespace]
+
+    def admit(self, kind: str, obj: dict, op: str = "create") -> None:
+        if kind != "pods":
+            return
+        ns = (obj.get("metadata") or {}).get("namespace") or "default"
+        violations: list[str] = []
+        for lr in self._ranges(ns):
+            limits = ((lr.get("spec") or {}).get("limits")) or []
+            # Defaults first (mergePodResourceRequirements), then Min/Max
+            # against the merged values.
+            dreq: dict = {}
+            dlim: dict = {}
+            for item in limits:
+                if item.get("type", "Container") != "Container":
+                    continue
+                dreq.update(item.get("defaultRequest") or {})
+                dlim.update(item.get("default") or {})
+            applied: list[str] = []
+            for c in _pod_containers(obj):
+                res = c.get("resources")
+                if not isinstance(res, dict):
+                    res = {}       # explicit null: default the whole block
+                    c["resources"] = res
+                req = res.get("requests")
+                if not isinstance(req, dict):
+                    req = {}
+                    res["requests"] = req
+                lim = res.get("limits")
+                if not isinstance(lim, dict):
+                    lim = {}
+                    res["limits"] = lim
+                set_r = [k for k in dreq if k not in req]
+                set_l = [k for k in dlim if k not in lim]
+                for k in set_r:
+                    req[k] = dreq[k]
+                for k in set_l:
+                    lim[k] = dlim[k]
+                if set_r:
+                    applied.append(f"{', '.join(sorted(set_r))} request for "
+                                   f"container {c.get('name', '')}")
+                if set_l:
+                    applied.append(f"{', '.join(sorted(set_l))} limit for "
+                                   f"container {c.get('name', '')}")
+            if applied:
+                ann = (obj.setdefault("metadata", {})
+                       .setdefault("annotations", {}))
+                ann["kubernetes.io/limit-ranger"] = \
+                    "LimitRanger plugin set: " + "; ".join(applied)
+            for item in limits:
+                if item.get("type", "Container") != "Container":
+                    continue
+                for c in _pod_containers(obj):
+                    res = c.get("resources") if \
+                        isinstance(c.get("resources"), dict) else {}
+                    req = res.get("requests") if \
+                        isinstance(res.get("requests"), dict) else {}
+                    lim = res.get("limits") if \
+                        isinstance(res.get("limits"), dict) else {}
+                    for rname, floor in (item.get("min") or {}).items():
+                        fv = _milli(floor)
+                        if rname not in req:
+                            violations.append(
+                                f"minimum {rname} usage per Container is "
+                                f"{floor}.  No request is specified.")
+                            continue
+                        rv = _milli(req[rname])
+                        # None (unparseable) on either side: leave it to
+                        # the validator's 422.
+                        if fv is not None and rv is not None and rv < fv:
+                            violations.append(
+                                f"minimum {rname} usage per Container is "
+                                f"{floor}, but request is {req[rname]}.")
+                    for rname, cap in (item.get("max") or {}).items():
+                        cv = _milli(cap)
+                        if cv is None:
+                            continue
+                        lv = _milli(lim[rname]) if rname in lim else None
+                        rv = _milli(req[rname]) if rname in req else None
+                        if lv is not None and lv > cv:
+                            violations.append(
+                                f"maximum {rname} usage per Container is "
+                                f"{cap}, but limit is {lim[rname]}.")
+                        elif rname not in lim and rv is not None and rv > cv:
+                            violations.append(
+                                f"maximum {rname} usage per Container is "
+                                f"{cap}, but request is {req[rname]}.")
+        if violations:
+            raise AdmissionError(f"{self.name}: " + "; ".join(violations))
+
+
+# Quota resource names tracked for pods (pkg/quota/evaluator/core/pods.go:
+# podUsageHelper — pods count, cpu/memory from requests, the requests.*
+# aliases mirror them).
+_QUOTA_COMPUTE = {"cpu": "cpu", "requests.cpu": "cpu",
+                  "memory": "memory", "requests.memory": "memory"}
+
+
+class ResourceQuota:
+    """plugin/pkg/admission/resourcequota: bound namespace usage.  A write
+    that would push any tracked resource past the quota's hard limit
+    bounces 403 (admission.go:71-…) — creates charge their full usage,
+    updates charge their delta (old self excluded from the recompute); a
+    quota tracking a compute resource requires every container to specify
+    it (the evaluator's Constraints — this is why LimitRanger runs first).
+
+    Usage is recomputed from the live pod list on every admit rather than
+    incrementally CAS-maintained: writes are control-plane-rate, the
+    recompute is O(pods-in-namespace), and it self-heals after deletes
+    without needing the reference's quota controller resync.  The server
+    serializes pod admit+store under one write gate so concurrent creates
+    cannot both pass the check before either lands."""
+
+    name = "ResourceQuota"
+
+    def __init__(self, store=None):
+        self._store = store
+
+    def admit(self, kind: str, obj: dict, op: str = "create") -> None:
+        if kind != "pods" or self._store is None:
+            return
+        meta = obj.get("metadata") or {}
+        ns = meta.get("namespace") or "default"
+        quotas, _ = self._store.list("resourcequotas")
+        quotas = [q for q in quotas
+                  if (q.get("metadata") or {}).get(
+                      "namespace", "default") == ns]
+        if not quotas:
+            return
+        new_usage = self._pod_usage(obj)
+        self_key = f"{ns}/{meta.get('name', '')}"
+        pods, _ = self._store.list("pods")
+        used = {"pods": 0, "cpu": 0, "memory": 0}
+        for p in pods:
+            pmeta = p.get("metadata") or {}
+            if pmeta.get("namespace", "default") != ns:
+                continue
+            if op == "update" and \
+                    f"{ns}/{pmeta.get('name', '')}" == self_key:
+                continue  # replaced by new_usage: a PUT that inflates
+                # requests is charged its delta, not waved through
+            phase = (p.get("status") or {}).get("phase", "")
+            if phase in ("Succeeded", "Failed"):
+                continue  # terminal pods stop counting (pods.go:52-58)
+            u = self._pod_usage(p)
+            for k in used:
+                used[k] += u[k]
+        # Surface CURRENT usage (stored pods only, not the pod being
+        # admitted) on the quota objects FIRST — admission runs before the
+        # store, so a later 422/409 must not leave a phantom pod in
+        # status.used, and a 403 below should still record live usage.
+        for q in quotas:
+            try:
+                self._store.update("resourcequotas", {
+                    **q, "status": {
+                        "hard": dict(((q.get("spec") or {}).get("hard"))
+                                     or {}),
+                        "used": {
+                            "pods": str(used["pods"] // 1000),
+                            "requests.cpu": f"{used['cpu']}m",
+                            "requests.memory": str(used["memory"] // 1000),
+                        }}})
+            except Exception:  # noqa: BLE001 — quota deleted mid-admit:
+                pass           # usage surfacing is best-effort display
+        for q in quotas:
+            hard = ((q.get("spec") or {}).get("hard")) or {}
+            for rname, cap in hard.items():
+                dim = _QUOTA_COMPUTE.get(rname)
+                if dim is None and rname != "pods":
+                    continue
+                if dim is not None and new_usage[f"unset_{dim}"]:
+                    raise AdmissionError(
+                        f"{self.name}: must specify {dim} — quota "
+                        f"{(q.get('metadata') or {}).get('name', '')} "
+                        f"tracks {rname}")
+                key = dim or "pods"
+                cap_v = _milli(cap)
+                if cap_v is not None and \
+                        used[key] + new_usage[key] > cap_v:
+                    raise AdmissionError(
+                        f"{self.name}: exceeded quota "
+                        f"{(q.get('metadata') or {}).get('name', '')}: "
+                        f"requested {rname}, used {used[key]}m of {cap}")
+
+    @staticmethod
+    def _pod_usage(obj: dict) -> dict:
+        cpu = mem = 0
+        unset_cpu = unset_mem = False
+        for c in _pod_containers(obj):
+            res = c.get("resources")
+            req = res.get("requests") if isinstance(res, dict) else None
+            req = req if isinstance(req, dict) else {}
+            # Unparseable values count 0 and fall through to the
+            # validator's 422 (admission must neither crash nor mask the
+            # structural error with a quota 403).
+            if "cpu" in req:
+                cpu += _milli(req["cpu"]) or 0
+            else:
+                unset_cpu = True
+            if "memory" in req:
+                mem += _milli(req["memory"]) or 0
+            else:
+                unset_mem = True
+        # All dimensions in milli-units so they compare directly against
+        # _milli(hard-cap) — one pod counts 1000 against a "pods: 10" cap
+        # of 10000.
+        return {"pods": 1000, "cpu": cpu, "memory": mem,
+                "unset_cpu": unset_cpu, "unset_memory": unset_mem}
+
+
 DEFAULT_ADMISSION = (LimitPodHardAntiAffinityTopology(),)
 
 
+def store_admission(store) -> tuple:
+    """The server's default chain, in the reference's plugin order:
+    anti-affinity veto, LimitRanger defaulting, then ResourceQuota against
+    the post-default requests."""
+    return (LimitPodHardAntiAffinityTopology(), LimitRanger(store),
+            ResourceQuota(store))
+
+
 def admit_and_validate(kind: str, obj: dict,
-                       admission=DEFAULT_ADMISSION) -> list[str]:
+                       admission=DEFAULT_ADMISSION,
+                       op: str = "create") -> list[str]:
     """The write-path chain (pkg/apiserver: admission -> validation ->
     registry).  Returns validation errors; raises AdmissionError on veto."""
     for plugin in admission:
-        plugin.admit(kind, obj)
+        plugin.admit(kind, obj, op)
     validator = VALIDATORS.get(kind)
     return validator(obj) if validator else []

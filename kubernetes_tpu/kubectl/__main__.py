@@ -44,6 +44,10 @@ ALIASES = {
     "replicasets": "replicasets",
     "deploy": "deployments", "deployment": "deployments",
     "deployments": "deployments",
+    "limits": "limitranges", "limitrange": "limitranges",
+    "limitranges": "limitranges",
+    "quota": "resourcequotas", "resourcequota": "resourcequotas",
+    "resourcequotas": "resourcequotas",
 }
 
 # Kinds whose storage keys carry a namespace (matches the apiserver).
@@ -191,6 +195,8 @@ _KIND_FIELD_TO_RESOURCE = {
     "replicationcontroller": "replicationcontrollers",
     "replicaset": "replicasets",
     "deployment": "deployments",
+    "limitrange": "limitranges",
+    "resourcequota": "resourcequotas",
 }
 
 

