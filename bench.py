@@ -129,6 +129,18 @@ def main() -> None:
         except Exception as err:  # noqa: BLE001 — quality phase is additive
             print(f"joint phase failed: {err}", file=sys.stderr)
 
+    # Kubemark-scale control plane (VERDICT r3 #9): 500 hollow kubelets +
+    # 2,000 replicas through the real scheduler, controller sync cost and
+    # heartbeat write load measured.  BENCH_FLEET=0 skips (~90 s).
+    fleet = None
+    if os.environ.get("BENCH_FLEET", "1") != "0":
+        from kubernetes_tpu.perf.harness import fleet_metrics
+        try:
+            fleet = fleet_metrics()
+            print(f"fleet: {fleet}", file=sys.stderr)
+        except Exception as err:  # noqa: BLE001 — fleet phase is additive
+            print(f"fleet phase failed: {err}", file=sys.stderr)
+
     baseline = 8.0  # test/e2e/density.go:48 MinPodsPerSecondThroughput
     out = {
         "metric": f"scheduler throughput, {n_pods} pods onto {n_nodes} nodes "
@@ -145,6 +157,8 @@ def main() -> None:
     }
     if joint is not None:
         out["joint"] = joint
+    if fleet is not None:
+        out["fleet"] = fleet
     if wire is not None:
         vals = sorted(r.pods_per_second for r in wire_all)
         out["wire"] = {

@@ -23,6 +23,13 @@ def main(argv=None) -> int:
                         "(tokenfile authenticator)")
     p.add_argument("--authorization-policy-file", default="",
                    help="ABAC policy file, one JSON object per line")
+    p.add_argument("--storage-dir", default="",
+                   help="durable storage directory (snapshot + WAL): a "
+                        "restart recovers objects and the resourceVersion "
+                        "counter, like etcd behind the reference apiserver")
+    p.add_argument("--storage-fsync", action="store_true",
+                   help="fsync the WAL per write (etcd's default "
+                        "durability; slower)")
     opts = p.parse_args(argv)
     auth = None
     if opts.token_auth_file or opts.authorization_policy_file:
@@ -38,8 +45,10 @@ def main(argv=None) -> int:
     # share_events: this process's only consumers are HTTP watch streams
     # (read-only serializers), so events may reference stored objects
     # directly — no per-write deepcopy (see MemStore.__init__).
-    server = serve(MemStore(share_events=True), port=opts.port,
-                   host=opts.host, auth=auth)
+    store = MemStore(share_events=True,
+                     storage_dir=opts.storage_dir or None,
+                     fsync=opts.storage_fsync)
+    server = serve(store, port=opts.port, host=opts.host, auth=auth)
     print(f"apiserver listening on {server.server_address[0]}:"
           f"{server.server_address[1]}", file=sys.stderr, flush=True)
     stop = threading.Event()
@@ -47,6 +56,7 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
     server.shutdown()
+    store.close()
     return 0
 
 

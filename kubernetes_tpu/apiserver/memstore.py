@@ -26,12 +26,16 @@ from __future__ import annotations
 
 import copy
 import json
+import os
 import queue
 import threading
 from dataclasses import dataclass
 from typing import Callable, Iterable, Optional
 
 WATCH_WINDOW = 1024  # Cacher event window (cacher.go's watchCache capacity)
+# WAL appends between snapshot rotations (a snapshot is one json.dump of
+# the whole object set; 4096 amortizes it to noise at control-plane rates).
+SNAPSHOT_EVERY = 4096
 
 
 class TooOldError(Exception):
@@ -84,20 +88,113 @@ class Watcher:
 
 
 class MemStore:
-    def __init__(self, share_events: bool = False) -> None:
+    def __init__(self, share_events: bool = False,
+                 storage_dir: Optional[str] = None,
+                 fsync: bool = False) -> None:
         """``share_events=True`` lets events reference stored objects
         directly instead of deep-copying a snapshot per write.  Safe ONLY
         when every consumer is read-only — the standalone apiserver binary
         qualifies (its watchers just serialize events to sockets, and no
         store code mutates a stored object in place: bind is
         copy-on-write).  In-process rigs keep the default: their reflector
-        handlers receive the event dicts and may mutate them."""
+        handlers receive the event dicts and may mutate them.
+
+        ``storage_dir`` makes the store durable — the one contract the
+        pure-memory store broke vs the reference (an apiserver restart
+        lost the cluster; etcd never does, pkg/storage/etcd3/store.go):
+        every write appends one JSON line to ``wal.jsonl``, a full
+        ``snapshot.json`` is rotated every SNAPSHOT_EVERY appends, and a
+        fresh store on the same directory replays snapshot + WAL,
+        preserving objects AND the resourceVersion counter (so reflectors
+        resume their watches without a 410 storm).  ``fsync=True`` forces
+        the WAL line to disk per write (etcd's default); off, durability
+        is to the OS page cache (survives process crash, not power loss)."""
         self._lock = threading.Lock()
         self._objects: dict[str, dict[str, dict]] = {}   # kind -> key -> obj
         self._rv = 0
         self._events: list[Event] = []                   # ring window
         self._watchers: list[Watcher] = []
         self._share_events = share_events
+        self._fsync = fsync
+        self._dir = storage_dir
+        self._wal = None
+        self._wal_count = 0
+        if storage_dir is not None:
+            os.makedirs(storage_dir, exist_ok=True)
+            self._recover(storage_dir)
+            self._wal = open(os.path.join(storage_dir, "wal.jsonl"),
+                             "a", encoding="utf-8")
+
+    # -- durability ------------------------------------------------------
+
+    def _recover(self, d: str) -> None:
+        snap = os.path.join(d, "snapshot.json")
+        if os.path.exists(snap):
+            with open(snap, encoding="utf-8") as f:
+                data = json.load(f)
+            self._objects = data["objects"]
+            self._rv = data["rv"]
+        wal = os.path.join(d, "wal.jsonl")
+        if os.path.exists(wal):
+            good_end = 0
+            with open(wal, "rb") as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        break  # torn final line from a crash mid-append
+                    good_end += len(line)
+                    self._wal_count += 1
+                    kind, key = rec["k"], rec["key"]
+                    bucket = self._objects.setdefault(kind, {})
+                    if rec["t"] == "DELETED":
+                        bucket.pop(key, None)
+                    else:
+                        bucket[key] = rec["o"]
+                    self._rv = max(self._rv, rec["rv"])
+            if good_end < os.path.getsize(wal):
+                # Drop the torn tail NOW: appending after it would weld
+                # the next record onto the fragment, and the restart after
+                # that would abort replay at the weld — silently losing
+                # every acknowledged write from this incarnation.
+                with open(wal, "rb+") as f:
+                    f.truncate(good_end)
+
+    def _append_wal(self, etype: str, kind: str, key: str,
+                    obj: dict, rv: int) -> None:
+        """Called under the store lock (from _emit)."""
+        rec = {"t": etype, "k": kind, "key": key, "rv": rv,
+               "o": None if etype == "DELETED" else obj}
+        self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._wal.flush()
+        if self._fsync:
+            os.fsync(self._wal.fileno())
+        self._wal_count += 1
+        if self._wal_count >= SNAPSHOT_EVERY:
+            self._rotate_snapshot()
+
+    def _rotate_snapshot(self) -> None:
+        """Write a full snapshot atomically, then truncate the WAL.  Under
+        the lock — a brief stall every SNAPSHOT_EVERY writes, the price of
+        never replaying an unbounded log."""
+        tmp = os.path.join(self._dir, "snapshot.json.tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"rv": self._rv, "objects": self._objects}, f,
+                      separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self._dir, "snapshot.json"))
+        self._wal.close()
+        self._wal = open(os.path.join(self._dir, "wal.jsonl"),
+                         "w", encoding="utf-8")
+        self._wal_count = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._wal is not None:
+                self._wal.flush()
+                self._wal.close()
+                self._wal = None
 
     # -- helpers ---------------------------------------------------------
 
@@ -110,6 +207,8 @@ class MemStore:
     def _emit(self, etype: str, kind: str, key: str, obj: dict) -> Event:
         self._rv += 1
         obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        if self._wal is not None:
+            self._append_wal(etype, kind, key, obj, self._rv)
         snapshot = obj if self._share_events else copy.deepcopy(obj)
         ev = Event(etype, kind, key, snapshot, self._rv)
         self._events.append(ev)

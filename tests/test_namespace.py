@@ -1,0 +1,134 @@
+"""Namespace as a real resource + lifecycle (pkg/controller/namespace,
+plugin/pkg/admission/namespace/lifecycle) — VERDICT r3 missing #5: before
+this, namespaces were implicit key prefixes and deleting one deleted
+nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.apiserver.server import serve
+from kubernetes_tpu.controller.namespace import NamespaceController
+
+
+def _pod(name, ns):
+    return {"metadata": {"name": name, "namespace": ns},
+            "spec": {"containers": [{"name": "c"}]}}
+
+
+def _wait(cond, timeout=10.0, msg=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out: {msg}")
+
+
+class TestNamespaceGC:
+    def test_deleted_namespace_contents_are_collected(self):
+        store = MemStore()
+        store.create("namespaces", {"metadata": {"name": "team-a"}})
+        store.create("pods", _pod("p1", "team-a"))
+        store.create("pods", _pod("p2", "team-a"))
+        store.create("services", {"metadata": {"name": "svc",
+                                               "namespace": "team-a"},
+                                  "spec": {"selector": {"a": "b"}}})
+        store.create("replicationcontrollers",
+                     {"metadata": {"name": "rc", "namespace": "team-a"},
+                      "spec": {"replicas": 0, "selector": {"x": "y"}}})
+        store.create("pods", _pod("keep", "team-b"))  # other ns untouched
+        nc = NamespaceController(store).run()
+        try:
+            store.delete("namespaces", "team-a")
+            _wait(lambda: not [o for o in store.list("pods")[0]
+                               if o["metadata"]["namespace"] == "team-a"],
+                  msg="team-a pods collected")
+            assert store.get("services", "team-a/svc") is None
+            assert store.get("replicationcontrollers", "team-a/rc") is None
+            assert store.get("pods", "team-b/keep") is not None
+        finally:
+            nc.stop()
+
+    def test_terminating_phase_finalizes(self):
+        """A namespace marked Terminating is drained and then removed —
+        the finalizer-shaped path."""
+        store = MemStore()
+        store.create("namespaces", {"metadata": {"name": "doomed"}})
+        store.create("pods", _pod("p", "doomed"))
+        nc = NamespaceController(store).run()
+        try:
+            ns = store.get("namespaces", "doomed")
+            ns["status"] = {"phase": "Terminating"}
+            store.update("namespaces", ns)
+            _wait(lambda: store.get("namespaces", "doomed") is None,
+                  msg="terminating namespace finalized")
+            assert store.get("pods", "doomed/p") is None
+        finally:
+            nc.stop()
+
+    def test_implicit_namespaces_never_collected(self):
+        """No Namespace object ever existed for 'default': its contents
+        must never be GC'd by absence."""
+        store = MemStore()
+        store.create("pods", _pod("p", "default"))
+        nc = NamespaceController(store).run()
+        try:
+            time.sleep(0.5)
+            assert store.get("pods", "default/p") is not None
+        finally:
+            nc.stop()
+
+
+class TestNamespaceWire:
+    @pytest.fixture
+    def rig(self):
+        store = MemStore()
+        server = serve(store)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        yield store, base
+        server.shutdown()
+
+    @staticmethod
+    def _req(base, method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read() or b"{}")
+
+    def test_namespace_crud_over_http(self, rig):
+        _, base = rig
+        code, created = self._req(base, "POST", "/api/v1/namespaces",
+                                  {"metadata": {"name": "web"}})
+        assert code == 201
+        code, got = self._req(base, "GET", "/api/v1/namespaces/web")
+        assert code == 200 and got["metadata"]["name"] == "web"
+        code, lst = self._req(base, "GET", "/api/v1/namespaces")
+        assert code == 200 and len(lst["items"]) == 1
+        code, _ = self._req(base, "DELETE", "/api/v1/namespaces/web")
+        assert code == 200
+
+    def test_create_into_terminating_namespace_403(self, rig):
+        store, base = rig
+        store.create("namespaces", {"metadata": {"name": "dying"},
+                                    "status": {"phase": "Terminating"}})
+        code, body = self._req(base, "POST", "/api/v1/pods",
+                               _pod("p", "dying"))
+        assert code == 403
+        assert "terminating" in body["error"]
+        # An implicit (objectless) namespace still admits.
+        code, _ = self._req(base, "POST", "/api/v1/pods",
+                            _pod("p", "fresh-ns"))
+        assert code == 201

