@@ -26,7 +26,9 @@ from kubernetes_tpu.api.quantity import milli_value, value
 NAMESPACED_KINDS = frozenset({"pods", "services", "persistentvolumeclaims",
                               "replicationcontrollers", "replicasets",
                               "events", "endpoints", "deployments",
-                              "limitranges", "resourcequotas"})
+                              "limitranges", "resourcequotas",
+                              "daemonsets", "jobs",
+                              "roles", "rolebindings"})
 
 AFFINITY_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/affinity"
 TOLERATIONS_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/tolerations"

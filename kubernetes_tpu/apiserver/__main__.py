@@ -23,6 +23,13 @@ def main(argv=None) -> int:
                         "(tokenfile authenticator)")
     p.add_argument("--authorization-policy-file", default="",
                    help="ABAC policy file, one JSON object per line")
+    p.add_argument("--authorization-mode", default="",
+                   choices=["", "ABAC", "RBAC"],
+                   help="RBAC authorizes from live Role/RoleBinding/"
+                        "ClusterRole/ClusterRoleBinding objects "
+                        "(system:masters group bypasses, the bootstrap "
+                        "superuser convention); default ABAC when a "
+                        "policy file is given")
     p.add_argument("--storage-dir", default="",
                    help="durable storage directory (snapshot + WAL): a "
                         "restart recovers objects and the resourceVersion "
@@ -30,25 +37,43 @@ def main(argv=None) -> int:
     p.add_argument("--storage-fsync", action="store_true",
                    help="fsync the WAL per write (etcd's default "
                         "durability; slower)")
+    p.add_argument("--tls-cert-file", default="",
+                   help="serve HTTPS with this certificate (the secure "
+                        "port)")
+    p.add_argument("--tls-private-key-file", default="")
+    p.add_argument("--client-ca-file", default="",
+                   help="verify client certificates against this CA; a "
+                        "verified cert's CN/O become the request's "
+                        "user/groups (x509 authenticator)")
     opts = p.parse_args(argv)
-    auth = None
-    if opts.token_auth_file or opts.authorization_policy_file:
-        from kubernetes_tpu.apiserver.auth import (ABACAuthorizer,
-                                                   AuthConfig,
-                                                   TokenAuthenticator)
-        auth = AuthConfig(
-            authenticator=TokenAuthenticator.from_file(opts.token_auth_file)
-            if opts.token_auth_file else None,
-            authorizer=ABACAuthorizer.from_file(
-                opts.authorization_policy_file)
-            if opts.authorization_policy_file else None)
     # share_events: this process's only consumers are HTTP watch streams
     # (read-only serializers), so events may reference stored objects
     # directly — no per-write deepcopy (see MemStore.__init__).
     store = MemStore(share_events=True,
                      storage_dir=opts.storage_dir or None,
                      fsync=opts.storage_fsync)
-    server = serve(store, port=opts.port, host=opts.host, auth=auth)
+    auth = None
+    if opts.token_auth_file or opts.authorization_policy_file or \
+            opts.authorization_mode == "RBAC":
+        from kubernetes_tpu.apiserver.auth import (ABACAuthorizer,
+                                                   AuthConfig,
+                                                   RBACAuthorizer,
+                                                   TokenAuthenticator)
+        if opts.authorization_mode == "RBAC":
+            authorizer = RBACAuthorizer(store)
+        elif opts.authorization_policy_file:
+            authorizer = ABACAuthorizer.from_file(
+                opts.authorization_policy_file)
+        else:
+            authorizer = None
+        auth = AuthConfig(
+            authenticator=TokenAuthenticator.from_file(opts.token_auth_file)
+            if opts.token_auth_file else None,
+            authorizer=authorizer)
+    server = serve(store, port=opts.port, host=opts.host, auth=auth,
+                   tls_cert=opts.tls_cert_file,
+                   tls_key=opts.tls_private_key_file,
+                   client_ca=opts.client_ca_file)
     print(f"apiserver listening on {server.server_address[0]}:"
           f"{server.server_address[1]}", file=sys.stderr, flush=True)
     stop = threading.Event()

@@ -105,18 +105,116 @@ class ABACAuthorizer:
         return False
 
 
+def user_from_cert(cert: dict) -> UserInfo:
+    """x509 request authenticator (plugin/pkg/auth/authenticator/request/
+    x509 CommonNameUserConversion): a VERIFIED client certificate's
+    subject CN is the user name; O entries become groups."""
+    cn = ""
+    orgs: list[str] = []
+    for rdn in cert.get("subject", ()):
+        for key, value in rdn:
+            if key == "commonName":
+                cn = value
+            elif key == "organizationName":
+                orgs.append(value)
+    return UserInfo(name=cn or "system:anonymous", groups=tuple(orgs))
+
+
+# HTTP method -> RBAC verb (pkg/apiserver request attribute mapping).
+_METHOD_VERBS = {"GET": "get", "POST": "create", "PUT": "update",
+                 "DELETE": "delete", "HEAD": "get"}
+
+# The reference's superuser convention: system:masters bypasses RBAC
+# (pkg/registry + the --authorization-rbac-super-user bootstrap) — without
+# it an RBAC-only apiserver could never receive its first RoleBinding.
+SUPER_GROUP = "system:masters"
+
+
+class RBACAuthorizer:
+    """Alpha RBAC (pkg/apis/rbac; plugin/pkg/auth/authorizer/rbac):
+    Roles/ClusterRoles hold rules {verbs, resources}; RoleBindings/
+    ClusterRoleBindings grant them to User/Group subjects.  Reads the
+    live objects from the store on every check — a kubectl-created
+    binding takes effect immediately, like the reference's informers."""
+
+    def __init__(self, store):
+        self._store = store
+
+    @staticmethod
+    def _rule_covers(rule: dict, verb: str, resource: str) -> bool:
+        verbs = rule.get("verbs") or []
+        resources = rule.get("resources") or []
+        return ("*" in verbs or verb in verbs) and \
+            ("*" in resources or resource in resources)
+
+    @staticmethod
+    def _subject_matches(subj: dict, user: UserInfo) -> bool:
+        kind = subj.get("kind", "User")
+        name = subj.get("name", "")
+        if kind == "User":
+            return name == "*" or name == user.name
+        if kind == "Group":
+            return name in user.groups
+        return False
+
+    def _role_rules(self, ref: dict, namespace: str) -> list[dict]:
+        kind = ref.get("kind", "Role")
+        name = ref.get("name", "")
+        if kind == "ClusterRole":
+            obj = self._store.get("clusterroles", name)
+        else:
+            obj = self._store.get("roles", f"{namespace}/{name}")
+        return (obj or {}).get("rules") or []
+
+    def authorize(self, user: UserInfo, verb: str, resource: str,
+                  namespace: str = "") -> bool:
+        if SUPER_GROUP in user.groups:
+            return True
+        rbac_verb = _METHOD_VERBS.get(verb, verb.lower())
+        try:
+            crbs, _ = self._store.list("clusterrolebindings")
+            # A RoleBinding authorizes ONLY inside its own namespace: a
+            # namespace-less request (cluster-scoped resource or flat
+            # cluster-wide list) is judged by ClusterRoleBindings alone —
+            # otherwise one team-a grant would leak cluster-wide reads.
+            if namespace:
+                rbs, _ = self._store.list(
+                    "rolebindings",
+                    lambda o: (o.get("metadata") or {})
+                    .get("namespace", "default") == namespace)
+            else:
+                rbs = []
+        except Exception:  # noqa: BLE001 — store unreadable: deny
+            return False
+        for binding in list(crbs) + list(rbs):
+            subjects = binding.get("subjects") or []
+            if not any(self._subject_matches(s, user) for s in subjects):
+                continue
+            ref = binding.get("roleRef") or {}
+            bns = (binding.get("metadata") or {}).get(
+                "namespace", "default")
+            for rule in self._role_rules(ref, bns):
+                if self._rule_covers(rule, rbac_verb, resource):
+                    return True
+        return False
+
+
 @dataclass
 class AuthConfig:
     """The chain the server consults; either part may be absent."""
 
     authenticator: Optional[TokenAuthenticator] = None
-    authorizer: Optional[ABACAuthorizer] = None
+    authorizer: Optional[object] = None   # ABACAuthorizer | RBACAuthorizer
 
-    def check(self, authorization: str, verb: str,
-              resource: str) -> Optional[tuple[int, str]]:
-        """None = allowed; else (status, message)."""
-        user = None
-        if self.authenticator is not None:
+    def check(self, authorization: str, verb: str, resource: str,
+              namespace: str = "",
+              peer_user: Optional[UserInfo] = None
+              ) -> Optional[tuple[int, str]]:
+        """None = allowed; else (status, message).  ``peer_user`` is a
+        verified-client-cert identity (x509 authenticator): it outranks
+        the token layer, as the reference's request-auth union does."""
+        user = peer_user
+        if user is None and self.authenticator is not None:
             try:
                 user = self.authenticator.authenticate(authorization)
             except AuthenticationError as err:
@@ -124,7 +222,12 @@ class AuthConfig:
         if self.authorizer is not None:
             if user is None:
                 user = UserInfo(name="system:anonymous")
-            if not self.authorizer.authorize(user, verb, resource):
+            if isinstance(self.authorizer, RBACAuthorizer):
+                allowed = self.authorizer.authorize(user, verb, resource,
+                                                    namespace)
+            else:
+                allowed = self.authorizer.authorize(user, verb, resource)
+            if not allowed:
                 return 403, (f"user {user.name!r} is not allowed to "
                              f"{verb} {resource}")
         return None

@@ -87,6 +87,25 @@ def make_handler(store: MemStore, auth=None):
             self.connection.settimeout(120.0)
 
         def handle(self):
+            # Deferred TLS handshake (see serve()): completes here, in
+            # this connection's thread, bounded by setup()'s 120 s socket
+            # deadline.  A verified client certificate then authenticates
+            # the whole connection (x509 request authenticator): subject
+            # CN is the user, O entries the groups; it outranks tokens.
+            self._peer_user = None
+            if hasattr(self.connection, "do_handshake"):
+                import ssl
+                try:
+                    self.connection.do_handshake()
+                except (ssl.SSLError, TimeoutError, OSError):
+                    return  # bad/absent TLS from the peer: drop quietly
+                try:
+                    cert = self.connection.getpeercert()
+                except ValueError:
+                    cert = None
+                if cert:
+                    from kubernetes_tpu.apiserver.auth import user_from_cert
+                    self._peer_user = user_from_cert(cert)
             try:
                 self._handle_loop()
             except (TimeoutError, OSError):
@@ -146,8 +165,10 @@ def make_handler(store: MemStore, auth=None):
                         # Resource name for ABAC: the {kind} segment of
                         # API paths; top-level paths (healthz, metrics)
                         # are their own nameable resources.
+                        ns = ""
                         if len(parts) >= 5 and parts[2] == "namespaces":
                             resource = parts[4]
+                            ns = parts[3]
                         elif len(parts) >= 3 and parts[:2] == ["api", "v1"]:
                             resource = parts[2]
                         elif parts:
@@ -155,7 +176,8 @@ def make_handler(store: MemStore, auth=None):
                         else:
                             resource = ""
                         denied = auth.check(authz, method.decode(),
-                                            resource)
+                                            resource, ns,
+                                            peer_user=self._peer_user)
                         if denied is not None:
                             code, msg = denied
                             self._send_json(code, {"error": msg})
@@ -465,12 +487,48 @@ class _Server(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     request_queue_size = 128
 
+    def handle_error(self, request, client_address):
+        # TLS handshake failures and peer resets are routine connection
+        # noise (a port scanner, a curl without the CA), not tracebacks.
+        import ssl
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ssl.SSLError, ConnectionError,
+                            TimeoutError, OSError)):
+            return
+        super().handle_error(request, client_address)
+
 
 def serve(store: MemStore, port: int = 0,
-          host: str = "127.0.0.1", auth=None) -> _Server:
+          host: str = "127.0.0.1", auth=None,
+          tls_cert: str = "", tls_key: str = "",
+          client_ca: str = "") -> _Server:
     """``auth``: an apiserver.auth.AuthConfig; None = the reference's
-    insecure port (no authn/z)."""
+    insecure port (no authn/z).
+
+    ``tls_cert``/``tls_key`` serve HTTPS (the reference's secure port);
+    ``client_ca`` additionally verifies client certificates against that
+    CA, and a verified cert's subject becomes the request's user (CN ->
+    name, O -> groups — the x509 request authenticator,
+    plugin/pkg/auth/authenticator/request/x509), taking precedence over
+    bearer tokens."""
     server = _Server((host, port), make_handler(store, auth))
+    if tls_cert:
+        import ssl
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(tls_cert, tls_key or None)
+        if client_ca:
+            ctx.load_verify_locations(client_ca)
+            # OPTIONAL: token-bearing clients without certs still pass
+            # TLS and then authenticate at the token layer.
+            ctx.verify_mode = ssl.CERT_OPTIONAL
+        # Handshake-on-first-read, NOT on accept: with the default, the
+        # handshake runs inside the single serve_forever accept loop, so
+        # one stalled client (connect, send nothing) would freeze every
+        # new connection.  Deferred, it runs in the per-connection handler
+        # thread under that connection's own timeout.
+        server.socket = ctx.wrap_socket(server.socket, server_side=True,
+                                        do_handshake_on_connect=False)
     t = threading.Thread(target=server.serve_forever, daemon=True,
                          name="apiserver-http")
     t.start()

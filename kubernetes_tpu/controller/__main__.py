@@ -23,8 +23,10 @@ import socket
 import sys
 import threading
 
+from kubernetes_tpu.controller.daemonset import DaemonSetController
 from kubernetes_tpu.controller.deployment import DeploymentController
 from kubernetes_tpu.controller.endpoints import EndpointsController
+from kubernetes_tpu.controller.job import JobController
 from kubernetes_tpu.controller.namespace import NamespaceController
 from kubernetes_tpu.controller.node import NodeLifecycleController
 from kubernetes_tpu.controller.replication import ReplicationManager
@@ -68,8 +70,13 @@ def main(argv=None) -> int:
             EndpointsController(opts.api_server, token=tok).run())
         controllers.append(
             NamespaceController(opts.api_server, token=tok).run())
+        controllers.append(
+            DaemonSetController(opts.api_server, token=tok).run())
+        controllers.append(
+            JobController(opts.api_server, token=tok).run())
         log.info("controller-manager running (replication + deployment + "
-                 "node lifecycle + endpoints + namespace)")
+                 "node lifecycle + endpoints + namespace + daemonset + "
+                 "job)")
 
     elector = None
     if opts.leader_elect:

@@ -124,7 +124,9 @@ class GenericScheduler:
         self.policy = policy or default_provider()
         self.cache = cache or SchedulerCache()
         self.listers = listers or Listers()
-        self.solver = sv.Solver(self.policy)
+        # Shared per policy signature: a fresh Solver per engine would
+        # re-trace and re-compile every executable (see Solver.for_policy).
+        self.solver = sv.Solver.for_policy(self.policy)
         self.extenders = [HTTPExtender(cfg) for cfg in self.policy.extenders]
         self.last_node_index = np.uint32(0)
         # Monotonic compile state (features.padcap): table-axis capacities
@@ -334,7 +336,9 @@ class GenericScheduler:
 
         The last chunk is padded with inert pods (live=False rows are
         infeasible everywhere and bump no tie counter) so every chunk hits
-        the same compiled executable."""
+        the same compiled executable.  (A pow2 tail-bucket ladder was
+        measured and REJECTED: on a tunneled chip each extra chunk launch
+        costs a full RTT, which dwarfs the dead padded rows it saves.)"""
         p = len(pods)
         if p == 0:
             return

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from typing import Any, NamedTuple
 
 import jax
@@ -379,7 +380,29 @@ def _priority_plane(name: str, b: DeviceBatch, c: DeviceCluster,
 
 
 class Solver:
-    """Compiles a Policy into jitted evaluate / sequential-solve callables."""
+    """Compiles a Policy into jitted evaluate / sequential-solve callables.
+
+    Solvers are stateless (the policy-derived constants plus XLA
+    executables keyed on them), so ``Solver.for_policy`` shares one
+    instance per distinct derived signature process-wide: jit caches are
+    keyed on the Solver object (static argnum 0), and a fresh Solver per
+    daemon/engine instance silently re-traced and re-compiled every
+    executable (~15-40 s per rig at the 30k/5k shape)."""
+
+    _registry: dict = {}
+    _registry_lock = threading.Lock()
+
+    @classmethod
+    def for_policy(cls, policy: Policy) -> "Solver":
+        candidate = cls(policy)
+        key = (candidate.predicate_names, candidate.priority_specs,
+               tuple(sorted(candidate.extra.items())))
+        with cls._registry_lock:
+            existing = cls._registry.get(key)
+            if existing is not None:
+                return existing
+            cls._registry[key] = candidate
+            return candidate
 
     def __init__(self, policy: Policy):
         self.policy = policy

@@ -50,6 +50,14 @@ ALIASES = {
     "resourcequotas": "resourcequotas",
     "ns": "namespaces", "namespace": "namespaces",
     "namespaces": "namespaces",
+    "ds": "daemonsets", "daemonset": "daemonsets",
+    "daemonsets": "daemonsets",
+    "job": "jobs", "jobs": "jobs",
+    "role": "roles", "roles": "roles",
+    "rolebinding": "rolebindings", "rolebindings": "rolebindings",
+    "clusterrole": "clusterroles", "clusterroles": "clusterroles",
+    "clusterrolebinding": "clusterrolebindings",
+    "clusterrolebindings": "clusterrolebindings",
 }
 
 # Kinds whose storage keys carry a namespace (matches the apiserver).
@@ -200,6 +208,12 @@ _KIND_FIELD_TO_RESOURCE = {
     "limitrange": "limitranges",
     "resourcequota": "resourcequotas",
     "namespace": "namespaces",
+    "daemonset": "daemonsets",
+    "job": "jobs",
+    "role": "roles",
+    "rolebinding": "rolebindings",
+    "clusterrole": "clusterroles",
+    "clusterrolebinding": "clusterrolebindings",
 }
 
 
@@ -219,6 +233,47 @@ def cmd_create(client: APIClient, opts, out) -> int:
             print(f"{resource[:-1]}/{name} created", file=out)
         except APIError as err:
             print(f"error creating from {opts.filename}: {err}",
+                  file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def cmd_apply(client: APIClient, opts, out) -> int:
+    """kubectl apply (pkg/kubectl/cmd/apply.go, the declarative verb):
+    create the object if absent, else replace it — the submitted spec is
+    the desired state.  The replace carries the live resourceVersion so a
+    concurrent writer wins the CAS and apply reports the conflict."""
+    rc = 0
+    for doc in _load_documents(opts.filename):
+        kind_field = doc.get("kind", "Pod").lower()
+        resource = _KIND_FIELD_TO_RESOURCE.get(kind_field)
+        if resource is None:
+            print(f'error: unsupported kind "{doc.get("kind")}"',
+                  file=sys.stderr)
+            rc = 1
+            continue
+        meta = doc.setdefault("metadata", {})
+        name = meta.get("name", "")
+        if resource in NAMESPACED_KINDS:
+            meta.setdefault("namespace", "default")
+            key = f"{meta['namespace']}/{name}"
+        else:
+            key = name
+        try:
+            current = client.get(resource, key)
+        except APIError:
+            current = None
+        try:
+            if current is None:
+                client.create(resource, doc)
+                print(f"{resource[:-1]}/{name} created", file=out)
+            else:
+                meta["resourceVersion"] = \
+                    (current.get("metadata") or {}).get("resourceVersion")
+                client.update(resource, doc)
+                print(f"{resource[:-1]}/{name} configured", file=out)
+        except APIError as err:
+            print(f"error applying {resource}/{name}: {err}",
                   file=sys.stderr)
             rc = 1
     return rc
@@ -367,6 +422,62 @@ def _set_unschedulable(client: APIClient, name: str, value: bool,
     return 0
 
 
+def cmd_drain(client: APIClient, opts, out) -> int:
+    """kubectl drain (pkg/kubectl/cmd/drain.go): cordon the node, then
+    delete every pod on it.  Pods not managed by an RC/RS/Deployment (no
+    controller will re-create them elsewhere) are refused without
+    --force, the reference's safety rule."""
+    # One selector semantics, not a divergent copy: _matches handles both
+    # RC map selectors and RS LabelSelectors (matchLabels+matchExpressions).
+    from kubernetes_tpu.controller.replication import _matches
+    name = opts.name
+    rc_code = _set_unschedulable(client, name, True, out)
+    if rc_code != 0:
+        return rc_code  # nonexistent node must not report a clean drain
+    pods, _ = client.list("pods")
+    mine = [p for p in pods
+            if (p.get("spec") or {}).get("nodeName") == name]
+    if not mine:
+        print(f"node/{name} drained (no pods)", file=out)
+        return 0
+    rcs, _ = client.list("replicationcontrollers")
+    rss, _ = client.list("replicasets")
+
+    def managed(pod: dict) -> bool:
+        pns = (pod.get("metadata") or {}).get("namespace", "default")
+        for owner in rcs + rss:
+            sel = (owner.get("spec") or {}).get("selector") or {}
+            if (owner.get("metadata") or {}).get(
+                    "namespace", "default") == pns and _matches(sel, pod):
+                return True
+        return False
+
+    unmanaged = [p for p in mine if not managed(p)]
+    if unmanaged and not opts.force:
+        names = ", ".join((p.get("metadata") or {}).get("name", "")
+                          for p in unmanaged)
+        print(f"error: pods not managed by ReplicationController/"
+              f"ReplicaSet (use --force to override): {names}", file=out)
+        return 1
+    failures = 0
+    for p in mine:
+        meta = p.get("metadata") or {}
+        pns = meta.get("namespace", "default")
+        try:
+            client.delete("pods", f"{pns}/{meta.get('name')}")
+            print(f"pod/{meta.get('name')} evicted", file=out)
+        except APIError as err:
+            failures += 1
+            print(f"error evicting pod/{meta.get('name')}: {err}",
+                  file=out)
+    if failures:
+        print(f"error: node/{name} NOT fully drained "
+              f"({failures} eviction(s) failed)", file=out)
+        return 1
+    print(f"node/{name} drained", file=out)
+    return 0
+
+
 def main(argv=None, out=sys.stdout) -> int:
     p = argparse.ArgumentParser(prog="kubectl (kubernetes_tpu)",
                                 description=__doc__)
@@ -391,6 +502,9 @@ def main(argv=None, out=sys.stdout) -> int:
     c = sub.add_parser("create")
     c.add_argument("-f", "--filename", required=True)
 
+    ap = sub.add_parser("apply")
+    ap.add_argument("-f", "--filename", required=True)
+
     x = sub.add_parser("delete")
     x.add_argument("resource")
     x.add_argument("name")
@@ -399,6 +513,11 @@ def main(argv=None, out=sys.stdout) -> int:
     for verb in ("cordon", "uncordon"):
         v = sub.add_parser(verb)
         v.add_argument("name")
+
+    dr = sub.add_parser("drain")
+    dr.add_argument("name")
+    dr.add_argument("--force", action="store_true",
+                    help="also evict pods no controller will re-create")
 
     sc = sub.add_parser("scale")
     sc.add_argument("resource")
@@ -422,12 +541,16 @@ def main(argv=None, out=sys.stdout) -> int:
         return cmd_describe(client, opts, out)
     if opts.cmd == "create":
         return cmd_create(client, opts, out)
+    if opts.cmd == "apply":
+        return cmd_apply(client, opts, out)
     if opts.cmd == "delete":
         return cmd_delete(client, opts, out)
     if opts.cmd == "cordon":
         return _set_unschedulable(client, opts.name, True, out)
     if opts.cmd == "uncordon":
         return _set_unschedulable(client, opts.name, False, out)
+    if opts.cmd == "drain":
+        return cmd_drain(client, opts, out)
     if opts.cmd == "scale":
         return cmd_scale(client, opts, out)
     if opts.cmd == "rollout":

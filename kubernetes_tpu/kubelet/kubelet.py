@@ -138,12 +138,18 @@ class HollowKubelet:
                 self._running.pop(key, None)
                 if hasattr(self, "_ip_leases"):
                     self._ip_leases.pop(key, None)  # free the IP lease
+                getattr(self, "_completing", set()).discard(key)
             return
         phase = (obj.get("status") or {}).get("phase", "")
         if phase in ("Running", "Failed", "Succeeded"):
             if phase == "Running":
                 with self._lock:
                     self._running.setdefault(key, api.pod_from_json(obj))
+                # Re-arm completion on redelivery: a lost CAS on the
+                # Running->Succeeded write surfaces as another Running
+                # event, and without this the pod (and its Job) would
+                # stay Running forever.
+                self._maybe_schedule_completion(key, obj)
             return
         pod = api.pod_from_json(obj)
         with self._lock:
@@ -152,6 +158,55 @@ class HollowKubelet:
                 self._running[key] = pod
         self._set_phase(obj, "Running" if admitted else "Failed",
                         "" if admitted else "OutOfResources")
+        if admitted:
+            self._maybe_schedule_completion(key, obj)
+
+    # Run-to-completion simulation (the hollow runtime's analogue of a
+    # container exiting 0): a pod annotated with a run duration flips
+    # Running -> Succeeded after that many seconds — what Job pods do on
+    # a real kubelet when their process exits.
+    RUN_DURATION_ANN = "kubemark.kubernetes.io/run-duration"
+
+    def _maybe_schedule_completion(self, key: str, obj: dict) -> None:
+        ann = (obj.get("metadata") or {}).get("annotations") or {}
+        try:
+            dur = float(ann.get(self.RUN_DURATION_ANN, ""))
+        except ValueError:
+            return
+        with self._lock:
+            if not hasattr(self, "_completing"):
+                self._completing: set[str] = set()
+            if key in self._completing:
+                return  # one armed timer per pod
+            self._completing.add(key)
+        # Timers are fire-and-forget daemons (no tracking list to leak);
+        # _complete_pod checks _stop, so a stopped kubelet's stragglers
+        # are inert.
+        t = threading.Timer(max(dur, 0.01), self._complete_pod, args=(key,))
+        t.daemon = True
+        t.start()
+
+    def _complete_pod(self, key: str) -> None:
+        # The timer has fired: clear the armed marker FIRST, so if the
+        # Succeeded CAS below loses to a concurrent writer, the watch's
+        # Running redelivery arms a fresh timer instead of deadlocking
+        # behind a stale marker.
+        with self._lock:
+            getattr(self, "_completing", set()).discard(key)
+        if self._stop.is_set():
+            return
+        try:
+            obj = self.store.get("pods", key)
+        except Exception:  # noqa: BLE001 — apiserver down: the next
+            return         # Running redelivery re-arms
+        if obj is None or (obj.get("spec") or {}).get("nodeName") != \
+                self.node.name:
+            return
+        if (obj.get("status") or {}).get("phase") != "Running":
+            return
+        with self._lock:
+            self._running.pop(key, None)
+        self._set_phase(obj, "Succeeded", "Completed")
 
     def _admit(self, pod: api.Pod, key: str) -> bool:
         """GeneralPredicates at admission (lifecycle/predicate.go) against

@@ -202,6 +202,10 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         # mid-run would re-specialize the scan on the clock (measured
         # ~10 s of XLA recompiles on the first live drain otherwise).
         factory.algorithm._compile(pods, device=False)
+        # 2*chunk pods: warms BOTH full-chunk jit specializations (the
+        # first chunk carries no state dict, later chunks do — two
+        # distinct signatures); any shape first seen mid-run would
+        # XLA-compile on the clock (~5 s).
         warm_pods = synth.make_pods(
             min(num_pods, 2 * daemon.stream_chunk_size()),
             profile=profile, name_prefix="warm")

@@ -67,7 +67,48 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default="",
                    help="write jax.profiler device traces of every solve "
                         "here (also KT_PROFILE_DIR; view with XProf)")
+    p.add_argument("--config", default="",
+                   help="KubeSchedulerConfiguration JSON file "
+                        "(componentconfig/types.go:426-457); explicit "
+                        "flags override file values")
+    p.add_argument("--feature-gates", default="",
+                   help="comma-separated Name=true|false pairs "
+                        "(BatchBindings, StreamingDrain, JointSolver)")
     return p
+
+
+def apply_component_config(p: argparse.ArgumentParser, argv):
+    """--config provides flag DEFAULTS, explicit flags override (the
+    reference's scheme-defaults-then-flags order).  Returns parsed opts
+    with the validated config folded in."""
+    pre, _ = p.parse_known_args(argv)
+    if pre.config:
+        from kubernetes_tpu.api.componentconfig import (
+            KubeSchedulerConfiguration)
+        with open(pre.config) as f:
+            cfg = KubeSchedulerConfiguration.from_json(f.read())
+        errors = cfg.validate()
+        if errors:
+            raise SystemExit("invalid --config: " + "; ".join(errors))
+        p.set_defaults(
+            port=cfg.port,
+            algorithm_provider=cfg.algorithm_provider,
+            policy_config_file=cfg.policy_config_file,
+            scheduler_name=cfg.scheduler_name,
+            kube_api_qps=cfg.kube_api_qps,
+            kube_api_burst=cfg.kube_api_burst,
+            hard_pod_affinity_symmetric_weight=(
+                cfg.hard_pod_affinity_symmetric_weight),
+            feature_gates=cfg.feature_gates,
+            enable_profiling=cfg.enable_profiling,
+            leader_elect=cfg.leader_election.leader_elect,
+            leader_elect_lease_duration=cfg.leader_election.lease_duration,
+            leader_elect_renew_deadline=cfg.leader_election.renew_deadline,
+            leader_elect_retry_period=cfg.leader_election.retry_period)
+    opts = p.parse_args(argv)
+    if not hasattr(opts, "enable_profiling"):
+        opts.enable_profiling = True  # reference scheduler default
+    return opts
 
 
 def load_policy(opts):
@@ -117,7 +158,11 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
                            "application/json")
             elif self.path.startswith("/debug/pprof"):
                 # The goroutine-dump analogue (app/server.go:96-100): all
-                # live thread stacks.
+                # live thread stacks.  EnableProfiling=false removes the
+                # handlers, as the reference's mux does (server.go:96).
+                if not configz.get("enableProfiling", True):
+                    self._send(404, b"profiling disabled")
+                    return
                 from kubernetes_tpu.utils.profiling import thread_stacks
                 self._send(200, thread_stacks().encode())
             elif self.path == "/debug/vars":
@@ -139,11 +184,17 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
 
 
 def main(argv=None) -> int:
-    opts = build_parser().parse_args(argv)
+    opts = apply_component_config(build_parser(), argv)
     configure(v=opts.v)
     if opts.profile_dir:
         from kubernetes_tpu.utils.profiling import set_profile_dir
         set_profile_dir(opts.profile_dir)
+    from kubernetes_tpu.utils import featuregate
+    try:
+        gates = featuregate.FeatureGate.parse(opts.feature_gates)
+    except ValueError as err:
+        raise SystemExit(f"--feature-gates: {err}")
+    featuregate.set_default(gates)
     policy = load_policy(opts)
     configz = {
         "apiServer": opts.api_server or "(in-process)",
@@ -153,6 +204,8 @@ def main(argv=None) -> int:
         "kubeAPIQPS": opts.kube_api_qps,
         "kubeAPIBurst": opts.kube_api_burst,
         "leaderElect": opts.leader_elect,
+        "featureGates": gates.as_dict(),
+        "enableProfiling": getattr(opts, "enable_profiling", True),
         "predicates": [s.name for s in policy.predicates],
         "priorities": [[s.name, s.weight] for s in policy.priorities],
     }

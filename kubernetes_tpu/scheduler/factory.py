@@ -83,6 +83,10 @@ class APIClientBinder:
     def bind_many(self, placed: list) -> list:
         """Bind a batch; returns [(pod, err)] failures (the CAS conflicts
         the batched drain forgets + requeues)."""
+        from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATE
+        if not DEFAULT_FEATURE_GATE.enabled("BatchBindings"):
+            # Gated off: the reference's per-bind-goroutine wire behavior.
+            return self._bind_many_fallback(placed)
         if len(placed) <= 2:
             return [f for f in map(self._bind_one, placed) if f is not None]
         failures: list = []

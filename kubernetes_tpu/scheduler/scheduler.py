@@ -153,10 +153,16 @@ class Scheduler:
             return len(pods)
 
     def _solve_drain(self, pods: list) -> int:
-        if len(pods) >= self.STREAM_THRESHOLD and \
+        from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATE
+        joint = DEFAULT_FEATURE_GATE.enabled("JointSolver")
+        # The joint solve needs the whole queue at once (prices couple
+        # every pod); it supersedes the streaming split.
+        streaming = DEFAULT_FEATURE_GATE.enabled("StreamingDrain") \
+            and not joint
+        if streaming and len(pods) >= self.STREAM_THRESHOLD and \
                 not self.config.algorithm.extenders:
             return self._schedule_pending_stream(pods)
-        if len(pods) < self._PAD_LIMIT and \
+        if streaming and len(pods) < self._PAD_LIMIT and \
                 not self.config.algorithm.extenders:
             # Small drain: one power-of-two stream chunk (live-flag
             # padded), so arrival races don't mint a new compiled shape
@@ -164,7 +170,7 @@ class Scheduler:
             bucket = 1 << (len(pods) - 1).bit_length()
             return self._schedule_pending_stream(pods, chunk_size=bucket)
         start = time.perf_counter()
-        placements = self.config.algorithm.schedule_batch(pods)
+        placements = self.config.algorithm.schedule_batch(pods, joint=joint)
         algo_us = (time.perf_counter() - start) * 1e6 / len(pods)
         self.config.metrics.scheduling_algorithm_latency.observe_many(
             algo_us, len(pods))

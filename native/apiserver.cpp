@@ -432,7 +432,7 @@ static std::vector<std::string> validate(const std::string& kind,
 static const std::set<std::string> kNamespaced = {
     "pods", "services", "persistentvolumeclaims", "replicationcontrollers",
     "replicasets", "endpoints", "events", "deployments", "limitranges",
-    "resourcequotas"};
+    "resourcequotas", "daemonsets", "jobs", "roles", "rolebindings"};
 
 struct StoredEvent {
   uint64_t rv;

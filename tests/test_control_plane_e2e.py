@@ -9,6 +9,7 @@ directly.
 
 from __future__ import annotations
 
+import os
 import time
 
 import pytest
@@ -316,7 +317,10 @@ def test_hollow_fleet_kubemark_500_nodes():
             return len(pods) == n_replicas and all(
                 (p.get("status") or {}).get("phase") == "Running"
                 for p in pods)
-        _wait(all_running, timeout=240, period=1.0,
+        # Generous: a contended machine (another process on the device,
+        # suite parallelism) has been observed to stretch settle from
+        # ~75 s standalone to ~4x.
+        _wait(all_running, timeout=480, period=1.0,
               msg=f"{n_replicas} replicas Running on {n_nodes} nodes")
         settle_s = time.time() - t_create
 
@@ -346,12 +350,17 @@ def test_hollow_fleet_kubemark_500_nodes():
         print(f"\nkubemark-500: settle {settle_s:.1f}s, full resync "
               f"{full_ms:.1f}ms, idle dirty pass {dirty_ms:.2f}ms, "
               f"heartbeat writes {hb_writes_per_s:.0f}/s")
-        assert full_ms < 1000, f"full resync {full_ms:.0f}ms"
-        assert dirty_ms < 50, f"idle dirty pass {dirty_ms:.1f}ms"
-        # Liveness floor, not a rate check: under a contended full-suite
-        # run GIL pressure can halve the observed rate (expected ~50/s,
-        # seen as low as 20/s); the ceiling guards against a busy loop.
-        assert 5 <= hb_writes_per_s <= 200, hb_writes_per_s
+        # Wall-clock bars are hardware-dependent; KT_PERF_ASSERTS=0 keeps
+        # the measurement but skips them on contended runners (the
+        # extender perf test's discipline).
+        if os.environ.get("KT_PERF_ASSERTS", "1") != "0":
+            assert full_ms < 1000, f"full resync {full_ms:.0f}ms"
+            assert dirty_ms < 50, f"idle dirty pass {dirty_ms:.1f}ms"
+            # Liveness floor, not a rate check: under a contended
+            # full-suite run GIL pressure can halve the observed rate
+            # (expected ~50/s, seen as low as 20/s); the ceiling guards
+            # against a busy loop.
+            assert 5 <= hb_writes_per_s <= 200, hb_writes_per_s
     finally:
         rm.stop()
         scheduler.stop()

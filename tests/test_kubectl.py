@@ -141,3 +141,95 @@ def test_get_pods_wide(rig):
     rc, out = run(base, "get", "pods", "-o", "wide")
     assert rc == 0
     assert "REQUESTS" in out and "cpu=100m" in out
+
+
+class TestDrain:
+    """kubectl drain (pkg/kubectl/cmd/drain.go): cordon + evict, refusing
+    unmanaged pods without --force."""
+
+    def _managed_pod(self, name, node):
+        d = _pod(name, node=node)
+        d["metadata"]["labels"] = {"run": "web"}
+        return d
+
+    def _rc(self):
+        return {"metadata": {"name": "web", "namespace": "default"},
+                "spec": {"replicas": 2, "selector": {"run": "web"},
+                         "template": {"metadata": {"labels": {"run": "web"}},
+                                      "spec": {"containers": [
+                                          {"name": "c"}]}}}}
+
+    def test_drain_evicts_managed_pods(self, rig):
+        store, base = rig
+        store.create("nodes", _node("n1"))
+        store.create("replicationcontrollers", self._rc())
+        store.create("pods", self._managed_pod("web-a", "n1"))
+        store.create("pods", self._managed_pod("web-b", "n1"))
+        store.create("pods", self._managed_pod("web-c", "n2"))  # elsewhere
+        rc, out = run(base, "drain", "n1")
+        assert rc == 0
+        assert "cordoned" in out and "drained" in out
+        assert store.get("nodes", "n1")["spec"]["unschedulable"] is True
+        assert store.get("pods", "default/web-a") is None
+        assert store.get("pods", "default/web-b") is None
+        assert store.get("pods", "default/web-c") is not None
+
+    def test_drain_refuses_unmanaged_without_force(self, rig):
+        store, base = rig
+        store.create("nodes", _node("n1"))
+        store.create("pods", _pod("naked", node="n1"))
+        rc, out = run(base, "drain", "n1")
+        assert rc == 1
+        assert "--force" in out and "naked" in out
+        # Node is cordoned (the reference cordons before inspecting) but
+        # the pod survives.
+        assert store.get("pods", "default/naked") is not None
+        rc, out = run(base, "drain", "n1", "--force")
+        assert rc == 0
+        assert store.get("pods", "default/naked") is None
+
+    def test_drain_empty_node(self, rig):
+        store, base = rig
+        store.create("nodes", _node("n1"))
+        rc, out = run(base, "drain", "n1")
+        assert rc == 0 and "no pods" in out
+
+
+class TestApply:
+    """kubectl apply (pkg/kubectl/cmd/apply.go): declarative create-or-
+    replace with CAS on the live resourceVersion."""
+
+    def test_apply_creates_then_configures(self, rig, tmp_path):
+        store, base = rig
+        f = tmp_path / "rc.json"
+        rc_obj = {"kind": "ReplicationController",
+                  "metadata": {"name": "web", "namespace": "default"},
+                  "spec": {"replicas": 2, "selector": {"run": "web"},
+                           "template": {"metadata": {"labels":
+                                                     {"run": "web"}},
+                                        "spec": {"containers":
+                                                 [{"name": "c"}]}}}}
+        f.write_text(json.dumps(rc_obj))
+        rc, out = run(base, "apply", "-f", str(f))
+        assert rc == 0 and "created" in out
+        assert store.get("replicationcontrollers",
+                         "default/web")["spec"]["replicas"] == 2
+        rc_obj["spec"]["replicas"] = 5
+        f.write_text(json.dumps(rc_obj))
+        rc, out = run(base, "apply", "-f", str(f))
+        assert rc == 0 and "configured" in out
+        assert store.get("replicationcontrollers",
+                         "default/web")["spec"]["replicas"] == 5
+
+    def test_apply_mixed_documents(self, rig, tmp_path):
+        store, base = rig
+        f = tmp_path / "all.json"
+        f.write_text(json.dumps({"kind": "List", "items": [
+            {"kind": "Namespace", "metadata": {"name": "team-z"}},
+            {"kind": "Pod",
+             "metadata": {"name": "p", "namespace": "team-z"},
+             "spec": {"containers": [{"name": "c"}]}}]}))
+        rc, out = run(base, "apply", "-f", str(f))
+        assert rc == 0, out
+        assert store.get("namespaces", "team-z") is not None
+        assert store.get("pods", "team-z/p") is not None

@@ -1,0 +1,162 @@
+"""TLS + x509 client-certificate auth on the apiserver (the secure port:
+pkg/genericapiserver's TLS serving; plugin/pkg/auth/authenticator/request/
+x509's CN->user, O->groups conversion) — VERDICT r3 missing #6.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import ssl
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    """CA + server cert + two client certs (admin in system:masters via O,
+    and a plain user) minted with the openssl CLI."""
+    d = tmp_path_factory.mktemp("pki")
+
+    def sh(*args):
+        subprocess.run(args, cwd=d, check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    sh("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+       "-keyout", "ca.key", "-out", "ca.crt", "-days", "1",
+       "-subj", "/CN=test-ca")
+    for name, subj in (("server", "/CN=127.0.0.1"),
+                       ("admin", "/O=system:masters/CN=cluster-admin"),
+                       ("alice", "/CN=alice")):
+        sh("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+           "-keyout", f"{name}.key", "-out", f"{name}.csr", "-subj", subj)
+        ext = d / f"{name}.ext"
+        ext.write_text("subjectAltName=IP:127.0.0.1\n"
+                       if name == "server" else "basicConstraints=CA:FALSE\n")
+        sh("openssl", "x509", "-req", "-in", f"{name}.csr", "-CA", "ca.crt",
+           "-CAkey", "ca.key", "-CAcreateserial", "-out", f"{name}.crt",
+           "-days", "1", "-extfile", str(ext))
+    return d
+
+
+@pytest.fixture(scope="module")
+def secure_server(pki):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubernetes_tpu.apiserver",
+         "--port", str(port),
+         "--tls-cert-file", str(pki / "server.crt"),
+         "--tls-private-key-file", str(pki / "server.key"),
+         "--client-ca-file", str(pki / "ca.crt"),
+         "--authorization-mode", "RBAC"],
+        env=dict(os.environ, PYTHONPATH=REPO), cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    yield pki, f"https://127.0.0.1:{port}"
+    proc.kill()
+
+
+def _client_ctx(pki, cert=None):
+    ctx = ssl.create_default_context(cafile=str(pki / "ca.crt"))
+    if cert:
+        ctx.load_cert_chain(str(pki / f"{cert}.crt"),
+                            str(pki / f"{cert}.key"))
+    return ctx
+
+
+def _req(url, path, ctx, method="GET", obj=None):
+    data = json.dumps(obj).encode() if obj is not None else None
+    r = urllib.request.Request(url + path, data=data, method=method,
+                               headers={"Content-Type": "application/json"})
+    def _body(raw):
+        try:
+            return json.loads(raw or b"{}")
+        except ValueError:
+            return {"raw": raw.decode(errors="replace")}
+    try:
+        with urllib.request.urlopen(r, timeout=10, context=ctx) as resp:
+            return resp.status, _body(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, _body(err.read())
+
+
+def _wait_up(url, ctx):
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            _req(url, "/healthz", ctx)
+            return
+        except (OSError, ssl.SSLError):
+            time.sleep(0.2)
+    raise RuntimeError("secure apiserver never came up")
+
+
+def test_cert_subject_becomes_user(secure_server):
+    """O=system:masters cert bypasses RBAC; a plain-CN cert is a plain
+    user who needs a binding; certless https is anonymous -> 403."""
+    pki, url = secure_server
+    admin = _client_ctx(pki, "admin")
+    _wait_up(url, admin)
+    code, _ = _req(url, "/api/v1/pods", admin)
+    assert code == 200  # system:masters group from O
+    code, _ = _req(url, "/api/v1/pods", _client_ctx(pki, "alice"))
+    assert code == 403  # authenticated as alice, no grant yet
+    code, _ = _req(url, "/api/v1/pods", _client_ctx(pki))
+    assert code == 403  # anonymous
+    # Admin grants alice read via RBAC over the same TLS surface.
+    assert _req(url, "/api/v1/clusterroles", admin, "POST",
+                {"metadata": {"name": "reader"},
+                 "rules": [{"verbs": ["get"],
+                            "resources": ["pods"]}]})[0] == 201
+    assert _req(url, "/api/v1/clusterrolebindings", admin, "POST",
+                {"metadata": {"name": "alice-reads"},
+                 "subjects": [{"kind": "User", "name": "alice"}],
+                 "roleRef": {"kind": "ClusterRole",
+                             "name": "reader"}})[0] == 201
+    code, _ = _req(url, "/api/v1/pods", _client_ctx(pki, "alice"))
+    assert code == 200
+    code, _ = _req(url, "/api/v1/pods", _client_ctx(pki, "alice"), "POST",
+                   {"metadata": {"name": "nope"},
+                    "spec": {"containers": [{"name": "c"}]}})
+    assert code == 403
+
+
+def test_untrusted_client_cert_rejected_at_handshake(secure_server, pki,
+                                                     tmp_path):
+    """A client cert from a DIFFERENT CA fails TLS verification."""
+    d = tmp_path
+
+    def sh(*args):
+        subprocess.run(args, cwd=d, check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    sh("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+       "-keyout", "evil-ca.key", "-out", "evil-ca.crt", "-days", "1",
+       "-subj", "/CN=evil-ca")
+    sh("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+       "-keyout", "mallory.key", "-out", "mallory.csr",
+       "-subj", "/O=system:masters/CN=mallory")
+    sh("openssl", "x509", "-req", "-in", "mallory.csr",
+       "-CA", "evil-ca.crt", "-CAkey", "evil-ca.key", "-CAcreateserial",
+       "-out", "mallory.crt", "-days", "1")
+    _, url = secure_server
+    ctx = ssl.create_default_context(cafile=str(pki / "ca.crt"))
+    ctx.load_cert_chain(str(d / "mallory.crt"), str(d / "mallory.key"))
+    with pytest.raises((ssl.SSLError, urllib.error.URLError,
+                        ConnectionError, OSError)):
+        _req(url, "/api/v1/pods", ctx)
+
+
+def test_plaintext_client_cannot_speak(secure_server):
+    _, url = secure_server
+    plain = url.replace("https://", "http://")
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        urllib.request.urlopen(plain + "/healthz", timeout=5)

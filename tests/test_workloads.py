@@ -1,0 +1,192 @@
+"""DaemonSet + Job controllers (pkg/controller/daemon, pkg/controller/job)
+— run-to-completion and one-pod-per-node workloads over the apiserver
+surface, with the hollow kubelet's run-duration completion simulating
+container exit.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.controller.daemonset import DaemonSetController
+from kubernetes_tpu.controller.job import JobController
+from kubernetes_tpu.kubelet.kubelet import HollowKubelet
+
+
+def _node(name, labels=None):
+    return api.Node(
+        name=name, labels={api.HOSTNAME_LABEL: name, **(labels or {})},
+        allocatable_milli_cpu=8000, allocatable_memory=32 * 1024 ** 3,
+        allocatable_pods=110,
+        conditions=[api.NodeCondition("Ready", "True")])
+
+
+def _wait(cond, timeout=30.0, period=0.1, msg=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(period)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestDaemonSet:
+    def _ds(self, name="logd", node_selector=None):
+        spec = {"containers": [{"name": "c"}]}
+        if node_selector:
+            spec["nodeSelector"] = node_selector
+        return {"metadata": {"name": name, "namespace": "default"},
+                "spec": {"template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": spec}}}
+
+    def test_one_pod_per_eligible_node(self):
+        store = MemStore()
+        for nd in (_node("n0", {"disk": "ssd"}), _node("n1", {"disk": "ssd"}),
+                   _node("n2")):
+            store.create("nodes", {"metadata": {"name": nd.name,
+                                                "labels": dict(nd.labels)},
+                                   "status": {}})
+        dc = DaemonSetController(store, sync_period=0.1).run()
+        try:
+            store.create("daemonsets",
+                         self._ds(node_selector={"disk": "ssd"}))
+
+            def placed():
+                items, _ = store.list("pods")
+                nodes = sorted((o.get("spec") or {}).get("nodeName", "")
+                               for o in items)
+                return nodes == ["n0", "n1"] and nodes
+            _wait(placed, msg="one DS pod on each ssd node")
+            # Direct placement: the controller set nodeName, no scheduler
+            # involved, and the unlabeled node got nothing.
+            ds = store.get("daemonsets", "default/logd")
+            assert ds["status"]["desiredNumberScheduled"] == 2
+            # A new eligible node gets its daemon.
+            store.create("nodes", {"metadata": {"name": "n3", "labels":
+                                                {"disk": "ssd"}},
+                                   "status": {}})
+            _wait(lambda: sum(
+                1 for o in store.list("pods")[0]
+                if (o.get("spec") or {}).get("nodeName") == "n3") == 1,
+                msg="daemon lands on the new node")
+        finally:
+            dc.stop()
+
+    def test_ineligible_and_duplicate_pods_pruned(self):
+        store = MemStore()
+        store.create("nodes", {"metadata": {"name": "n0", "labels":
+                                            {"disk": "ssd"}}, "status": {}})
+        dc = DaemonSetController(store, sync_period=0.1).run()
+        try:
+            store.create("daemonsets",
+                         self._ds(node_selector={"disk": "ssd"}))
+            _wait(lambda: len(store.list("pods")[0]) == 1, msg="daemon up")
+            # Inject a duplicate on the same node: pruned back to one.
+            dup = {"metadata": {"name": "logd-dup", "namespace": "default",
+                                "labels": {"daemonset-name": "logd"}},
+                   "spec": {"nodeName": "n0",
+                            "containers": [{"name": "c"}]}}
+            store.create("pods", dup)
+            _wait(lambda: len(store.list("pods")[0]) == 1,
+                  msg="duplicate pruned")
+            # Node loses the label: its daemon is removed.
+            nd = store.get("nodes", "n0")
+            nd["metadata"]["labels"] = {}
+            store.update("nodes", nd)
+            _wait(lambda: len(store.list("pods")[0]) == 0,
+                  msg="daemon removed from ineligible node")
+        finally:
+            dc.stop()
+
+    def test_daemons_ignore_cordon(self):
+        """DS pods bypass the scheduler: a cordoned (unschedulable) node
+        still runs its daemon (controller.go's nodeShouldRunDaemonPod)."""
+        store = MemStore()
+        store.create("nodes", {"metadata": {"name": "n0"},
+                               "spec": {"unschedulable": True},
+                               "status": {}})
+        dc = DaemonSetController(store, sync_period=0.1).run()
+        try:
+            store.create("daemonsets", self._ds())
+            _wait(lambda: len(store.list("pods")[0]) == 1,
+                  msg="daemon on cordoned node")
+        finally:
+            dc.stop()
+
+
+class TestJob:
+    def _job(self, name="batch", completions=3, parallelism=2,
+             duration="0.3"):
+        return {"metadata": {"name": name, "namespace": "default"},
+                "spec": {"completions": completions,
+                         "parallelism": parallelism,
+                         "template": {
+                             "metadata": {
+                                 "labels": {"app": name},
+                                 "annotations": {
+                                     HollowKubelet.RUN_DURATION_ANN:
+                                         duration}},
+                             "spec": {"containers": [{
+                                 "name": "c", "resources": {
+                                     "requests": {"cpu": "100m"}}}]}}}}
+
+    def test_job_runs_to_completion(self):
+        from kubernetes_tpu.scheduler.factory import ConfigFactory
+        store = MemStore()
+        kubelet = HollowKubelet(store, _node("jn0"),
+                                heartbeat_period=5.0).run()
+        scheduler = ConfigFactory(store).run()
+        jc = JobController(store, sync_period=0.1).run()
+        try:
+            store.create("jobs", self._job())
+
+            def complete():
+                job = store.get("jobs", "default/batch")
+                status = job.get("status") or {}
+                return status.get("succeeded", 0) >= 3 and any(
+                    c.get("type") == "Complete"
+                    for c in status.get("conditions", []))
+            _wait(complete, timeout=60, msg="job completes 3 pods")
+            # Succeeded pods are the job's record — never deleted; and
+            # parallelism bounded the flight: at most 2 + 3 = 5 pods ever
+            # existed (no runaway creation).
+            items, _ = store.list("pods")
+            mine = [o for o in items
+                    if (o["metadata"].get("labels") or {})
+                    .get("job-name") == "batch"]
+            assert sum(1 for o in mine
+                       if (o.get("status") or {}).get("phase")
+                       == "Succeeded") >= 3
+            assert len(mine) <= 5
+            # Settled: no new active pods after completion.
+            time.sleep(0.5)
+            items, _ = store.list("pods")
+            active = [o for o in items
+                      if (o["metadata"].get("labels") or {})
+                      .get("job-name") == "batch"
+                      and (o.get("status") or {}).get("phase")
+                      not in ("Succeeded", "Failed")]
+            assert not active, active
+        finally:
+            jc.stop()
+            scheduler.stop()
+            kubelet.stop()
+
+    def test_parallelism_bounds_active_pods(self):
+        store = MemStore()
+        jc = JobController(store, sync_period=0.1).run()
+        try:
+            store.create("jobs", self._job(name="wide", completions=6,
+                                           parallelism=2))
+            # No kubelet: pods stay Pending (active); controller must hold
+            # at exactly `parallelism` in flight.
+            _wait(lambda: len(store.list("pods")[0]) == 2,
+                  msg="2 active pods")
+            time.sleep(0.6)   # several sync periods: must not overshoot
+            assert len(store.list("pods")[0]) == 2
+        finally:
+            jc.stop()
