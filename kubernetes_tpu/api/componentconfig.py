@@ -47,7 +47,10 @@ class KubeSchedulerConfiguration:
     kube_api_burst: int = 100
     hard_pod_affinity_symmetric_weight: int = 1
     failure_domains: str = DEFAULT_FAILURE_DOMAINS
-    enable_profiling: bool = False
+    # The reference's scheme default for the scheduler is
+    # EnableProfiling=true (v1alpha1 defaults) — a config file that never
+    # mentions it must not silently turn /debug off.
+    enable_profiling: bool = True
     feature_gates: str = ""          # "Name=true,Other=false"
     leader_election: LeaderElectionConfiguration = field(
         default_factory=LeaderElectionConfiguration)
@@ -112,17 +115,41 @@ class KubeSchedulerConfiguration:
         return json.dumps(out, indent=1)
 
     def validate(self) -> list[str]:
-        """Collect-all field errors (validation.go style)."""
+        """Collect-all field errors (validation.go style).  Type errors
+        (a JSON string where a number belongs) are collected too, not
+        raised — the contract is one list with every problem."""
         errors: list[str] = []
-        if not 0 <= self.port <= 65535:
+        num = (int, float)
+        typed = [("port", self.port, num),
+                 ("kubeAPIQPS", self.kube_api_qps, num),
+                 ("kubeAPIBurst", self.kube_api_burst, num),
+                 ("hardPodAffinitySymmetricWeight",
+                  self.hard_pod_affinity_symmetric_weight, num),
+                 ("enableProfiling", self.enable_profiling, bool),
+                 ("leaderElection.leaseDuration",
+                  self.leader_election.lease_duration, num),
+                 ("leaderElection.renewDeadline",
+                  self.leader_election.renew_deadline, num)]
+        bad_types = set()
+        for fieldname, value, kinds in typed:
+            # bool is an int subclass: a JSON true for a numeric field
+            # should still be flagged.
+            if not isinstance(value, kinds) or \
+                    (kinds is num and isinstance(value, bool)):
+                errors.append(f"{fieldname}: expected a "
+                              f"{'number' if kinds is num else 'boolean'},"
+                              f" got {value!r}")
+                bad_types.add(fieldname)
+        if "port" not in bad_types and not 0 <= self.port <= 65535:
             errors.append(f"port: {self.port} not in 0-65535")
-        if not 0 <= self.hard_pod_affinity_symmetric_weight <= 100:
+        if "hardPodAffinitySymmetricWeight" not in bad_types and \
+                not 0 <= self.hard_pod_affinity_symmetric_weight <= 100:
             errors.append("hardPodAffinitySymmetricWeight: "
                           f"{self.hard_pod_affinity_symmetric_weight} "
                           "not in 0-100")
-        if self.kube_api_qps < 0:
+        if "kubeAPIQPS" not in bad_types and self.kube_api_qps < 0:
             errors.append(f"kubeAPIQPS: {self.kube_api_qps} negative")
-        if self.kube_api_burst < 0:
+        if "kubeAPIBurst" not in bad_types and self.kube_api_burst < 0:
             errors.append(f"kubeAPIBurst: {self.kube_api_burst} negative")
         if self.algorithm_provider not in ("DefaultProvider",
                                            "ClusterAutoscalerProvider"):
@@ -137,7 +164,9 @@ class KubeSchedulerConfiguration:
                           "supported by this build (fixed to "
                           f"{DEFAULT_FAILURE_DOMAINS!r})")
         le = self.leader_election
-        if le.renew_deadline >= le.lease_duration:
+        if "leaderElection.leaseDuration" not in bad_types and \
+                "leaderElection.renewDeadline" not in bad_types and \
+                le.renew_deadline >= le.lease_duration:
             errors.append("leaderElection: renewDeadline "
                           f"{le.renew_deadline} must be < leaseDuration "
                           f"{le.lease_duration}")

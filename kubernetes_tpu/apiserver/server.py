@@ -490,11 +490,12 @@ class _Server(socketserver.ThreadingTCPServer):
     def handle_error(self, request, client_address):
         # TLS handshake failures and peer resets are routine connection
         # noise (a port scanner, a curl without the CA), not tracebacks.
+        # Deliberately NOT the blanket OSError: fd exhaustion and other
+        # OSError-derived faults must still surface.
         import ssl
         import sys
         exc = sys.exc_info()[1]
-        if isinstance(exc, (ssl.SSLError, ConnectionError,
-                            TimeoutError, OSError)):
+        if isinstance(exc, (ssl.SSLError, ConnectionError, TimeoutError)):
             return
         super().handle_error(request, client_address)
 

@@ -29,6 +29,7 @@ from kubernetes_tpu.controller.endpoints import EndpointsController
 from kubernetes_tpu.controller.job import JobController
 from kubernetes_tpu.controller.namespace import NamespaceController
 from kubernetes_tpu.controller.node import NodeLifecycleController
+from kubernetes_tpu.controller.podgc import PodGCController
 from kubernetes_tpu.controller.replication import ReplicationManager
 from kubernetes_tpu.utils.logging import configure, get_logger
 
@@ -41,6 +42,9 @@ def main(argv=None) -> int:
     p.add_argument("--api-server", required=True)
     p.add_argument("--node-monitor-grace-period", type=float, default=40.0)
     p.add_argument("--pod-eviction-timeout", type=float, default=60.0)
+    p.add_argument("--terminated-pod-gc-threshold", type=int, default=1000,
+                   help="delete the oldest terminated pods beyond this "
+                        "count (gc_controller.go)")
     p.add_argument("--kube-api-token", default="",
                    help="bearer token for an authenticated apiserver")
     p.add_argument("--leader-elect", action="store_true",
@@ -74,9 +78,12 @@ def main(argv=None) -> int:
             DaemonSetController(opts.api_server, token=tok).run())
         controllers.append(
             JobController(opts.api_server, token=tok).run())
+        controllers.append(PodGCController(
+            opts.api_server, token=tok,
+            threshold=opts.terminated_pod_gc_threshold).run())
         log.info("controller-manager running (replication + deployment + "
                  "node lifecycle + endpoints + namespace + daemonset + "
-                 "job)")
+                 "job + podgc)")
 
     elector = None
     if opts.leader_elect:

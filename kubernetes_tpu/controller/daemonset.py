@@ -131,8 +131,6 @@ class DaemonSetController:
         meta = ds.get("metadata") or {}
         ns = meta.get("namespace", "default")
         name = meta.get("name", "")
-        node_names = {(n.get("metadata") or {}).get("name", "")
-                      for n in nodes}
         eligible = {(n.get("metadata") or {}).get("name", "")
                     for n in nodes if self._eligible(ds, n)}
         mine = [p for p in pods

@@ -137,7 +137,17 @@ class JobController:
             have_active = len(active) + len(pending)
 
         complete = succeeded >= completions
-        if not complete:
+        if complete:
+            # The reference deletes the remaining active pods once
+            # completions is reached (job controller manageJob): a watch-
+            # lag overshoot pod must not run forever on a Complete job.
+            for p in active:
+                pmeta = p.get("metadata") or {}
+                try:
+                    self.store.delete("pods", f"{ns}/{pmeta.get('name')}")
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
+        else:
             want_active = min(parallelism, completions - succeeded)
             if have_active < want_active:
                 for _ in range(want_active - have_active):
@@ -166,15 +176,14 @@ class JobController:
         }
         if complete:
             status["conditions"] = [{"type": "Complete", "status": "True"}]
-            status["completionTime"] = time.time()
+            # The first completion stamp is the record; later syncs keep
+            # it while counts (active draining to 0) stay live.
+            status["completionTime"] = \
+                (job.get("status") or {}).get("completionTime") \
+                or time.time()
         cur = dict(job)
-        if (cur.get("status") or {}) != status and \
-                not (complete and (cur.get("status") or {})
-                     .get("completionTime")):
+        if (cur.get("status") or {}) != status:
             try:
-                old_time = (cur.get("status") or {}).get("completionTime")
-                if complete and old_time:
-                    status["completionTime"] = old_time
                 self.store.update("jobs", {**cur, "status": status})
             except Exception:  # noqa: BLE001 — CAS race: next sync heals
                 pass

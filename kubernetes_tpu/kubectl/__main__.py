@@ -426,9 +426,13 @@ def cmd_drain(client: APIClient, opts, out) -> int:
     """kubectl drain (pkg/kubectl/cmd/drain.go): cordon the node, then
     delete every pod on it.  Pods not managed by an RC/RS/Deployment (no
     controller will re-create them elsewhere) are refused without
-    --force, the reference's safety rule."""
+    --force; DaemonSet pods are refused without --ignore-daemonsets and
+    then LEFT IN PLACE (deleting them is futile — the daemon controller
+    ignores cordons and would recreate them within a sync), the
+    reference's rule exactly."""
     # One selector semantics, not a divergent copy: _matches handles both
     # RC map selectors and RS LabelSelectors (matchLabels+matchExpressions).
+    from kubernetes_tpu.controller.daemonset import DS_LABEL
     from kubernetes_tpu.controller.replication import _matches
     name = opts.name
     rc_code = _set_unschedulable(client, name, True, out)
@@ -440,6 +444,17 @@ def cmd_drain(client: APIClient, opts, out) -> int:
     if not mine:
         print(f"node/{name} drained (no pods)", file=out)
         return 0
+    daemon_pods = [p for p in mine
+                   if ((p.get("metadata") or {}).get("labels") or {})
+                   .get(DS_LABEL)]
+    if daemon_pods and not opts.ignore_daemonsets:
+        names = ", ".join((p.get("metadata") or {}).get("name", "")
+                          for p in daemon_pods)
+        print(f"error: DaemonSet-managed pods (use --ignore-daemonsets "
+              f"to proceed; they will be left in place): {names}",
+              file=out)
+        return 1
+    mine = [p for p in mine if p not in daemon_pods]
     rcs, _ = client.list("replicationcontrollers")
     rss, _ = client.list("replicasets")
 
@@ -518,6 +533,9 @@ def main(argv=None, out=sys.stdout) -> int:
     dr.add_argument("name")
     dr.add_argument("--force", action="store_true",
                     help="also evict pods no controller will re-create")
+    dr.add_argument("--ignore-daemonsets", action="store_true",
+                    help="proceed past DaemonSet-managed pods (left in "
+                         "place; the daemon controller ignores cordons)")
 
     sc = sub.add_parser("scale")
     sc.add_argument("resource")

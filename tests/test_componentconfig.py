@@ -192,3 +192,25 @@ class TestFeatureGates:
                 c["stream"] == 0, c
         finally:
             featuregate.set_default(old)
+
+
+class TestConfigTypeSafety:
+    def test_string_numbers_collected_not_raised(self):
+        cfg = KubeSchedulerConfiguration.from_json(json.dumps(
+            {"kind": "KubeSchedulerConfiguration", "port": "10251",
+             "kubeAPIQPS": "50"}))
+        errors = cfg.validate()
+        joined = " ".join(errors)
+        assert "port" in joined and "kubeAPIQPS" in joined
+        assert all("expected a number" in e for e in errors)
+
+    def test_config_file_keeps_profiling_on_by_default(self, tmp_path):
+        """A --config file that never mentions enableProfiling must keep
+        the reference's EnableProfiling=true scheme default."""
+        from kubernetes_tpu.scheduler.__main__ import (
+            apply_component_config, build_parser)
+        f = tmp_path / "cfg.json"
+        f.write_text(json.dumps({"kind": "KubeSchedulerConfiguration"}))
+        opts = apply_component_config(build_parser(),
+                                      ["--config", str(f)])
+        assert opts.enable_profiling is True

@@ -233,3 +233,21 @@ class TestApply:
         assert rc == 0, out
         assert store.get("namespaces", "team-z") is not None
         assert store.get("pods", "team-z/p") is not None
+
+
+class TestDrainDaemonSets:
+    def test_daemonset_pods_refused_then_left_in_place(self, rig):
+        """Drain refuses DS pods without --ignore-daemonsets; with it they
+        are LEFT running (deleting them is futile: the daemon controller
+        ignores cordons and recreates within a sync)."""
+        store, base = rig
+        store.create("nodes", _node("n1"))
+        store.create("pods", {
+            "metadata": {"name": "logd-abc", "namespace": "default",
+                         "labels": {"daemonset-name": "logd"}},
+            "spec": {"nodeName": "n1", "containers": [{"name": "c"}]}})
+        rc, out = run(base, "drain", "n1")
+        assert rc == 1 and "ignore-daemonsets" in out
+        rc, out = run(base, "drain", "n1", "--ignore-daemonsets")
+        assert rc == 0 and "drained" in out
+        assert store.get("pods", "default/logd-abc") is not None

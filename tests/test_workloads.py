@@ -190,3 +190,72 @@ class TestJob:
             assert len(store.list("pods")[0]) == 2
         finally:
             jc.stop()
+
+
+class TestPodGC:
+    """podgc (pkg/controller/podgc/gc_controller.go): oldest terminated
+    pods deleted beyond the threshold; live pods untouched."""
+
+    def test_oldest_terminated_collected_beyond_threshold(self):
+        from kubernetes_tpu.controller.podgc import PodGCController
+        store = MemStore()
+        for i in range(8):
+            store.create("pods", {
+                "metadata": {"name": f"done-{i}", "namespace": "default"},
+                "spec": {"containers": [{"name": "c"}]},
+                "status": {"phase": "Succeeded"}})
+        store.create("pods", {
+            "metadata": {"name": "alive", "namespace": "default"},
+            "spec": {"containers": [{"name": "c"}]},
+            "status": {"phase": "Running"}})
+        gc = PodGCController(store, threshold=3, sync_period=0.1).run()
+        try:
+            _wait(lambda: sum(
+                1 for o in store.list("pods")[0]
+                if (o.get("status") or {}).get("phase") == "Succeeded") == 3,
+                msg="terminated pods trimmed to threshold")
+            names = {o["metadata"]["name"] for o in store.list("pods")[0]}
+            assert "alive" in names
+            # The oldest five were collected; the newest three remain.
+            assert {"done-5", "done-6", "done-7"} <= names
+            assert not {"done-0", "done-1"} & names
+        finally:
+            gc.stop()
+
+
+class TestJobCompletionDrain:
+    def test_leftover_active_pods_deleted_on_completion(self):
+        """An overshoot pod still active when completions is reached must
+        be deleted (the reference's manageJob), and status counts stay
+        live past the first completion stamp."""
+        from kubernetes_tpu.controller.job import JobController
+        store = MemStore()
+        jc = JobController(store, sync_period=0.1).run()
+        try:
+            store.create("jobs", {
+                "metadata": {"name": "j", "namespace": "default"},
+                "spec": {"completions": 1, "parallelism": 1,
+                         "template": {"metadata": {"labels": {"a": "j"}},
+                                      "spec": {"containers":
+                                               [{"name": "c"}]}}}})
+            _wait(lambda: len(store.list("pods")[0]) == 1, msg="1 active")
+            # Inject an overshoot pod, then complete the first one.
+            store.create("pods", {
+                "metadata": {"name": "j-overshoot", "namespace": "default",
+                             "labels": {"job-name": "j"}},
+                "spec": {"containers": [{"name": "c"}]},
+                "status": {"phase": "Running"}})
+            first = next(o for o in store.list("pods")[0]
+                         if o["metadata"]["name"] != "j-overshoot")
+            first["status"] = {"phase": "Succeeded"}
+            store.update("pods", first)
+
+            def settled():
+                job = store.get("jobs", "default/j")
+                status = job.get("status") or {}
+                return status.get("succeeded", 0) >= 1 and \
+                    status.get("active", 1) == 0 and \
+                    store.get("pods", "default/j-overshoot") is None
+            _wait(settled, msg="overshoot deleted, counts live")
+        finally:
+            jc.stop()
