@@ -28,7 +28,8 @@ NAMESPACED_KINDS = frozenset({"pods", "services", "persistentvolumeclaims",
                               "events", "endpoints", "deployments",
                               "limitranges", "resourcequotas",
                               "daemonsets", "jobs",
-                              "roles", "rolebindings"})
+                              "roles", "rolebindings",
+                              "horizontalpodautoscalers"})
 
 AFFINITY_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/affinity"
 TOLERATIONS_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/tolerations"

@@ -29,6 +29,8 @@ from kubernetes_tpu.controller.endpoints import EndpointsController
 from kubernetes_tpu.controller.job import JobController
 from kubernetes_tpu.controller.namespace import NamespaceController
 from kubernetes_tpu.controller.node import NodeLifecycleController
+from kubernetes_tpu.controller.podautoscaler import (
+    HorizontalPodAutoscaler)
 from kubernetes_tpu.controller.podgc import PodGCController
 from kubernetes_tpu.controller.replication import ReplicationManager
 from kubernetes_tpu.utils.logging import configure, get_logger
@@ -81,9 +83,11 @@ def main(argv=None) -> int:
         controllers.append(PodGCController(
             opts.api_server, token=tok,
             threshold=opts.terminated_pod_gc_threshold).run())
+        controllers.append(
+            HorizontalPodAutoscaler(opts.api_server, token=tok).run())
         log.info("controller-manager running (replication + deployment + "
                  "node lifecycle + endpoints + namespace + daemonset + "
-                 "job + podgc)")
+                 "job + podgc + hpa)")
 
     elector = None
     if opts.leader_elect:

@@ -1,0 +1,195 @@
+"""Horizontal pod autoscaler: scale a workload on CPU utilization.
+
+The reference's HPA controller (pkg/controller/podautoscaler/
+horizontal.go) reads per-pod CPU usage from heapster, computes average
+utilization as a percentage of requests, and rescales the target when the
+usage ratio leaves a ±10% tolerance band:
+
+    desired = ceil(currentReplicas * utilization / target)    (:163-166)
+
+clamped to [minReplicas, maxReplicas].  Here the metrics source is the
+hollow kubelet's fake-cAdvisor stand-in (``status.cpuUsage``, stamped
+from the ``kubemark.kubernetes.io/cpu-usage`` annotation); the scale
+targets are ReplicationControllers, ReplicaSets, and Deployments.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Optional, Union
+
+from kubernetes_tpu.api.quantity import parse_quantity
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.client import cas_update
+from kubernetes_tpu.client.http import APIClient
+from kubernetes_tpu.client.reflector import Reflector
+from kubernetes_tpu.controller.replication import _matches
+from kubernetes_tpu.utils.logging import get_logger
+
+log = get_logger("hpa")
+
+SYNC_PERIOD = 2.0
+TOLERANCE = 0.1           # horizontal.go:46
+DEFAULT_TARGET_PCT = 80   # the reference's defaulted CPU target
+
+_KIND_TO_RESOURCE = {"ReplicationController": "replicationcontrollers",
+                     "ReplicaSet": "replicasets",
+                     "Deployment": "deployments"}
+
+
+def _milli(val) -> Optional[float]:
+    try:
+        return float(parse_quantity(val) * 1000)
+    except (ValueError, TypeError, ArithmeticError):
+        return None
+
+
+class HorizontalPodAutoscaler:
+    def __init__(self, source: Union[MemStore, APIClient, str],
+                 sync_period: float = SYNC_PERIOD, token: str = ""):
+        if isinstance(source, str):
+            source = APIClient(source, token=token)
+        self.store = source
+        self.sync_period = sync_period
+        self._hpas: dict[str, dict] = {}
+        # Namespace-sliced pod index (the sibling controllers' pattern):
+        # without it every HPA paid a full-cluster pod LIST per sync.
+        self._pods_by_ns: dict[str, dict[str, dict]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._reflectors: list[Reflector] = []
+
+    def run(self) -> "HorizontalPodAutoscaler":
+        for kind, handler in (("horizontalpodautoscalers", self._on_hpa),
+                              ("pods", self._on_pod)):
+            r = Reflector(self.store, kind, handler)
+            self._reflectors.append(r)
+            r.run()
+        for r in self._reflectors:
+            r.wait_for_sync()
+        t = threading.Thread(target=self._loop, daemon=True, name="hpa")
+        t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for r in self._reflectors:
+            r.stop()
+
+    def _on_hpa(self, etype: str, obj: dict) -> None:
+        key = MemStore.object_key(obj)
+        with self._lock:
+            if etype == "DELETED":
+                self._hpas.pop(key, None)
+            else:
+                self._hpas[key] = obj
+
+    def _on_pod(self, etype: str, obj: dict) -> None:
+        key = MemStore.object_key(obj)
+        ns = (obj.get("metadata") or {}).get("namespace", "default")
+        with self._lock:
+            bucket = self._pods_by_ns.setdefault(ns, {})
+            if etype == "DELETED":
+                bucket.pop(key, None)
+            else:
+                bucket[key] = obj
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.sync_period):
+            try:
+                self.sync_all()
+            except Exception:  # noqa: BLE001 — HandleCrash analogue
+                log.exception("hpa sync crashed; continuing")
+
+    def sync_all(self) -> None:
+        with self._lock:
+            hpas = list(self._hpas.values())
+        for hpa in hpas:
+            try:
+                self._sync_one(hpa)
+            except Exception:  # noqa: BLE001
+                log.exception("hpa %s sync failed",
+                              (hpa.get("metadata") or {}).get("name"))
+
+    def _sync_one(self, hpa: dict) -> None:
+        meta = hpa.get("metadata") or {}
+        spec = hpa.get("spec") or {}
+        ns = meta.get("namespace", "default")
+        ref = spec.get("scaleTargetRef") or {}
+        resource = _KIND_TO_RESOURCE.get(ref.get("kind", ""))
+        if resource is None:
+            return
+        target = self.store.get(resource, f"{ns}/{ref.get('name', '')}")
+        if target is None:
+            return
+        tspec = target.get("spec") or {}
+        current = int(tspec.get("replicas", 1))
+        if current == 0:
+            # Scaled-to-zero means autoscaling is paused (the reference's
+            # reconcileAutoscaler skips at 0) — resurrecting a workload
+            # the user deliberately stopped would fight kubectl scale.
+            return
+        selector = tspec.get("selector") or {}
+
+        with self._lock:
+            pods = list(self._pods_by_ns.get(ns, {}).values())
+        mine = [p for p in pods if _matches(selector, p)
+                and (p.get("status") or {}).get("phase") == "Running"]
+        usages, requests = [], []
+        for p in mine:
+            u = _milli((p.get("status") or {}).get("cpuUsage"))
+            if u is None:
+                continue  # no metric for this pod yet
+            req = 0.0
+            for c in (p.get("spec") or {}).get("containers") or []:
+                r = _milli(((c.get("resources") or {}).get("requests")
+                            or {}).get("cpu"))
+                if r:
+                    req += r
+            if req > 0:
+                usages.append(u)
+                requests.append(req)
+        if not usages:
+            return  # the reference errors without metrics; we wait
+        utilization = 100.0 * sum(usages) / sum(requests)
+        target_pct = float(spec.get("targetCPUUtilizationPercentage",
+                                    DEFAULT_TARGET_PCT) or
+                           DEFAULT_TARGET_PCT)
+        ratio = utilization / target_pct
+        if abs(1.0 - ratio) > TOLERANCE:
+            desired = int(math.ceil(ratio * current))
+        else:
+            desired = current
+        lo = int(spec.get("minReplicas", 1) or 1)
+        hi = int(spec.get("maxReplicas", current) or current)
+        desired = max(lo, min(hi, desired))
+
+        if desired != current:
+            try:
+                # cas_update: the target was read fresh above, and its rv
+                # guards the write on BOTH transports (a plain
+                # APIClient.update has no expected_rv kwarg; a plain
+                # MemStore.update without one is last-write-wins).
+                cas_update(self.store, resource, {
+                    **target, "spec": {**tspec, "replicas": desired}})
+                log.info("hpa %s/%s: %s %s %d -> %d (util %.0f%% vs %d%%)",
+                         ns, meta.get("name"), ref.get("kind"),
+                         ref.get("name"), current, desired, utilization,
+                         int(target_pct))
+            except Exception:  # noqa: BLE001 — CAS race: next sync heals
+                return
+        status = {"currentReplicas": current, "desiredReplicas": desired,
+                  "currentCPUUtilizationPercentage": int(utilization)}
+        if (hpa.get("status") or {}) != status:
+            try:
+                # Fresh read + CAS: the reflector copy may be stale, and a
+                # full-object rewrite from it would revert a concurrent
+                # kubectl edit of spec (maxReplicas, target%).
+                cur = self.store.get("horizontalpodautoscalers",
+                                     f"{ns}/{meta.get('name', '')}")
+                if cur is not None and (cur.get("status") or {}) != status:
+                    cas_update(self.store, "horizontalpodautoscalers",
+                               {**cur, "status": status})
+            except Exception:  # noqa: BLE001 — CAS race: next sync heals
+                pass

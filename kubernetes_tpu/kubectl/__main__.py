@@ -58,6 +58,9 @@ ALIASES = {
     "clusterrole": "clusterroles", "clusterroles": "clusterroles",
     "clusterrolebinding": "clusterrolebindings",
     "clusterrolebindings": "clusterrolebindings",
+    "hpa": "horizontalpodautoscalers",
+    "horizontalpodautoscaler": "horizontalpodautoscalers",
+    "horizontalpodautoscalers": "horizontalpodautoscalers",
 }
 
 # Kinds whose storage keys carry a namespace (matches the apiserver).
@@ -214,6 +217,7 @@ _KIND_FIELD_TO_RESOURCE = {
     "rolebinding": "rolebindings",
     "clusterrole": "clusterroles",
     "clusterrolebinding": "clusterrolebindings",
+    "horizontalpodautoscaler": "horizontalpodautoscalers",
 }
 
 

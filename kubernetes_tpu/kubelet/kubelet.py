@@ -219,11 +219,21 @@ class HollowKubelet:
                 and oracle.pod_fits_host_ports(pod, node_pods)
                 and oracle.pod_matches_node_labels(pod, self.node))
 
+    # The fake-cAdvisor analogue: a pod annotated with a simulated CPU
+    # usage reports it in status, which the HPA controller consumes as
+    # its heapster stand-in.
+    CPU_USAGE_ANN = "kubemark.kubernetes.io/cpu-usage"
+
     def _set_phase(self, obj: dict, phase: str, reason: str) -> None:
         status = obj.setdefault("status", {})
         status["phase"] = phase
         if reason:
             status["reason"] = reason
+        if phase == "Running":
+            usage = ((obj.get("metadata") or {}).get("annotations")
+                     or {}).get(self.CPU_USAGE_ANN)
+            if usage:
+                status["cpuUsage"] = usage
         if phase == "Running" and not status.get("podIP"):
             # The hollow runtime's IPAM (kubemark's fake runtime assigns
             # pod IPs too): a node-scoped /24 (md5 of the node name — NOT

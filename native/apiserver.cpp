@@ -432,7 +432,8 @@ static std::vector<std::string> validate(const std::string& kind,
 static const std::set<std::string> kNamespaced = {
     "pods", "services", "persistentvolumeclaims", "replicationcontrollers",
     "replicasets", "endpoints", "events", "deployments", "limitranges",
-    "resourcequotas", "daemonsets", "jobs", "roles", "rolebindings"};
+    "resourcequotas", "daemonsets", "jobs", "roles", "rolebindings",
+    "horizontalpodautoscalers"};
 
 struct StoredEvent {
   uint64_t rv;
