@@ -186,11 +186,19 @@ class RBACAuthorizer:
                 rbs = []
         except Exception:  # noqa: BLE001 — store unreadable: deny
             return False
-        for binding in list(crbs) + list(rbs):
+        for binding, cluster_scoped in \
+                [(b, True) for b in crbs] + [(b, False) for b in rbs]:
             subjects = binding.get("subjects") or []
             if not any(self._subject_matches(s, user) for s in subjects):
                 continue
             ref = binding.get("roleRef") or {}
+            # A ClusterRoleBinding may only reference a ClusterRole
+            # (pkg/apis/rbac/validation): resolving a namespaced Role
+            # against the binding's own namespace would grant
+            # cluster-wide authority from a namespace-scoped object
+            # (ADVICE r4).
+            if cluster_scoped and ref.get("kind", "Role") != "ClusterRole":
+                continue
             bns = (binding.get("metadata") or {}).get(
                 "namespace", "default")
             for rule in self._role_rules(ref, bns):

@@ -139,9 +139,54 @@ def validate_resource_quota(obj: dict) -> list[str]:
     return errors
 
 
+def validate_hpa(obj: dict) -> list[str]:
+    """ValidateHorizontalPodAutoscaler (pkg/apis/autoscaling/validation):
+    maxReplicas required and >= 1, and >= minReplicas.  Without this an
+    HPA missing maxReplicas would silently disable all scale-up in the
+    controller (ADVICE r4)."""
+    errors: list[str] = []
+    _check_name(obj.get("metadata") or {}, errors, "horizontalpodautoscaler")
+    spec = obj.get("spec") or {}
+    maxr = spec.get("maxReplicas")
+    if not isinstance(maxr, int) or maxr < 1:
+        errors.append("horizontalpodautoscaler.spec.maxReplicas: must be "
+                      "an integer >= 1")
+    minr = spec.get("minReplicas")
+    if minr is None:
+        minr = 1  # optional; unset/null defaults to 1 like the controller
+    if not isinstance(minr, int) or minr < 1:
+        errors.append("horizontalpodautoscaler.spec.minReplicas: must be "
+                      "an integer >= 1")
+    elif isinstance(maxr, int) and maxr < minr:
+        errors.append("horizontalpodautoscaler.spec.maxReplicas: must be "
+                      ">= minReplicas")
+    if not spec.get("scaleTargetRef"):
+        errors.append("horizontalpodautoscaler.spec.scaleTargetRef: "
+                      "required")
+    return errors
+
+
+def validate_cluster_role_binding(obj: dict) -> list[str]:
+    """pkg/apis/rbac/validation: a ClusterRoleBinding's roleRef must name
+    a ClusterRole — stored otherwise it would either silently grant
+    nothing (our authorizer skips it) or, resolved naively, grant
+    cluster-wide authority from a namespaced Role."""
+    errors: list[str] = []
+    _check_name(obj.get("metadata") or {}, errors, "clusterrolebinding")
+    ref = obj.get("roleRef") or {}
+    if ref.get("kind", "Role") != "ClusterRole":
+        errors.append("clusterrolebinding.roleRef.kind: must be "
+                      "'ClusterRole'")
+    if not ref.get("name"):
+        errors.append("clusterrolebinding.roleRef.name: required")
+    return errors
+
+
 VALIDATORS = {"pods": validate_pod, "nodes": validate_node,
               "limitranges": validate_limit_range,
-              "resourcequotas": validate_resource_quota}
+              "resourcequotas": validate_resource_quota,
+              "horizontalpodautoscalers": validate_hpa,
+              "clusterrolebindings": validate_cluster_role_binding}
 
 
 class AdmissionError(Exception):

@@ -29,10 +29,15 @@ from kubernetes_tpu.utils.logging import get_logger
 log = get_logger("namespace-controller")
 
 # Deletion order: workload owners first so their controllers don't
-# re-create pods mid-GC, then pods, then the rest.
-_GC_ORDER = ("deployments", "replicasets", "replicationcontrollers",
-             "pods", "services", "endpoints", "limitranges",
-             "resourcequotas", "persistentvolumeclaims", "events")
+# re-create pods mid-GC, then pods, then everything else that is
+# namespaced.  Derived from NAMESPACED_KINDS so a kind added to the API
+# surface can never silently survive namespace deletion (ADVICE r4 high:
+# jobs/daemonsets resurrected pods in a deleted namespace).
+_OWNERS_FIRST = ("horizontalpodautoscalers", "deployments", "daemonsets",
+                 "jobs", "petsets", "scheduledjobs", "replicasets",
+                 "replicationcontrollers", "pods")
+_GC_ORDER = _OWNERS_FIRST + tuple(sorted(
+    k for k in NAMESPACED_KINDS if k not in _OWNERS_FIRST))
 
 
 class NamespaceController:

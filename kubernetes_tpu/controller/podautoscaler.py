@@ -59,6 +59,7 @@ class HorizontalPodAutoscaler:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._reflectors: list[Reflector] = []
+        self._warned_invalid: set[str] = set()
 
     def run(self) -> "HorizontalPodAutoscaler":
         for kind, handler in (("horizontalpodautoscalers", self._on_hpa),
@@ -161,9 +162,22 @@ class HorizontalPodAutoscaler:
             desired = int(math.ceil(ratio * current))
         else:
             desired = current
+        maxr = spec.get("maxReplicas")
+        if not isinstance(maxr, int) or maxr < 1:
+            # The reference rejects such a spec at validation
+            # (maxReplicas >= 1 required); if one reaches us anyway
+            # (stored before validation existed), skip rather than
+            # clamping desired to current — which would silently disable
+            # all scale-up (ADVICE r4).  Warn once per object, not every
+            # 2 s sync tick.
+            hkey = f"{ns}/{meta.get('name')}"
+            if hkey not in self._warned_invalid:
+                self._warned_invalid.add(hkey)
+                log.warning("hpa %s: missing/invalid maxReplicas; "
+                            "skipping", hkey)
+            return
         lo = int(spec.get("minReplicas", 1) or 1)
-        hi = int(spec.get("maxReplicas", current) or current)
-        desired = max(lo, min(hi, desired))
+        desired = max(lo, min(maxr, desired))
 
         if desired != current:
             try:

@@ -297,6 +297,27 @@ class TestValidationAdmission:
         assert code == 422
         assert any("at least one container" in r for r in body["reasons"])
 
+    def test_hpa_without_max_replicas_bounces_422(self, rig):
+        """pkg/apis/autoscaling/validation requires maxReplicas >= 1 —
+        a stored HPA without it would silently disable scale-up in the
+        controller (ADVICE r4)."""
+        store, base = rig
+        bad = {"metadata": {"name": "web"},
+               "spec": {"scaleTargetRef": {"kind": "ReplicationController",
+                                           "name": "web"},
+                        "minReplicas": 1}}
+        code, body = _req(base, "POST",
+                          "/api/v1/horizontalpodautoscalers", bad)
+        assert code == 422
+        assert any("maxReplicas" in r for r in body["reasons"])
+        assert store.get("horizontalpodautoscalers", "default/web") is None
+        bad["spec"]["maxReplicas"] = 2
+        bad["spec"]["minReplicas"] = 5
+        code, body = _req(base, "POST",
+                          "/api/v1/horizontalpodautoscalers", bad)
+        assert code == 422
+        assert any(">= minReplicas" in r for r in body["reasons"])
+
     def test_malformed_node_bounces_422(self, rig):
         _, base = rig
         bad = {"metadata": {"name": "n-bad"},

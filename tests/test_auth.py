@@ -176,6 +176,26 @@ class TestRBAC:
         root = UserInfo(name="root", groups=("system:masters",))
         assert rbac.authorize(root, "DELETE", "namespaces", "")
 
+    def test_clusterrolebinding_to_role_grants_nothing(self):
+        """ADVICE r4: a ClusterRoleBinding may only reference a
+        ClusterRole (pkg/apis/rbac/validation) — resolving a namespaced
+        Role from a CRB would grant cluster-wide authority from a
+        namespace-scoped object."""
+        store, rbac, UserInfo = self._rig()
+        store.create("roles", {
+            "metadata": {"name": "admin", "namespace": "default"},
+            "rules": [{"verbs": ["*"], "resources": ["*"]}]})
+        for ref in ({"kind": "Role", "name": "admin"},
+                    {"name": "admin"}):  # kind omitted defaults to Role
+            store.create("clusterrolebindings", {
+                "metadata": {"name": f"crb-{len(ref)}"},
+                "subjects": [{"kind": "User", "name": "mallory"}],
+                "roleRef": ref})
+        mallory = UserInfo(name="mallory")
+        assert not rbac.authorize(mallory, "GET", "pods", "")
+        assert not rbac.authorize(mallory, "GET", "pods", "default")
+        assert not rbac.authorize(mallory, "DELETE", "nodes", "")
+
     def test_rolebinding_to_clusterrole(self):
         """A RoleBinding may reference a ClusterRole; the grant is still
         namespace-scoped (the reference's reuse pattern)."""

@@ -57,6 +57,53 @@ class TestNamespaceGC:
         finally:
             nc.stop()
 
+    def test_workload_kinds_are_collected(self):
+        """ADVICE r4 high: jobs/daemonsets/HPAs/roles/rolebindings must be
+        in the GC set, owners before pods — else the Job/DaemonSet
+        controllers resurrect pods in the deleted namespace."""
+        store = MemStore()
+        store.create("namespaces", {"metadata": {"name": "team-a"}})
+        store.create("jobs", {
+            "metadata": {"name": "j", "namespace": "team-a"},
+            "spec": {"completions": 1, "parallelism": 1,
+                     "selector": {"matchLabels": {"job": "j"}},
+                     "template": {"metadata": {"labels": {"job": "j"}},
+                                  "spec": {"containers": [{"name": "c"}]}}}})
+        store.create("daemonsets", {
+            "metadata": {"name": "d", "namespace": "team-a"},
+            "spec": {"selector": {"matchLabels": {"ds": "d"}},
+                     "template": {"metadata": {"labels": {"ds": "d"}},
+                                  "spec": {"containers": [{"name": "c"}]}}}})
+        store.create("horizontalpodautoscalers", {
+            "metadata": {"name": "h", "namespace": "team-a"},
+            "spec": {"scaleTargetRef": {"kind": "Job", "name": "j"},
+                     "maxReplicas": 3}})
+        store.create("roles", {
+            "metadata": {"name": "r", "namespace": "team-a"},
+            "rules": [{"verbs": ["get"], "resources": ["pods"]}]})
+        store.create("rolebindings", {
+            "metadata": {"name": "rb", "namespace": "team-a"},
+            "subjects": [{"kind": "User", "name": "a"}],
+            "roleRef": {"kind": "Role", "name": "r"}})
+        nc = NamespaceController(store).run()
+        try:
+            store.delete("namespaces", "team-a")
+            for kind, name in (("jobs", "j"), ("daemonsets", "d"),
+                               ("horizontalpodautoscalers", "h"),
+                               ("roles", "r"), ("rolebindings", "rb")):
+                _wait(lambda k=kind, n=name:
+                      store.get(k, f"team-a/{n}") is None,
+                      msg=f"{kind}/{name} collected")
+        finally:
+            nc.stop()
+
+    def test_gc_order_covers_every_namespaced_kind(self):
+        """Structural guard: a kind added to NAMESPACED_KINDS can never be
+        missing from the GC sweep again."""
+        from kubernetes_tpu.api.types import NAMESPACED_KINDS
+        from kubernetes_tpu.controller.namespace import _GC_ORDER
+        assert NAMESPACED_KINDS <= set(_GC_ORDER)
+
     def test_terminating_phase_finalizes(self):
         """A namespace marked Terminating is drained and then removed —
         the finalizer-shaped path."""
