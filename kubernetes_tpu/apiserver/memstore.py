@@ -53,6 +53,25 @@ class Event:
     key: str
     object: dict
     rv: int
+    # The object's state BEFORE this write (None for creates).  Fielded
+    # watchers need it to classify set transitions: a pod leaving the
+    # ``spec.nodeName=`` set on bind is a DELETED to that watcher even
+    # though the store event is MODIFIED (pkg/storage/cacher's
+    # watchCache computes event types against prevObject the same way).
+    prev: Optional[dict] = None
+
+    def _obj_json(self) -> bytes:
+        """The object serialized once, shared between the event's own
+        wire line and any re-typed (fielded-watch) lines — at density
+        rates every bind synthesizes a DELETED for the scheduler's
+        unassigned watch, and re-serializing the identical pod per
+        rewrite was GIL time in the watch-serving threads."""
+        cached = self.__dict__.get("_oj")
+        if cached is None:
+            cached = json.dumps(self.object,
+                                separators=(",", ":")).encode()
+            object.__setattr__(self, "_oj", cached)
+        return cached
 
     def wire_line(self) -> bytes:
         """The NDJSON watch-wire form, serialized once and shared by every
@@ -61,20 +80,56 @@ class Event:
         re-serialization was a measurable slice of apiserver GIL time."""
         cached = self.__dict__.get("_wire")
         if cached is None:
-            cached = (json.dumps({"type": self.type, "object": self.object},
-                                 separators=(",", ":")) + "\n").encode()
+            cached = (b'{"type":"' + self.type.encode() +
+                      b'","object":' + self._obj_json() + b'}\n')
             object.__setattr__(self, "_wire", cached)
         return cached
 
+    def as_type(self, etype: str) -> "Event":
+        """This event re-typed for a fielded watcher: shares the object
+        AND its cached serialization; only the tiny envelope differs."""
+        ev = Event(etype, self.kind, self.key, self.object, self.rv,
+                   self.prev)
+        oj = self.__dict__.get("_oj")
+        if oj is not None:
+            object.__setattr__(ev, "_oj", oj)
+        return ev
+
 
 class Watcher:
-    def __init__(self, store: "MemStore", kinds: tuple[str, ...]):
+    def __init__(self, store: "MemStore", kinds: tuple[str, ...],
+                 selector=None):
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._store = store
         self.kinds = kinds
+        self.selector = selector  # fielded watch predicate (or None)
 
     def _deliver(self, ev: Event) -> None:
-        self._q.put(ev)
+        """Called under the store lock.  An unfielded watcher forwards the
+        shared event; a fielded one classifies the set transition
+        (cacher.go watchCache semantics):
+
+        * entered the set  -> ADDED
+        * stayed in        -> event as-is
+        * left the set     -> DELETED (carrying the new object state)
+        * never in         -> dropped
+        """
+        sel = self.selector
+        if sel is None:
+            self._q.put(ev)
+            return
+        m_new = sel(ev.object)
+        m_prev = ev.prev is not None and sel(ev.prev)
+        if ev.type == "DELETED":
+            if m_prev or m_new:
+                self._q.put(ev)
+        elif ev.type == "ADDED":
+            if m_new:
+                self._q.put(ev)
+        elif m_new:
+            self._q.put(ev if m_prev else ev.as_type("ADDED"))
+        elif m_prev:
+            self._q.put(ev.as_type("DELETED"))
 
     def next(self, timeout: Optional[float] = None) -> Optional[Event]:
         try:
@@ -204,13 +259,16 @@ class MemStore:
         ns = meta.get("namespace")
         return f"{ns}/{meta['name']}" if ns else meta["name"]
 
-    def _emit(self, etype: str, kind: str, key: str, obj: dict) -> Event:
+    def _emit(self, etype: str, kind: str, key: str, obj: dict,
+              prev: Optional[dict] = None) -> Event:
         self._rv += 1
         obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
         if self._wal is not None:
             self._append_wal(etype, kind, key, obj, self._rv)
         snapshot = obj if self._share_events else copy.deepcopy(obj)
-        ev = Event(etype, kind, key, snapshot, self._rv)
+        # prev is read only by fielded-watch predicates (never handed to
+        # handlers), so it can reference the retired stored dict directly.
+        ev = Event(etype, kind, key, snapshot, self._rv, prev)
         self._events.append(ev)
         if len(self._events) > WATCH_WINDOW:
             self._events = self._events[-WATCH_WINDOW:]
@@ -268,7 +326,7 @@ class MemStore:
             else:
                 meta["generation"] = old_gen
             bucket[key] = obj
-            ev = self._emit("MODIFIED", kind, key, obj)
+            ev = self._emit("MODIFIED", kind, key, obj, prev=current)
             return ev.object if owned else copy.deepcopy(obj)
 
     def delete(self, kind: str, key: str) -> None:
@@ -279,9 +337,10 @@ class MemStore:
                 raise KeyError(f"{kind} {key} not found")
             # COW before the rv stamp: the popped dict may still be
             # referenced by earlier in-flight events (share_events mode).
+            prev = obj
             obj = dict(obj)
             obj["metadata"] = dict(obj.get("metadata") or {})
-            self._emit("DELETED", kind, key, obj)
+            self._emit("DELETED", kind, key, obj, prev=prev)
 
     def get(self, kind: str, key: str) -> Optional[dict]:
         with self._lock:
@@ -299,12 +358,16 @@ class MemStore:
 
     # -- watch -----------------------------------------------------------
 
-    def watch(self, kinds: Iterable[str], from_rv: int) -> Watcher:
+    def watch(self, kinds: Iterable[str], from_rv: int,
+              selector=None) -> Watcher:
+        """``selector``: a fielded-watch predicate (api.fieldsel.matcher)
+        applied server-side with set-transition semantics — see
+        Watcher._deliver."""
         with self._lock:
             if self._events and from_rv < self._events[0].rv - 1 and \
                     from_rv < self._rv - len(self._events):
                 raise TooOldError(f"rv {from_rv} too old")
-            w = Watcher(self, tuple(kinds))
+            w = Watcher(self, tuple(kinds), selector=selector)
             for ev in self._events:
                 if ev.rv > from_rv and ev.kind in w.kinds:
                     w._deliver(ev)
@@ -337,12 +400,13 @@ class MemStore:
         # Copy-on-write (pod + the two sub-dicts this write touches): the
         # previous version may still be referenced by in-flight events, so
         # no stored object is ever mutated in place.
+        prev = pod
         pod = dict(pod)
         pod["spec"] = dict(pod.get("spec") or {})
         pod["metadata"] = dict(pod.get("metadata") or {})
         pod["spec"]["nodeName"] = node_name
         self._objects["pods"][key] = pod
-        self._emit("MODIFIED", "pods", key, pod)
+        self._emit("MODIFIED", "pods", key, pod, prev=prev)
 
     def bind_many(self, bindings: list[tuple[str, str, str]]
                   ) -> list[Optional[str]]:

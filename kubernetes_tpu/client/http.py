@@ -171,6 +171,10 @@ class APIClient:
             "items": [{"metadata": {"name": pod, "namespace": ns},
                        "target": {"kind": "Node", "name": node}}
                       for ns, pod, node in bindings]})
+        if resp.get("failed") == 0:
+            # Success fast path: the server omits per-item results when
+            # every bind landed (nothing to detail).
+            return [None] * len(bindings)
         return [None if r.get("code") == 201 else
                 r.get("error", f"HTTP {r.get('code')}")
                 for r in resp.get("results", [])]
@@ -187,21 +191,31 @@ class APIClient:
     # -- list + watch ----------------------------------------------------
 
     def list(self, kind: str,
-             selector: Optional[Callable[[dict], bool]] = None
-             ) -> tuple[list[dict], int]:
-        obj = self._request("GET", f"/api/v1/{kind}")
+             selector: Optional[Callable[[dict], bool]] = None,
+             field_selector: str = "") -> tuple[list[dict], int]:
+        """``field_selector`` filters SERVER-side (?fieldSelector=...,
+        pkg/fields); ``selector`` remains a client-side predicate."""
+        path = f"/api/v1/{kind}"
+        if field_selector:
+            path += "?fieldSelector=" + urllib.parse.quote(field_selector)
+        obj = self._request("GET", path)
         items = obj.get("items") or []
         if selector is not None:
             items = [o for o in items if selector(o)]
         rv = int((obj.get("metadata") or {}).get("resourceVersion", "0"))
         return items, rv
 
-    def watch(self, kind: str, from_rv: int) -> "HTTPWatcher":
-        """Open a chunked watch stream; TooOldError on 410 forces relist."""
+    def watch(self, kind: str, from_rv: int,
+              field_selector: str = "") -> "HTTPWatcher":
+        """Open a chunked watch stream; TooOldError on 410 forces relist.
+        With ``field_selector`` the server applies set-transition
+        semantics (an object leaving the set arrives as DELETED)."""
         self.limiter.accept()
-        return HTTPWatcher(
-            f"{self.base_url}/api/v1/{kind}?watch=1&resourceVersion={from_rv}",
-            kind, token=self.token)
+        url = (f"{self.base_url}/api/v1/{kind}?watch=1"
+               f"&resourceVersion={from_rv}")
+        if field_selector:
+            url += "&fieldSelector=" + urllib.parse.quote(field_selector)
+        return HTTPWatcher(url, kind, token=self.token)
 
 
 # A healthy watch stream carries a server heartbeat every ~10 s

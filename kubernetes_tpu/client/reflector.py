@@ -17,6 +17,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
+from kubernetes_tpu.api import fieldsel
 from kubernetes_tpu.apiserver.memstore import MemStore, TooOldError
 
 Handler = Callable[[str, dict], None]
@@ -24,11 +25,21 @@ Handler = Callable[[str, dict], None]
 
 class Reflector:
     def __init__(self, source, kind: str, handler: Handler,
-                 selector: Optional[Callable[[dict], bool]] = None):
+                 selector: Optional[Callable[[dict], bool]] = None,
+                 field_selector: str = ""):
+        """``field_selector`` (e.g. ``spec.nodeName=``) filters
+        SERVER-side on both list and watch — the reference's fielded
+        informers (factory.go:466-469).  ``selector`` remains a local
+        predicate for conditions field selectors can't express."""
         self.source = source
         self.kind = kind
         self.handler = handler
         self.selector = selector
+        self.field_selector = field_selector
+        # Against a MemStore there is no server process; the compiled
+        # matcher IS the server-side filter (list + fielded watch).
+        self._fs_match = fieldsel.matcher(field_selector) \
+            if field_selector else None
         self._stop = threading.Event()
         self._synced = threading.Event()
         self._known: dict[str, dict] = {}  # key -> last delivered object
@@ -40,13 +51,25 @@ class Reflector:
 
     def _open_watch(self, rv: int):
         if isinstance(self.source, MemStore):
-            return self.source.watch([self.kind], rv)
-        return self.source.watch(self.kind, rv)
+            return self.source.watch([self.kind], rv,
+                                     selector=self._fs_match)
+        return self.source.watch(self.kind, rv,
+                                 field_selector=self.field_selector)
 
     def _list(self) -> int:
         """Replace semantics (cache.Store.Replace): objects that vanished
         while the watch was down are surfaced as DELETED on relist."""
-        items, rv = self.source.list(self.kind, self.selector)
+        if isinstance(self.source, MemStore):
+            sel = self.selector
+            if self._fs_match is not None:
+                fs = self._fs_match
+                sel = fs if sel is None else \
+                    (lambda o, _s=sel, _f=fs: _f(o) and _s(o))
+            items, rv = self.source.list(self.kind, sel)
+        else:
+            items, rv = self.source.list(
+                self.kind, self.selector,
+                field_selector=self.field_selector)
         fresh = {MemStore.object_key(obj): obj for obj in items}
         for key, obj in list(self._known.items()):
             if key not in fresh:

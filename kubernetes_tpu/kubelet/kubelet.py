@@ -61,9 +61,14 @@ class HollowKubelet:
 
     def run(self) -> "HollowKubelet":
         self._register()
-        selector = self._my_pod
-        self._reflector = Reflector(self.store, "pods", self._on_pod,
-                                    selector)
+        # Fielded watch, the reference kubelet's source exactly
+        # (pkg/kubelet/config/apiserver.go NewSourceApiserver:
+        # fieldSelector spec.nodeName=<node>): the server filters, so a
+        # 500-kubelet fleet no longer fans every pod event to every
+        # node's stream.
+        self._reflector = Reflector(
+            self.store, "pods", self._on_pod,
+            field_selector=f"spec.nodeName={self.node.name}")
         self._threads.append(self._reflector.run())
         t = threading.Thread(target=self._heartbeat_loop, daemon=True,
                              name=f"kubelet-heartbeat-{self.node.name}")
@@ -77,9 +82,6 @@ class HollowKubelet:
         self._stop.set()
         if self._reflector is not None:
             self._reflector.stop()
-
-    def _my_pod(self, obj: dict) -> bool:
-        return (obj.get("spec") or {}).get("nodeName") == self.node.name
 
     # -- registration + heartbeat ---------------------------------------
 

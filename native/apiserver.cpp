@@ -435,11 +435,136 @@ static const std::set<std::string> kNamespaced = {
     "resourcequotas", "daemonsets", "jobs", "roles", "rolebindings",
     "horizontalpodautoscalers"};
 
+// ------------------------------------------------------ field selectors --
+// pkg/fields ParseSelector subset: comma-separated `path=value`,
+// `path==value`, `path!=value`; a missing field compares as "".  The
+// same grammar and set-transition watch semantics as the Python
+// apiserver (api/fieldsel.py) — the conformance tests pin both.
+struct FieldReq {
+  std::vector<std::string> path;
+  bool neq = false;
+  std::string value;
+};
+
+struct FieldSelector {
+  std::vector<FieldReq> reqs;
+  bool ok = true;  // parse success
+  bool empty() const { return reqs.empty(); }
+};
+
+static FieldSelector parse_selector(const std::string& s) {
+  FieldSelector sel;
+  size_t i = 0;
+  while (i <= s.size()) {
+    size_t comma = s.find(',', i);
+    if (comma == std::string::npos) comma = s.size();
+    std::string part = s.substr(i, comma - i);
+    i = comma + 1;
+    // trim
+    size_t b = part.find_first_not_of(" \t");
+    size_t e = part.find_last_not_of(" \t");
+    if (b == std::string::npos) {
+      if (i > s.size()) break;
+      continue;
+    }
+    part = part.substr(b, e - b + 1);
+    FieldReq r;
+    size_t op = part.find("!=");
+    size_t vstart;
+    if (op != std::string::npos) {
+      r.neq = true;
+      vstart = op + 2;
+    } else if ((op = part.find("==")) != std::string::npos) {
+      vstart = op + 2;
+    } else if ((op = part.find('=')) != std::string::npos) {
+      vstart = op + 1;
+    } else {
+      sel.ok = false;
+      return sel;
+    }
+    auto trim = [](std::string v) {
+      size_t tb = v.find_first_not_of(" \t");
+      if (tb == std::string::npos) return std::string();
+      size_t te = v.find_last_not_of(" \t");
+      return v.substr(tb, te - tb + 1);
+    };
+    std::string field = trim(part.substr(0, op));
+    if (field.empty()) {
+      sel.ok = false;
+      return sel;
+    }
+    r.value = trim(part.substr(vstart));
+    size_t j = 0;
+    while (j <= field.size()) {
+      size_t dot = field.find('.', j);
+      if (dot == std::string::npos) dot = field.size();
+      r.path.push_back(field.substr(j, dot - j));
+      j = dot + 1;
+      if (j > field.size()) break;
+    }
+    sel.reqs.push_back(std::move(r));
+  }
+  return sel;
+}
+
+static std::string jfield(const JValue& obj,
+                          const std::vector<std::string>& path) {
+  const JValue* cur = &obj;
+  for (auto& seg : path) {
+    if (cur->type != JValue::Obj) return "";
+    JPtr nxt = cur->get(seg);
+    if (!nxt) return "";
+    cur = nxt.get();
+  }
+  switch (cur->type) {
+    case JValue::Str:
+    case JValue::Num: return cur->s;
+    case JValue::Bool: return cur->b ? "true" : "false";
+    default: return "";
+  }
+}
+
+static bool sel_match(const FieldSelector& sel, const JValue& obj) {
+  for (auto& r : sel.reqs)
+    if ((jfield(obj, r.path) == r.value) == r.neq) return false;
+  return true;
+}
+
+// Set-transition classification for a fielded watcher (cacher.go
+// watchCache semantics): returns the delivered event type, or nullptr
+// to drop.  An object leaving the selected set arrives as DELETED
+// (carrying the new state); one entering it as ADDED.
+static const char* sel_classify(const FieldSelector& sel, const char* etype,
+                                const JValue& obj, const JPtr& prev) {
+  bool m_new = sel_match(sel, obj);
+  bool m_prev = prev && sel_match(sel, *prev);
+  if (!strcmp(etype, "DELETED")) return (m_prev || m_new) ? "DELETED" : nullptr;
+  if (!strcmp(etype, "ADDED")) return m_new ? "ADDED" : nullptr;
+  if (m_new) return m_prev ? "MODIFIED" : "ADDED";
+  return m_prev ? "DELETED" : nullptr;
+}
+
 struct StoredEvent {
   uint64_t rv;
   std::string kind;
+  std::string etype;
+  JPtr obj;                             // new object state
+  JPtr prev;                            // state before the write (or null)
+  std::shared_ptr<std::string> obj_json;  // object serialized once
   std::shared_ptr<std::string> line;  // NDJSON wire form, shared by streams
 };
+
+static std::shared_ptr<std::string> make_line(const char* etype,
+                                              const std::string& obj_json) {
+  auto line = std::make_shared<std::string>();
+  line->reserve(obj_json.size() + 32);
+  *line += "{\"type\":\"";
+  *line += etype;
+  *line += "\",\"object\":";
+  *line += obj_json;
+  *line += "}\n";
+  return line;
+}
 
 struct Conn;  // fwd
 
@@ -458,7 +583,7 @@ struct Store {
   }
 
   void emit(const char* etype, const std::string& kind,
-            const JPtr& obj);
+            const JPtr& obj, const JPtr& prev);
 
   // returns error string or "" on success
   std::string create(const std::string& kind, const JPtr& obj) {
@@ -474,7 +599,7 @@ struct Store {
       meta->set("generation", g);
     }
     bucket[key] = obj;
-    emit("ADDED", kind, obj);
+    emit("ADDED", kind, obj, nullptr);
     return "";
   }
 
@@ -511,8 +636,9 @@ struct Store {
     g->type = JValue::Num;
     g->s = std::to_string(spec_changed ? old_gen + 1 : old_gen);
     meta->set("generation", g);
+    JPtr prev = it->second;
     bucket[key] = obj;
-    emit("MODIFIED", kind, obj);
+    emit("MODIFIED", kind, obj, prev);
     return "";
   }
 
@@ -522,7 +648,7 @@ struct Store {
     if (it == bucket.end()) return false;
     JPtr obj = it->second;
     bucket.erase(it);
-    emit("DELETED", kind, obj);
+    emit("DELETED", kind, obj, obj);
     return true;
   }
 
@@ -554,7 +680,7 @@ struct Store {
     np->set("metadata",
             meta ? std::make_shared<JValue>(*meta) : jobj());
     bucket[key] = np;
-    emit("MODIFIED", "pods", np);
+    emit("MODIFIED", "pods", np, pod);
     *code = 201;
     return "";
   }
@@ -567,6 +693,7 @@ struct Conn {
   std::string out;      // pending writes
   bool is_watch = false;
   std::set<std::string> watch_kinds;
+  FieldSelector sel;    // fielded watch (empty = everything)
   double last_stream_write = 0;
   bool closing = false;
 };
@@ -610,32 +737,43 @@ static void conn_queue(Conn* c, const std::string& s) {
 }
 
 void Store::emit(const char* etype, const std::string& kind,
-                 const JPtr& obj) {
+                 const JPtr& obj, const JPtr& prev) {
   rv += 1;
   auto meta = obj->get("metadata");
   if (!meta) {
     obj->set("metadata", (meta = jobj()));
   }
   meta->set("resourceVersion", jstr(std::to_string(rv)));
-  auto line = std::make_shared<std::string>();
-  line->reserve(256);
-  *line += "{\"type\":\"";
-  *line += etype;
-  *line += "\",\"object\":";
-  jdump(*obj, *line);
-  *line += "}\n";
-  window.push_back({rv, kind, line});
+  auto obj_json = std::make_shared<std::string>();
+  obj_json->reserve(256);
+  jdump(*obj, *obj_json);
+  auto line = make_line(etype, *obj_json);
+  window.push_back({rv, kind, etype, obj, prev, obj_json, line});
   if (window.size() > kWindow) window.pop_front();
+  // Fielded watchers sharing a rewritten type reuse one serialization
+  // (at density rates every bind fans a synthesized DELETED to every
+  // `spec.nodeName=` watcher).
+  std::shared_ptr<std::string> rew_added, rew_deleted;
   for (Conn* c : watchers) {
     if (!c->is_watch || c->closing || !c->watch_kinds.count(kind)) continue;
+    const std::string* dl = line.get();
+    if (!c->sel.empty()) {
+      const char* nt = sel_classify(c->sel, etype, *obj, prev);
+      if (!nt) continue;
+      if (strcmp(nt, etype) != 0) {
+        auto& cache = !strcmp(nt, "ADDED") ? rew_added : rew_deleted;
+        if (!cache) cache = make_line(nt, *obj_json);
+        dl = cache.get();
+      }
+    }
     // One chunk per event here; the kernel coalesces back-to-back sends,
     // and the chunked framing is per-write anyway.
     char hdr[16];
-    int hn = snprintf(hdr, sizeof hdr, "%zx\r\n", line->size());
+    int hn = snprintf(hdr, sizeof hdr, "%zx\r\n", dl->size());
     std::string frame;
-    frame.reserve(line->size() + hn + 2);
+    frame.reserve(dl->size() + hn + 2);
     frame.append(hdr, hn);
-    frame += *line;
+    frame += *dl;
     frame += "\r\n";
     conn_queue(c, frame);
     c->last_stream_write = now_s();
@@ -690,6 +828,30 @@ static std::vector<std::string> split_path(const std::string& path) {
   return parts;
 }
 
+static std::string url_decode(const std::string& in) {
+  std::string out;
+  out.reserve(in.size());
+  auto hex = [](char ch) -> int {
+    if (ch >= '0' && ch <= '9') return ch - '0';
+    if (ch >= 'a' && ch <= 'f') return ch - 'a' + 10;
+    if (ch >= 'A' && ch <= 'F') return ch - 'A' + 10;
+    return -1;
+  };
+  for (size_t i = 0; i < in.size(); i++) {
+    if (in[i] == '%' && i + 2 < in.size()) {
+      int h = hex(in[i + 1]), l = hex(in[i + 2]);
+      if (h >= 0 && l >= 0) {
+        out += (char)(h * 16 + l);
+        i += 2;
+        continue;
+      }
+    }
+    if (in[i] == '+') { out += ' '; continue; }
+    out += in[i];
+  }
+  return out;
+}
+
 static std::map<std::string, std::string> split_query(const std::string& q) {
   std::map<std::string, std::string> out;
   size_t i = 0;
@@ -698,15 +860,17 @@ static std::map<std::string, std::string> split_query(const std::string& q) {
     if (amp == std::string::npos) amp = q.size();
     size_t eq = q.find('=', i);
     if (eq != std::string::npos && eq < amp)
-      out[q.substr(i, eq - i)] = q.substr(eq + 1, amp - eq - 1);
+      out[url_decode(q.substr(i, eq - i))] =
+          url_decode(q.substr(eq + 1, amp - eq - 1));
     else
-      out[q.substr(i, amp - i)] = "";
+      out[url_decode(q.substr(i, amp - i))] = "";
     i = amp + 1;
   }
   return out;
 }
 
-static void handle_list(Conn* c, const std::string& kind) {
+static void handle_list(Conn* c, const std::string& kind,
+                        const FieldSelector& sel) {
   std::string body = "{\"kind\":\"";
   body += (char)toupper(kind[0]);
   body += kind.substr(1);
@@ -715,6 +879,7 @@ static void handle_list(Conn* c, const std::string& kind) {
   bool first = true;
   if (it != g_store.objects.end()) {
     for (auto& kv : it->second) {
+      if (!sel.empty() && !sel_match(sel, *kv.second)) continue;
       if (!first) body += ',';
       first = false;
       jdump(*kv.second, body);
@@ -726,7 +891,8 @@ static void handle_list(Conn* c, const std::string& kind) {
   send_json(c, 200, body);
 }
 
-static void handle_watch(Conn* c, const std::string& kind, uint64_t from) {
+static void handle_watch(Conn* c, const std::string& kind, uint64_t from,
+                         const FieldSelector& sel) {
   // Too-old check mirrors memstore.watch: the requested rv must still be
   // inside (or adjacent to) the buffered window.
   if (!g_store.window.empty() && from + 1 < g_store.window.front().rv &&
@@ -739,16 +905,28 @@ static void handle_watch(Conn* c, const std::string& kind, uint64_t from) {
              "Transfer-Encoding: chunked\r\n\r\n");
   c->is_watch = true;
   c->watch_kinds.insert(kind);
+  c->sel = sel;
   c->last_stream_write = now_s();
   g_store.watchers.push_back(c);
-  // Replay buffered events after `from`.
+  // Replay buffered events after `from`, with the same set-transition
+  // classification live events get.
   std::string frame;
   for (auto& ev : g_store.window) {
     if (ev.rv <= from || ev.kind != kind) continue;
+    const std::string* dl = ev.line.get();
+    std::shared_ptr<std::string> rewritten;
+    if (!sel.empty()) {
+      const char* nt = sel_classify(sel, ev.etype.c_str(), *ev.obj, ev.prev);
+      if (!nt) continue;
+      if (nt != ev.etype) {
+        rewritten = make_line(nt, *ev.obj_json);
+        dl = rewritten.get();
+      }
+    }
     char hdr[16];
-    int hn = snprintf(hdr, sizeof hdr, "%zx\r\n", ev.line->size());
+    int hn = snprintf(hdr, sizeof hdr, "%zx\r\n", dl->size());
     frame.append(hdr, hn);
-    frame += *ev.line;
+    frame += *dl;
     frame += "\r\n";
   }
   if (!frame.empty()) conn_queue(c, frame);
@@ -845,6 +1023,7 @@ static void do_bind_list(Conn* c, const std::string& default_ns,
                          const JPtr& items) {
   std::string results;
   int failed = 0;
+  size_t idx = 0;  // items processed so far (for lazy 201 backfill)
   for (auto& it : items->arr) {
     auto meta = it->type == JValue::Obj ? it->get("metadata") : nullptr;
     std::string ns = meta ? meta->str_or("namespace", "") : "";
@@ -854,9 +1033,15 @@ static void do_bind_list(Conn* c, const std::string& default_ns,
     std::string node = target ? target->str_or("name", "") : "";
     int code = 0;
     std::string err = g_store.bind(ns, name, node, &code);
+    idx++;
     if (code == 201) {
-      results += "{\"code\":201},";
+      // Results stay empty until the first failure: the all-success
+      // batch (the density common case) never pays the per-item
+      // serialization the count-only response discards anyway.
+      if (failed) results += "{\"code\":201},";
     } else {
+      if (!failed)
+        for (size_t k = 1; k < idx; k++) results += "{\"code\":201},";
       failed++;
       JValue e;
       e.type = JValue::Obj;
@@ -869,9 +1054,18 @@ static void do_bind_list(Conn* c, const std::string& default_ns,
       results += ',';
     }
   }
-  if (!results.empty()) results.pop_back();
   std::string body = "{\"kind\":\"BindingListResult\",\"failed\":";
   body += std::to_string(failed);
+  if (failed == 0) {
+    // All bound: the count is the contract; per-item results are
+    // detailed only when something failed (matches the Python server).
+    body += ",\"bound\":";
+    body += std::to_string(items->arr.size());
+    body += "}";
+    send_json(c, 200, body);
+    return;
+  }
+  if (!results.empty()) results.pop_back();
   body += ",\"results\":[";
   body += results;
   body += "]}";
@@ -905,14 +1099,19 @@ static bool dispatch(Conn* c, const std::string& method,
     }
     if (parts.size() == 3 && parts[0] == "api" && parts[1] == "v1") {
       const std::string& kind = parts[2];
+      FieldSelector sel = parse_selector(params["fieldSelector"]);
+      if (!sel.ok) {
+        send_error(c, 400, "invalid field selector");
+        return true;
+      }
       auto w = params.find("watch");
       if (w != params.end() && (w->second == "1" || w->second == "true")) {
         uint64_t from = strtoull(params["resourceVersion"].c_str(),
                                  nullptr, 10);
-        handle_watch(c, kind, from);
+        handle_watch(c, kind, from, sel);
         return !c->is_watch ? true : false;
       }
-      handle_list(c, kind);
+      handle_list(c, kind, sel);
       return true;
     }
     std::string kind, key;

@@ -214,6 +214,11 @@ def test_pvc_volume_zone_over_the_wire(wire):
     _post(f"{api_url}/api/v1/persistentvolumeclaims", {
         "metadata": {"name": "claim-wire", "namespace": "default"},
         "spec": {"volumeName": "pv-wire"}})
+    # Let the daemon's PV/PVC reflectors deliver before the pod arrives:
+    # informers are async streams (here as in the reference), so a pod
+    # solved before the listers fill would legally skip the zone
+    # predicate — not the behavior under test.
+    time.sleep(1.0)
     pod = _pod_json("pvc-pod")
     pod["spec"]["volumes"] = [{
         "name": "data",
