@@ -29,7 +29,9 @@ NAMESPACED_KINDS = frozenset({"pods", "services", "persistentvolumeclaims",
                               "limitranges", "resourcequotas",
                               "daemonsets", "jobs",
                               "roles", "rolebindings",
-                              "horizontalpodautoscalers"})
+                              "horizontalpodautoscalers",
+                              "poddisruptionbudgets", "scheduledjobs",
+                              "petsets"})
 
 AFFINITY_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/affinity"
 TOLERATIONS_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/tolerations"

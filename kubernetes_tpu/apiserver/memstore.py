@@ -38,6 +38,11 @@ WATCH_WINDOW = 1024  # Cacher event window (cacher.go's watchCache capacity)
 SNAPSHOT_EVERY = 4096
 
 
+def _now_rfc3339() -> str:
+    import time as _time
+    return _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+
+
 class TooOldError(Exception):
     """HTTP 410 Gone: requested watch RV fell out of the event window."""
 
@@ -292,7 +297,12 @@ class MemStore:
                 raise ConflictError(f"{kind} {key} already exists")
             if not owned:
                 obj = copy.deepcopy(obj)
-            obj.setdefault("metadata", {}).setdefault("generation", 1)
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("generation", 1)
+            # RFC3339 creation stamp (ObjectMeta.CreationTimestamp): age
+            # ordering for pod GC, and the scheduled-job controller's
+            # earliest-possible-start when lastScheduleTime is unset.
+            meta.setdefault("creationTimestamp", _now_rfc3339())
             bucket[key] = obj
             ev = self._emit("ADDED", kind, key, obj)
             # The event snapshot is already shared read-only with every

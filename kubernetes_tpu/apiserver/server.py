@@ -57,6 +57,8 @@ _STATUS_LINES = {
     409: b"HTTP/1.1 409 Conflict\r\n",
     410: b"HTTP/1.1 410 Gone\r\n",
     422: b"HTTP/1.1 422 Unprocessable Entity\r\n",
+    429: b"HTTP/1.1 429 Too Many Requests\r\n",
+    500: b"HTTP/1.1 500 Internal Server Error\r\n",
     501: b"HTTP/1.1 501 Not Implemented\r\n",
 }
 
@@ -342,6 +344,10 @@ def make_handler(store: MemStore, auth=None):
 
         def _do_post(self, parts, body) -> None:
             try:
+                if len(parts) == 7 and parts[2] == "namespaces" and \
+                        parts[4] == "pods" and parts[6] == "eviction":
+                    self._do_eviction(parts[3], parts[5])
+                    return
                 if len(parts) == 5 and parts[2] == "namespaces" and \
                         parts[4] == "bindings":
                     ns = parts[3]
@@ -381,6 +387,73 @@ def make_handler(store: MemStore, auth=None):
                 self._send_json(404, {"error": str(err)})
                 return
             self._send_json(404, {"error": "unknown path"})
+
+        def _do_eviction(self, ns: str, name: str) -> None:
+            """The eviction subresource (POST .../pods/{name}/eviction —
+            EvictionREST, pkg/registry/pod/etcd/etcd.go:138-230): delete
+            the pod ONLY if its PodDisruptionBudget allows it, with a
+            CAS verify-and-decrement on ``status.disruptionAllowed`` so
+            two racing evictions can't both spend the same budget slot.
+            429 when the budget blocks; >1 matching PDB is the
+            reference's unsupported 500."""
+            from kubernetes_tpu.controller.replication import _matches
+            pod = store.get("pods", f"{ns}/{name}")
+            if pod is None:
+                self._send_json(404, {"error": f"pod {ns}/{name} "
+                                      f"not found"})
+                return
+            pdbs, _ = store.list(
+                "poddisruptionbudgets",
+                lambda o: (o.get("metadata") or {})
+                .get("namespace", "default") == ns)
+            matching = [p for p in pdbs
+                        if _matches((p.get("spec") or {})
+                                    .get("selector") or {}, pod)]
+            if len(matching) > 1:
+                self._send_json(500, {"error":
+                                      "This pod has more than one "
+                                      "PodDisruptionBudget, which the "
+                                      "eviction subresource does not "
+                                      "support."})
+                return
+            if matching:
+                pdb_key = f"{ns}/" + (matching[0].get("metadata") or {}) \
+                    .get("name", "")
+                for _attempt in range(3):
+                    cur = store.get("poddisruptionbudgets", pdb_key)
+                    if cur is None:
+                        break  # PDB vanished: no budget to honor
+                    if not (cur.get("status") or {}) \
+                            .get("disruptionAllowed"):
+                        self._send_json(429, {
+                            "error": "Cannot evict pod as it would "
+                                     "violate the pod's disruption "
+                                     "budget."})
+                        return
+                    # verify-and-decrement: flip allowed -> False under
+                    # CAS; the disruption controller recomputes it after
+                    # the delete lands.
+                    cur.setdefault("status", {})["disruptionAllowed"] = \
+                        False
+                    try:
+                        store.update(
+                            "poddisruptionbudgets", cur,
+                            expected_rv=(cur.get("metadata") or {})
+                            .get("resourceVersion"))
+                        break
+                    except ConflictError:
+                        continue  # racing eviction/controller: re-check
+                else:
+                    self._send_json(429, {"error":
+                                          "disruption budget contended; "
+                                          "retry"})
+                    return
+            try:
+                store.delete("pods", f"{ns}/{name}")
+            except KeyError:
+                self._send_json(404, {"error": "not found"})
+                return
+            self._send_json(201, {"status": "Success"})
 
         def _do_bind_list(self, default_ns: str, items: list) -> None:
             """Batch form of the binding subresource: per-item CAS under

@@ -166,6 +166,74 @@ def validate_hpa(obj: dict) -> list[str]:
     return errors
 
 
+def validate_pdb(obj: dict) -> list[str]:
+    """ValidatePodDisruptionBudget (pkg/apis/policy/validation):
+    minAvailable is an int >= 0 or a percentage string."""
+    errors: list[str] = []
+    _check_name(obj.get("metadata") or {}, errors, "poddisruptionbudget")
+    ma = (obj.get("spec") or {}).get("minAvailable", 0)
+    if isinstance(ma, bool) or not isinstance(ma, (int, str)):
+        errors.append("poddisruptionbudget.spec.minAvailable: must be an "
+                      "integer or a percentage string")
+    elif isinstance(ma, int) and ma < 0:
+        errors.append("poddisruptionbudget.spec.minAvailable: must be "
+                      "non-negative")
+    elif isinstance(ma, str):
+        if not ma.endswith("%"):
+            errors.append("poddisruptionbudget.spec.minAvailable: string "
+                          "form must be a percentage, e.g. '30%'")
+        else:
+            try:
+                pct = float(ma[:-1])
+            except ValueError:
+                errors.append("poddisruptionbudget.spec.minAvailable: "
+                              f"unparseable percentage {ma!r}")
+            else:
+                if pct < 0:
+                    # A negative percentage silently disables the budget
+                    # (desiredHealthy <= 0 allows every eviction).
+                    errors.append("poddisruptionbudget.spec."
+                                  "minAvailable: must be non-negative")
+    return errors
+
+
+def validate_scheduled_job(obj: dict) -> list[str]:
+    """ValidateScheduledJob (pkg/apis/batch/validation): the schedule
+    must parse, the concurrency policy must be a known value, and a job
+    template must exist — a stored garbage schedule would wedge the
+    controller's every sync."""
+    from kubernetes_tpu.utils import cron
+    errors: list[str] = []
+    _check_name(obj.get("metadata") or {}, errors, "scheduledjob")
+    spec = obj.get("spec") or {}
+    try:
+        cron.parse(spec.get("schedule", ""))
+    except ValueError as err:
+        errors.append(f"scheduledjob.spec.schedule: {err}")
+    if spec.get("concurrencyPolicy", "Allow") not in (
+            "Allow", "Forbid", "Replace"):
+        errors.append("scheduledjob.spec.concurrencyPolicy: must be "
+                      "Allow, Forbid or Replace")
+    if not isinstance(spec.get("jobTemplate"), dict):
+        errors.append("scheduledjob.spec.jobTemplate: required")
+    return errors
+
+
+def validate_petset(obj: dict) -> list[str]:
+    """ValidatePetSet (pkg/apis/apps/validation): non-negative replicas
+    and a pod template."""
+    errors: list[str] = []
+    _check_name(obj.get("metadata") or {}, errors, "petset")
+    spec = obj.get("spec") or {}
+    reps = spec.get("replicas", 1)
+    if isinstance(reps, bool) or not isinstance(reps, int) or reps < 0:
+        errors.append("petset.spec.replicas: must be a non-negative "
+                      "integer")
+    if not isinstance(spec.get("template"), dict):
+        errors.append("petset.spec.template: required")
+    return errors
+
+
 def validate_cluster_role_binding(obj: dict) -> list[str]:
     """pkg/apis/rbac/validation: a ClusterRoleBinding's roleRef must name
     a ClusterRole — stored otherwise it would either silently grant
@@ -186,7 +254,10 @@ VALIDATORS = {"pods": validate_pod, "nodes": validate_node,
               "limitranges": validate_limit_range,
               "resourcequotas": validate_resource_quota,
               "horizontalpodautoscalers": validate_hpa,
-              "clusterrolebindings": validate_cluster_role_binding}
+              "clusterrolebindings": validate_cluster_role_binding,
+              "poddisruptionbudgets": validate_pdb,
+              "scheduledjobs": validate_scheduled_job,
+              "petsets": validate_petset}
 
 
 class AdmissionError(Exception):

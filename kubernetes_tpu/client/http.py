@@ -157,6 +157,17 @@ class APIClient:
             "target": {"apiVersion": "v1", "kind": "Node",
                        "name": node_name}})
 
+    def evict(self, namespace: str, pod_name: str) -> None:
+        """POST the eviction subresource (policy Eviction,
+        pkg/registry/pod/etcd/etcd.go EvictionREST): delete-if-budget-
+        allows.  Raises APIError(429) when a PodDisruptionBudget blocks
+        the eviction."""
+        self._request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{pod_name}/eviction",
+            {"apiVersion": "policy/v1alpha1", "kind": "Eviction",
+             "metadata": {"name": pod_name, "namespace": namespace}})
+
     def bind_list(self, bindings: list[tuple[str, str, str]]
                   ) -> list[Optional[str]]:
         """Batch bindings: one POST carrying a Binding list; the server

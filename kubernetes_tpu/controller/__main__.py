@@ -25,14 +25,20 @@ import threading
 
 from kubernetes_tpu.controller.daemonset import DaemonSetController
 from kubernetes_tpu.controller.deployment import DeploymentController
+from kubernetes_tpu.controller.disruption import DisruptionController
 from kubernetes_tpu.controller.endpoints import EndpointsController
+from kubernetes_tpu.controller.garbagecollector import GarbageCollector
 from kubernetes_tpu.controller.job import JobController
 from kubernetes_tpu.controller.namespace import NamespaceController
 from kubernetes_tpu.controller.node import NodeLifecycleController
+from kubernetes_tpu.controller.petset import PetSetController
 from kubernetes_tpu.controller.podautoscaler import (
     HorizontalPodAutoscaler)
 from kubernetes_tpu.controller.podgc import PodGCController
 from kubernetes_tpu.controller.replication import ReplicationManager
+from kubernetes_tpu.controller.resourcequota import (
+    ResourceQuotaController)
+from kubernetes_tpu.controller.scheduledjob import ScheduledJobController
 from kubernetes_tpu.utils.logging import configure, get_logger
 
 log = get_logger("controller-manager")
@@ -85,9 +91,20 @@ def main(argv=None) -> int:
             threshold=opts.terminated_pod_gc_threshold).run())
         controllers.append(
             HorizontalPodAutoscaler(opts.api_server, token=tok).run())
+        controllers.append(
+            DisruptionController(opts.api_server, token=tok).run())
+        controllers.append(
+            ScheduledJobController(opts.api_server, token=tok).run())
+        controllers.append(
+            PetSetController(opts.api_server, token=tok).run())
+        controllers.append(
+            ResourceQuotaController(opts.api_server, token=tok).run())
+        controllers.append(
+            GarbageCollector(opts.api_server, token=tok).run())
         log.info("controller-manager running (replication + deployment + "
                  "node lifecycle + endpoints + namespace + daemonset + "
-                 "job + podgc + hpa)")
+                 "job + podgc + hpa + disruption + scheduledjob + "
+                 "petset + resourcequota + gc)")
 
     elector = None
     if opts.leader_elect:

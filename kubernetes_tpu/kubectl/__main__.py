@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 from kubernetes_tpu.client.http import APIClient, APIError
 
@@ -61,6 +62,12 @@ ALIASES = {
     "hpa": "horizontalpodautoscalers",
     "horizontalpodautoscaler": "horizontalpodautoscalers",
     "horizontalpodautoscalers": "horizontalpodautoscalers",
+    "pdb": "poddisruptionbudgets",
+    "poddisruptionbudget": "poddisruptionbudgets",
+    "poddisruptionbudgets": "poddisruptionbudgets",
+    "sj": "scheduledjobs", "scheduledjob": "scheduledjobs",
+    "scheduledjobs": "scheduledjobs",
+    "petset": "petsets", "petsets": "petsets",
 }
 
 # Kinds whose storage keys carry a namespace (matches the apiserver).
@@ -479,11 +486,40 @@ def cmd_drain(client: APIClient, opts, out) -> int:
               f"ReplicaSet (use --force to override): {names}", file=out)
         return 1
     failures = 0
+    deadline = time.time() + max(0.0, getattr(opts, "timeout", 5.0))
     for p in mine:
         meta = p.get("metadata") or {}
         pns = meta.get("namespace", "default")
         try:
-            client.delete("pods", f"{pns}/{meta.get('name')}")
+            # The eviction subresource honors PodDisruptionBudgets
+            # (EvictionREST): a blocked eviction comes back 429 and the
+            # pod stays — retried until --timeout, because each granted
+            # eviction SPENDS the budget (verify-and-decrement) and the
+            # disruption controller must observe the delete before it
+            # re-opens ``disruptionAllowed``.  A server without the
+            # route (404) gets the plain delete drain used before PDBs
+            # existed.
+            while True:
+                try:
+                    client.evict(pns, meta.get("name", ""))
+                    break
+                except APIError as err:
+                    if err.status == 404:
+                        if "unknown path" in str(err):
+                            # Server without the eviction route (the
+                            # native rig): plain delete, and a pod
+                            # already gone counts as drained (kubectl
+                            # treats NotFound as success).
+                            try:
+                                client.delete(
+                                    "pods", f"{pns}/{meta.get('name')}")
+                            except APIError as derr:
+                                if derr.status != 404:
+                                    raise
+                        break  # pod 404: already gone = drained
+                    if err.status != 429 or time.time() >= deadline:
+                        raise
+                    time.sleep(0.2)
             print(f"pod/{meta.get('name')} evicted", file=out)
         except APIError as err:
             failures += 1
@@ -540,6 +576,10 @@ def main(argv=None, out=sys.stdout) -> int:
     dr.add_argument("--ignore-daemonsets", action="store_true",
                     help="proceed past DaemonSet-managed pods (left in "
                          "place; the daemon controller ignores cordons)")
+    dr.add_argument("--timeout", type=float, default=5.0,
+                    help="how long to keep retrying evictions a "
+                         "PodDisruptionBudget blocks (429) before "
+                         "reporting the drain failed")
 
     sc = sub.add_parser("scale")
     sc.add_argument("resource")

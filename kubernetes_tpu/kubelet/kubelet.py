@@ -232,6 +232,14 @@ class HollowKubelet:
         if reason:
             status["reason"] = reason
         if phase == "Running":
+            # The real kubelet's status manager stamps the PodReady
+            # condition alongside Running (pkg/kubelet/status); the
+            # disruption controller counts healthy = Running AND Ready
+            # (disruption.go countHealthyPods), so without this a PDB
+            # over hollow pods would never see a healthy pod.
+            conds = status.setdefault("conditions", [])
+            conds[:] = [c for c in conds if c.get("type") != "Ready"]
+            conds.append({"type": "Ready", "status": "True"})
             usage = ((obj.get("metadata") or {}).get("annotations")
                      or {}).get(self.CPU_USAGE_ANN)
             if usage:

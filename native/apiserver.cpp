@@ -433,7 +433,8 @@ static const std::set<std::string> kNamespaced = {
     "pods", "services", "persistentvolumeclaims", "replicationcontrollers",
     "replicasets", "endpoints", "events", "deployments", "limitranges",
     "resourcequotas", "daemonsets", "jobs", "roles", "rolebindings",
-    "horizontalpodautoscalers"};
+    "horizontalpodautoscalers", "poddisruptionbudgets", "scheduledjobs",
+    "petsets"};
 
 // ------------------------------------------------------ field selectors --
 // pkg/fields ParseSelector subset: comma-separated `path=value`,
@@ -597,6 +598,16 @@ struct Store {
       g->type = JValue::Num;
       g->s = "1";
       meta->set("generation", g);
+    }
+    if (!meta->get("creationTimestamp")) {
+      // RFC3339 creation stamp (ObjectMeta.CreationTimestamp), same as
+      // the Python store.
+      time_t t = time(nullptr);
+      struct tm g;
+      gmtime_r(&t, &g);
+      char buf[32];
+      strftime(buf, sizeof buf, "%Y-%m-%dT%H:%M:%SZ", &g);
+      meta->set("creationTimestamp", jstr(buf));
     }
     bucket[key] = obj;
     emit("ADDED", kind, obj, nullptr);
