@@ -31,7 +31,8 @@ NAMESPACED_KINDS = frozenset({"pods", "services", "persistentvolumeclaims",
                               "roles", "rolebindings",
                               "horizontalpodautoscalers",
                               "poddisruptionbudgets", "scheduledjobs",
-                              "petsets"})
+                              "petsets",
+                              "secrets", "configmaps", "serviceaccounts"})
 
 AFFINITY_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/affinity"
 TOLERATIONS_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/tolerations"

@@ -55,10 +55,10 @@ def main(argv=None) -> int:
     auth = None
     if opts.token_auth_file or opts.authorization_policy_file or \
             opts.authorization_mode == "RBAC":
-        from kubernetes_tpu.apiserver.auth import (ABACAuthorizer,
-                                                   AuthConfig,
-                                                   RBACAuthorizer,
-                                                   TokenAuthenticator)
+        from kubernetes_tpu.apiserver.auth import (
+            ABACAuthorizer, AuthConfig, RBACAuthorizer,
+            ServiceAccountAuthenticator, TokenAuthenticator,
+            UnionAuthenticator)
         if opts.authorization_mode == "RBAC":
             authorizer = RBACAuthorizer(store)
         elif opts.authorization_policy_file:
@@ -66,10 +66,20 @@ def main(argv=None) -> int:
                 opts.authorization_policy_file)
         else:
             authorizer = None
+        # Union authenticator (the reference's request-auth union):
+        # static tokenfile entries AND live service-account token
+        # secrets both authenticate.
         auth = AuthConfig(
-            authenticator=TokenAuthenticator.from_file(opts.token_auth_file)
-            if opts.token_auth_file else None,
-            authorizer=authorizer)
+            authenticator=UnionAuthenticator(
+                TokenAuthenticator.from_file(opts.token_auth_file)
+                if opts.token_auth_file else None,
+                ServiceAccountAuthenticator(store)),
+            authorizer=authorizer,
+            # No static token source -> the x509-only posture, where a
+            # certless, tokenless request is system:anonymous for the
+            # authorizer (r4's secure-port behavior); with a tokenfile,
+            # credential-less requests are 401.
+            anonymous=not opts.token_auth_file)
     server = serve(store, port=opts.port, host=opts.host, auth=auth,
                    tls_cert=opts.tls_cert_file,
                    tls_key=opts.tls_private_key_file,

@@ -70,6 +70,109 @@ class TokenAuthenticator:
         return user
 
 
+SA_TOKEN_TYPE = "kubernetes.io/service-account-token"
+SA_NAME_ANNOTATION = "kubernetes.io/service-account.name"
+
+
+def serviceaccount_username(namespace: str, name: str) -> str:
+    """pkg/serviceaccount MakeUsername."""
+    return f"system:serviceaccount:{namespace}:{name}"
+
+
+class ServiceAccountAuthenticator:
+    """ServiceAccount token authentication (pkg/serviceaccount/jwt.go's
+    role): a bearer token is valid iff a live Secret of type
+    ``kubernetes.io/service-account-token`` carries it, and resolves to
+    the SA identity ``system:serviceaccount:<ns>:<name>`` with the
+    ``system:serviceaccounts`` group pair.  Where the reference verifies
+    a JWT signature offline, this store-backed check gives the same
+    revocation story the reference ALSO enforces (tokens die with their
+    secret, jwt.go lookup = true path)."""
+
+    def __init__(self, store):
+        self._store = store
+        # token -> (namespace, sa_name), maintained from a secrets
+        # watch: the authenticator sits on the request hot path, and an
+        # O(all-secrets) scan per bearer token would grow with the
+        # cluster.  Started lazily so constructing the authenticator
+        # stays side-effect free.
+        import threading
+        self._index: dict[str, tuple[str, str]] = {}
+        self._index_lock = threading.Lock()
+        self._reflector = None
+        self._ready = threading.Event()
+
+    def _on_secret(self, etype: str, obj: dict) -> None:
+        if obj.get("type") != SA_TOKEN_TYPE:
+            return
+        meta = obj.get("metadata") or {}
+        token = (obj.get("data") or {}).get("token")
+        sa_name = (meta.get("annotations") or {}).get(
+            SA_NAME_ANNOTATION, "")
+        if not token or not sa_name:
+            return
+        with self._index_lock:
+            if etype == "DELETED":
+                self._index.pop(token, None)
+            else:
+                self._index[token] = (meta.get("namespace", "default"),
+                                      sa_name)
+
+    def _ensure_watch(self) -> None:
+        if self._ready.is_set():
+            return
+        with self._index_lock:
+            starter = self._reflector is None
+            if starter:
+                from kubernetes_tpu.client.reflector import Reflector
+                self._reflector = Reflector(self._store, "secrets",
+                                            self._on_secret)
+        if starter:
+            # run() outside the index lock: the initial list delivers
+            # through _on_secret, which takes it.
+            self._reflector.run()
+            self._reflector.wait_for_sync()
+            self._ready.set()
+        else:
+            self._ready.wait(timeout=10)
+
+    def authenticate(self, authorization: str) -> UserInfo:
+        scheme, _, token = authorization.partition(" ")
+        token = token.strip()
+        if scheme.lower() != "bearer" or not token:
+            raise AuthenticationError("expected a bearer token")
+        try:
+            self._ensure_watch()
+        except Exception as err:  # noqa: BLE001 — store unreadable: 401
+            raise AuthenticationError("token lookup failed") from err
+        with self._index_lock:
+            hit = self._index.get(token)
+        if hit is None:
+            raise AuthenticationError("unknown token")
+        ns, sa_name = hit
+        return UserInfo(
+            name=serviceaccount_username(ns, sa_name),
+            groups=("system:serviceaccounts",
+                    f"system:serviceaccounts:{ns}"))
+
+
+class UnionAuthenticator:
+    """union.AuthenticatorRequest: first authenticator to accept wins;
+    401 only when every one refuses."""
+
+    def __init__(self, *authenticators):
+        self._authenticators = [a for a in authenticators if a is not None]
+
+    def authenticate(self, authorization: str) -> UserInfo:
+        last: Exception = AuthenticationError("no authenticators")
+        for a in self._authenticators:
+            try:
+                return a.authenticate(authorization)
+            except AuthenticationError as err:
+                last = err
+        raise last
+
+
 @dataclass
 class ABACAuthorizer:
     """abac.PolicyList.Authorize: any matching policy allows."""
@@ -155,6 +258,15 @@ class RBACAuthorizer:
             return name == "*" or name == user.name
         if kind == "Group":
             return name in user.groups
+        if kind == "ServiceAccount":
+            # pkg/apis/rbac validation REQUIRES namespace on SA
+            # subjects; silently defaulting it would make a forgetful
+            # ClusterRoleBinding grant to default/<name> — a different
+            # principal than intended.  No namespace, no match.
+            ns = subj.get("namespace")
+            if not ns:
+                return False
+            return user.name == serviceaccount_username(ns, name)
         return False
 
     def _role_rules(self, ref: dict, namespace: str) -> list[dict]:
@@ -213,6 +325,12 @@ class AuthConfig:
 
     authenticator: Optional[TokenAuthenticator] = None
     authorizer: Optional[object] = None   # ABACAuthorizer | RBACAuthorizer
+    # --anonymous-auth analogue: with it on, a request carrying NO
+    # credentials proceeds as system:anonymous for the authorizer to
+    # judge (the x509-only secure port's behavior); off, a configured
+    # authenticator 401s credential-less requests (the tokenfile
+    # server's behavior).
+    anonymous: bool = False
 
     def check(self, authorization: str, verb: str, resource: str,
               namespace: str = "",
@@ -222,7 +340,10 @@ class AuthConfig:
         verified-client-cert identity (x509 authenticator): it outranks
         the token layer, as the reference's request-auth union does."""
         user = peer_user
-        if user is None and self.authenticator is not None:
+        if user is None and self.authenticator is not None and \
+                (authorization or not self.anonymous):
+            # Credentials present must authenticate; with anonymous auth
+            # off, absent credentials fail the same way (401).
             try:
                 user = self.authenticator.authenticate(authorization)
             except AuthenticationError as err:

@@ -560,6 +560,82 @@ class ResourceQuota:
                 "unset_cpu": unset_cpu, "unset_memory": unset_mem}
 
 
+SA_MOUNT_PATH = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class ServiceAccount:
+    """plugin/pkg/admission/serviceaccount/admission.go: every pod runs
+    AS a service account —
+
+    * an unset ``spec.serviceAccountName`` defaults to ``default``
+      (admission.go DefaultServiceAccountName);
+    * a pod naming a MISSING non-default SA bounces 403 (admission.go
+      getServiceAccount error path) — it would run with credentials
+      that don't exist;
+    * the SA's token secret is mounted at the canonical path into every
+      container that doesn't already mount one (admission.go
+      mountServiceAccountToken).
+
+    Deviation, documented: a missing ``default`` SA skips the mount
+    instead of rejecting — the serviceaccounts controller creates it
+    asynchronously, and the reference's own perf master runs
+    AlwaysAdmit precisely to avoid this bootstrap coupling."""
+
+    name = "ServiceAccount"
+
+    def __init__(self, store=None):
+        self._store = store
+
+    def admit(self, kind: str, obj: dict, op: str = "create") -> None:
+        if kind != "pods" or op != "create" or self._store is None:
+            return
+        meta = obj.get("metadata") or {}
+        ns = meta.get("namespace") or "default"
+        spec = obj.setdefault("spec", {})
+        sa_name = spec.get("serviceAccountName") or \
+            spec.get("serviceAccount") or "default"
+        spec["serviceAccountName"] = sa_name
+        spec["serviceAccount"] = sa_name  # 1.x carries both fields
+        sa = self._store.get("serviceaccounts", f"{ns}/{sa_name}")
+        if sa is None:
+            if sa_name != "default":
+                raise AdmissionError(
+                    f"{self.name}: service account {ns}/{sa_name} "
+                    f"does not exist")
+            return  # bootstrap window: controller will create it
+        refs = sa.get("secrets") or []
+        token_name = refs[0].get("name", "") if refs else ""
+        if not token_name:
+            if sa_name != "default":
+                # The reference rejects until the token exists
+                # (admission.go mountServiceAccountToken: "no API token
+                # found ... retry after the token is automatically
+                # created") — admitting now would run the pod without
+                # credentials forever, since nothing reconciles mounts
+                # post-create.
+                raise AdmissionError(
+                    f"{self.name}: no API token found for service "
+                    f"account {ns}/{sa_name}; retry after the token "
+                    f"controller creates it")
+            return  # default-SA bootstrap window (documented deviation)
+        volumes = spec.setdefault("volumes", [])
+        vol_name = None
+        for v in volumes:
+            if (v.get("secret") or {}).get("secretName") == token_name:
+                vol_name = v.get("name")
+                break
+        if vol_name is None:
+            vol_name = f"{token_name}-volume"
+            volumes.append({"name": vol_name,
+                            "secret": {"secretName": token_name}})
+        for c in _pod_containers(obj):
+            mounts = c.setdefault("volumeMounts", [])
+            if any(m.get("mountPath") == SA_MOUNT_PATH for m in mounts):
+                continue
+            mounts.append({"name": vol_name, "readOnly": True,
+                           "mountPath": SA_MOUNT_PATH})
+
+
 class NamespaceLifecycle:
     """plugin/pkg/admission/namespace/lifecycle: reject creates into a
     namespace that is being torn down.  Unlike the reference, a namespace
@@ -590,9 +666,11 @@ DEFAULT_ADMISSION = (LimitPodHardAntiAffinityTopology(),)
 
 def store_admission(store) -> tuple:
     """The server's default chain, in the reference's plugin order:
-    namespace lifecycle first, the anti-affinity veto, LimitRanger
-    defaulting, then ResourceQuota against the post-default requests."""
-    return (NamespaceLifecycle(store), LimitPodHardAntiAffinityTopology(),
+    namespace lifecycle first, ServiceAccount defaulting/mounting, the
+    anti-affinity veto, LimitRanger defaulting, then ResourceQuota
+    against the post-default requests."""
+    return (NamespaceLifecycle(store), ServiceAccount(store),
+            LimitPodHardAntiAffinityTopology(),
             LimitRanger(store), ResourceQuota(store))
 
 

@@ -39,6 +39,8 @@ from kubernetes_tpu.controller.replication import ReplicationManager
 from kubernetes_tpu.controller.resourcequota import (
     ResourceQuotaController)
 from kubernetes_tpu.controller.scheduledjob import ScheduledJobController
+from kubernetes_tpu.controller.serviceaccounts import (
+    ServiceAccountsController)
 from kubernetes_tpu.utils.logging import configure, get_logger
 
 log = get_logger("controller-manager")
@@ -101,10 +103,12 @@ def main(argv=None) -> int:
             ResourceQuotaController(opts.api_server, token=tok).run())
         controllers.append(
             GarbageCollector(opts.api_server, token=tok).run())
+        controllers.append(
+            ServiceAccountsController(opts.api_server, token=tok).run())
         log.info("controller-manager running (replication + deployment + "
                  "node lifecycle + endpoints + namespace + daemonset + "
                  "job + podgc + hpa + disruption + scheduledjob + "
-                 "petset + resourcequota + gc)")
+                 "petset + resourcequota + gc + serviceaccounts)")
 
     elector = None
     if opts.leader_elect:

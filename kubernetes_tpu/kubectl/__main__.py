@@ -68,6 +68,11 @@ ALIASES = {
     "sj": "scheduledjobs", "scheduledjob": "scheduledjobs",
     "scheduledjobs": "scheduledjobs",
     "petset": "petsets", "petsets": "petsets",
+    "secret": "secrets", "secrets": "secrets",
+    "cm": "configmaps", "configmap": "configmaps",
+    "configmaps": "configmaps",
+    "sa": "serviceaccounts", "serviceaccount": "serviceaccounts",
+    "serviceaccounts": "serviceaccounts",
 }
 
 # Kinds whose storage keys carry a namespace (matches the apiserver).

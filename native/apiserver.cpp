@@ -434,7 +434,7 @@ static const std::set<std::string> kNamespaced = {
     "replicasets", "endpoints", "events", "deployments", "limitranges",
     "resourcequotas", "daemonsets", "jobs", "roles", "rolebindings",
     "horizontalpodautoscalers", "poddisruptionbudgets", "scheduledjobs",
-    "petsets"};
+    "petsets", "secrets", "configmaps", "serviceaccounts"};
 
 // ------------------------------------------------------ field selectors --
 // pkg/fields ParseSelector subset: comma-separated `path=value`,

@@ -1,0 +1,384 @@
+"""Secrets / ConfigMaps / ServiceAccounts end-to-end (VERDICT r4
+missing #2): the kinds, the serviceaccounts+tokens controllers, the
+ServiceAccount admission plugin, SA-token authentication and RBAC
+ServiceAccount subjects.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.apiserver.auth import (SA_NAME_ANNOTATION,
+                                           SA_TOKEN_TYPE,
+                                           AuthConfig, RBACAuthorizer,
+                                           ServiceAccountAuthenticator,
+                                           UnionAuthenticator, UserInfo)
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.apiserver.server import serve
+from kubernetes_tpu.controller.serviceaccounts import (
+    ServiceAccountsController)
+
+
+def _wait(cond, timeout=15.0, period=0.05, msg=""):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            v = cond()
+        except Exception:  # noqa: BLE001
+            v = None
+        if v:
+            return v
+        time.sleep(period)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestController:
+    def test_default_sa_and_token_per_namespace(self):
+        store = MemStore()
+        c = ServiceAccountsController(store, sync_period=0.05).run()
+        try:
+            sa = _wait(lambda: store.get("serviceaccounts",
+                                         "default/default"),
+                       msg="default/default SA")
+            secret = _wait(
+                lambda: next((s for s in store.list("secrets")[0]
+                              if s.get("type") == SA_TOKEN_TYPE), None),
+                msg="token secret minted")
+            assert (secret["metadata"]["annotations"]
+                    [SA_NAME_ANNOTATION]) == "default"
+            assert secret["data"]["token"]
+            sa = _wait(lambda: (store.get("serviceaccounts",
+                                          "default/default")
+                                or {}).get("secrets") and
+                       store.get("serviceaccounts", "default/default"),
+                       msg="SA references its token")
+            assert sa["secrets"][0]["name"] == \
+                secret["metadata"]["name"]
+            # A new Namespace object gets its own default SA + token.
+            store.create("namespaces", {"metadata": {"name": "team-a"}})
+            _wait(lambda: store.get("serviceaccounts",
+                                    "team-a/default"),
+                  msg="team-a default SA")
+            _wait(lambda: any(
+                (s["metadata"].get("namespace")) == "team-a"
+                and s.get("type") == SA_TOKEN_TYPE
+                for s in store.list("secrets")[0]),
+                msg="team-a token")
+            # Deleting an SA reaps its token secrets.
+            store.delete("serviceaccounts", "team-a/default")
+            _wait(lambda: not any(
+                s["metadata"].get("namespace") == "team-a"
+                and s.get("type") == SA_TOKEN_TYPE
+                and (s["metadata"].get("annotations") or {})
+                .get(SA_NAME_ANNOTATION) == "default"
+                for s in store.list("secrets")[0]),
+                msg="orphan token reaped")
+        finally:
+            c.stop()
+
+
+class TestAdmission:
+    def _rig(self):
+        store = MemStore()
+        srv = serve(store, port=0)
+        return store, srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+    def _post(self, base, path, obj):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(obj).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read() or b"{}")
+
+    def test_defaults_sa_and_mounts_token(self):
+        store, srv, base = self._rig()
+        try:
+            store.create("serviceaccounts", {
+                "metadata": {"name": "default", "namespace": "default"},
+                "secrets": [{"name": "default-token-abc12"}]})
+            store.create("secrets", {
+                "metadata": {"name": "default-token-abc12",
+                             "namespace": "default",
+                             "annotations": {
+                                 SA_NAME_ANNOTATION: "default"}},
+                "type": SA_TOKEN_TYPE, "data": {"token": "t0k"}})
+            code, pod = self._post(base, "/api/v1/pods", {
+                "metadata": {"name": "p"},
+                "spec": {"containers": [{"name": "c"}]}})
+            assert code == 201
+            assert pod["spec"]["serviceAccountName"] == "default"
+            vols = pod["spec"]["volumes"]
+            assert vols[0]["secret"]["secretName"] == \
+                "default-token-abc12"
+            mounts = pod["spec"]["containers"][0]["volumeMounts"]
+            assert mounts[0]["mountPath"] == \
+                "/var/run/secrets/kubernetes.io/serviceaccount"
+            assert mounts[0]["readOnly"] is True
+        finally:
+            srv.shutdown()
+
+    def test_missing_nondefault_sa_403(self):
+        store, srv, base = self._rig()
+        try:
+            code, body = self._post(base, "/api/v1/pods", {
+                "metadata": {"name": "p"},
+                "spec": {"serviceAccountName": "builder",
+                         "containers": [{"name": "c"}]}})
+            assert code == 403
+            assert "does not exist" in body["error"]
+            # Missing DEFAULT SA is the bootstrap window: admitted
+            # without a mount.
+            code, pod = self._post(base, "/api/v1/pods", {
+                "metadata": {"name": "p2"},
+                "spec": {"containers": [{"name": "c"}]}})
+            assert code == 201
+            assert pod["spec"]["serviceAccountName"] == "default"
+            assert "volumes" not in pod["spec"] or not \
+                pod["spec"]["volumes"]
+        finally:
+            srv.shutdown()
+
+
+class TestSATokenAuth:
+    def test_token_authenticates_and_rbac_sa_subject(self):
+        store = MemStore()
+        store.create("serviceaccounts", {
+            "metadata": {"name": "deployer", "namespace": "ci"}})
+        store.create("secrets", {
+            "metadata": {"name": "deployer-token-x", "namespace": "ci",
+                         "annotations": {SA_NAME_ANNOTATION: "deployer"}},
+            "type": SA_TOKEN_TYPE, "data": {"token": "sa-secret-token"}})
+        authn = ServiceAccountAuthenticator(store)
+        user = authn.authenticate("Bearer sa-secret-token")
+        assert user.name == "system:serviceaccount:ci:deployer"
+        assert "system:serviceaccounts" in user.groups
+        assert "system:serviceaccounts:ci" in user.groups
+        from kubernetes_tpu.apiserver.auth import AuthenticationError
+        with pytest.raises(AuthenticationError):
+            authn.authenticate("Bearer wrong")
+        # Token dies with its secret (the reference's revocation story;
+        # the authenticator's secret watch delivers asynchronously).
+        store.delete("secrets", "ci/deployer-token-x")
+
+        def _revoked():
+            try:
+                authn.authenticate("Bearer sa-secret-token")
+                return False
+            except AuthenticationError:
+                return True
+        _wait(_revoked, msg="token revoked with its secret")
+
+        # RBAC ServiceAccount subject grants to exactly that SA.
+        store.create("roles", {
+            "metadata": {"name": "pod-reader", "namespace": "ci"},
+            "rules": [{"verbs": ["get", "list"],
+                       "resources": ["pods"]}]})
+        store.create("rolebindings", {
+            "metadata": {"name": "rb", "namespace": "ci"},
+            "subjects": [{"kind": "ServiceAccount", "name": "deployer",
+                          "namespace": "ci"}],
+            "roleRef": {"kind": "Role", "name": "pod-reader"}})
+        store.create("rolebindings", {
+            "metadata": {"name": "rb-no-ns", "namespace": "ci"},
+            "subjects": [{"kind": "ServiceAccount", "name": "other"}],
+            "roleRef": {"kind": "Role", "name": "pod-reader"}})
+        rbac = RBACAuthorizer(store)
+        assert rbac.authorize(user, "GET", "pods", "ci")
+        # An SA subject WITHOUT a namespace matches nothing (rbac
+        # validation requires it; defaulting would grant to a different
+        # principal than intended).
+        assert not rbac.authorize(
+            UserInfo(name="system:serviceaccount:ci:other",
+                     groups=("system:serviceaccounts",)),
+            "GET", "pods", "ci")
+        assert not rbac.authorize(user, "POST", "pods", "ci")
+        assert not rbac.authorize(
+            UserInfo(name="system:serviceaccount:ci:other"),
+            "GET", "pods", "ci")
+
+    def test_sa_token_over_the_wire(self):
+        """A controller-shaped client authenticates with its SA token
+        against the authenticated port, RBAC scoping its reads."""
+        from kubernetes_tpu.client.http import APIClient, APIError
+        store = MemStore()
+        store.create("serviceaccounts", {
+            "metadata": {"name": "watcher", "namespace": "default"}})
+        store.create("secrets", {
+            "metadata": {"name": "watcher-token-1",
+                         "namespace": "default",
+                         "annotations": {SA_NAME_ANNOTATION: "watcher"}},
+            "type": SA_TOKEN_TYPE, "data": {"token": "wire-tok"}})
+        store.create("clusterroles", {
+            "metadata": {"name": "reader"},
+            "rules": [{"verbs": ["get", "list", "watch"],
+                       "resources": ["pods"]}]})
+        store.create("clusterrolebindings", {
+            "metadata": {"name": "crb"},
+            "subjects": [{"kind": "ServiceAccount", "name": "watcher",
+                          "namespace": "default"}],
+            "roleRef": {"kind": "ClusterRole", "name": "reader"}})
+        auth = AuthConfig(
+            authenticator=UnionAuthenticator(
+                ServiceAccountAuthenticator(store)),
+            authorizer=RBACAuthorizer(store))
+        srv = serve(store, port=0, auth=auth)
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            good = APIClient(base, token="wire-tok")
+            items, _ = good.list("pods")
+            assert items == []
+            with pytest.raises(APIError) as e:
+                good.create("pods", {
+                    "metadata": {"name": "nope"},
+                    "spec": {"containers": [{"name": "c"}]}})
+            assert e.value.status == 403
+            bad = APIClient(base, token="forged")
+            with pytest.raises(APIError) as e:
+                bad.list("pods")
+            assert e.value.status == 401
+        finally:
+            srv.shutdown()
+
+
+class TestSecretsConfigMapsKinds:
+    def test_crud_and_namespacing_both_servers(self):
+        """Secrets/ConfigMaps/ServiceAccounts are namespaced kinds on
+        BOTH servers."""
+        import socket
+        import subprocess
+
+        from kubernetes_tpu.apiserver.native import native_binary
+
+        def drive(base):
+            def req(method, path, body=None):
+                r = urllib.request.Request(
+                    base + path, method=method,
+                    data=json.dumps(body).encode()
+                    if body is not None else None,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(r, timeout=5) as resp:
+                        return resp.status, json.loads(resp.read())
+                except urllib.error.HTTPError as err:
+                    return err.code, json.loads(err.read() or b"{}")
+            code, created = req("POST", "/api/v1/secrets", {
+                "metadata": {"name": "pw"},
+                "type": "Opaque", "data": {"password": "hunter2"}})
+            assert code == 201
+            assert created["metadata"]["namespace"] == "default"
+            code, got = req("GET",
+                            "/api/v1/namespaces/default/secrets/pw")
+            assert code == 200 and got["data"]["password"] == "hunter2"
+            code, _ = req("POST", "/api/v1/configmaps", {
+                "metadata": {"name": "cfg"},
+                "data": {"max": "10"}})
+            assert code == 201
+            code, got = req(
+                "GET", "/api/v1/namespaces/default/configmaps/cfg")
+            assert code == 200 and got["data"]["max"] == "10"
+            code, _ = req("POST", "/api/v1/serviceaccounts", {
+                "metadata": {"name": "sa1"}})
+            assert code == 201
+            code, _ = req(
+                "DELETE", "/api/v1/namespaces/default/secrets/pw")
+            assert code == 200
+
+        store = MemStore()
+        srv = serve(store, port=0)
+        try:
+            drive(f"http://127.0.0.1:{srv.server_address[1]}")
+        finally:
+            srv.shutdown()
+
+        binary = native_binary()
+        if binary is None:
+            pytest.skip("no C++ toolchain")
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        proc = subprocess.Popen([binary, "--port", str(port)],
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        try:
+            base = f"http://127.0.0.1:{port}"
+            _wait(lambda: urllib.request.urlopen(
+                base + "/healthz", timeout=2).read() == b"ok",
+                msg="native up")
+            drive(base)
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+class TestEndToEnd:
+    def test_pod_with_secret_env_and_default_sa_runs(self):
+        """The VERDICT done-bar: a pod referencing a secret env with the
+        default SA schedules and runs on the hollow kubelet, with the
+        token volume mounted by admission."""
+        from kubernetes_tpu.api import types as api
+        from kubernetes_tpu.kubelet.kubelet import HollowKubelet
+        from kubernetes_tpu.scheduler.factory import ConfigFactory
+
+        store = MemStore()
+        srv = serve(store, port=0)
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        sac = ServiceAccountsController(store, sync_period=0.05).run()
+        node = api.Node(
+            name="sn-0", labels={api.HOSTNAME_LABEL: "sn-0"},
+            allocatable_milli_cpu=4000,
+            allocatable_memory=16 * 1024 ** 3, allocatable_pods=110,
+            conditions=[api.NodeCondition("Ready", "True")])
+        kubelet = HollowKubelet(store, node).run()
+        factory = ConfigFactory(base).run()
+        try:
+            store.create("secrets", {
+                "metadata": {"name": "db-creds", "namespace": "default"},
+                "type": "Opaque", "data": {"password": "hunter2"}})
+            _wait(lambda: (store.get("serviceaccounts",
+                                     "default/default") or {})
+                  .get("secrets"), msg="default SA token ready")
+            self._create_pod_via_http(base)
+            pod = _wait(
+                lambda: (store.get("pods", "default/app") or {})
+                if ((store.get("pods", "default/app") or {})
+                    .get("status") or {}).get("phase") == "Running"
+                else None,
+                timeout=60, msg="pod Running on the hollow kubelet")
+            assert pod["spec"]["nodeName"] == "sn-0"
+            assert pod["spec"]["serviceAccountName"] == "default"
+            # Admission mounted the SA token into the container.
+            assert any("serviceaccount" in (m.get("mountPath") or "")
+                       for m in pod["spec"]["containers"][0]
+                       ["volumeMounts"])
+        finally:
+            factory.stop()
+            kubelet.stop()
+            sac.stop()
+            srv.shutdown()
+
+    @staticmethod
+    def _create_pod_via_http(base):
+        req = urllib.request.Request(
+            base + "/api/v1/pods",
+            data=json.dumps({
+                "metadata": {"name": "app"},
+                "spec": {"containers": [{
+                    "name": "c",
+                    "env": [{"name": "DB_PASSWORD",
+                             "valueFrom": {"secretKeyRef": {
+                                 "name": "db-creds",
+                                 "key": "password"}}}],
+                    "resources": {"requests": {"cpu": "100m"}}}]}
+            }).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 201
