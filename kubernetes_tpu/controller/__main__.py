@@ -23,6 +23,8 @@ import socket
 import sys
 import threading
 
+from kubernetes_tpu.client.http import (DEFAULT_BURST, DEFAULT_QPS,
+                                        APIClient, TLSConfig)
 from kubernetes_tpu.controller.daemonset import DaemonSetController
 from kubernetes_tpu.controller.deployment import DeploymentController
 from kubernetes_tpu.controller.disruption import DisruptionController
@@ -57,6 +59,7 @@ def main(argv=None) -> int:
                         "count (gc_controller.go)")
     p.add_argument("--kube-api-token", default="",
                    help="bearer token for an authenticated apiserver")
+    TLSConfig.add_flags(p)
     p.add_argument("--leader-elect", action="store_true",
                    help="gate the control loops behind a leader lease "
                         "(controllermanager.go:171-189)")
@@ -71,40 +74,38 @@ def main(argv=None) -> int:
     controllers: list = []
     stop = threading.Event()
 
+    tls = TLSConfig.from_opts(opts)
+
+    def client(qps: float = DEFAULT_QPS,
+               burst: int = DEFAULT_BURST) -> APIClient:
+        """One APIClient per controller (own rate bucket), all carrying
+        the daemon's credentials + TLS config — the restclient.Config
+        every loop copies in the reference controller-manager."""
+        return APIClient(opts.api_server, qps=qps, burst=burst,
+                         token=tok, tls=tls)
+
     def start_controllers() -> None:
-        controllers.append(
-            ReplicationManager(opts.api_server, token=tok).run())
-        controllers.append(
-            DeploymentController(opts.api_server, token=tok).run())
+        controllers.append(ReplicationManager(client()).run())
+        controllers.append(DeploymentController(client()).run())
         controllers.append(NodeLifecycleController(
-            opts.api_server,
+            client(),
             monitor_grace=opts.node_monitor_grace_period,
-            eviction_timeout=opts.pod_eviction_timeout, token=tok).run())
-        controllers.append(
-            EndpointsController(opts.api_server, token=tok).run())
-        controllers.append(
-            NamespaceController(opts.api_server, token=tok).run())
-        controllers.append(
-            DaemonSetController(opts.api_server, token=tok).run())
-        controllers.append(
-            JobController(opts.api_server, token=tok).run())
+            eviction_timeout=opts.pod_eviction_timeout).run())
+        controllers.append(EndpointsController(client()).run())
+        controllers.append(NamespaceController(client()).run())
+        controllers.append(DaemonSetController(client()).run())
+        controllers.append(JobController(client()).run())
         controllers.append(PodGCController(
-            opts.api_server, token=tok,
+            client(),
             threshold=opts.terminated_pod_gc_threshold).run())
+        controllers.append(HorizontalPodAutoscaler(client()).run())
+        controllers.append(DisruptionController(client()).run())
+        controllers.append(ScheduledJobController(client()).run())
+        controllers.append(PetSetController(client()).run())
+        controllers.append(ResourceQuotaController(client()).run())
         controllers.append(
-            HorizontalPodAutoscaler(opts.api_server, token=tok).run())
-        controllers.append(
-            DisruptionController(opts.api_server, token=tok).run())
-        controllers.append(
-            ScheduledJobController(opts.api_server, token=tok).run())
-        controllers.append(
-            PetSetController(opts.api_server, token=tok).run())
-        controllers.append(
-            ResourceQuotaController(opts.api_server, token=tok).run())
-        controllers.append(
-            GarbageCollector(opts.api_server, token=tok).run())
-        controllers.append(
-            ServiceAccountsController(opts.api_server, token=tok).run())
+            GarbageCollector(client(qps=200, burst=400)).run())
+        controllers.append(ServiceAccountsController(client()).run())
         log.info("controller-manager running (replication + deployment + "
                  "node lifecycle + endpoints + namespace + daemonset + "
                  "job + podgc + hpa + disruption + scheduledjob + "
@@ -112,12 +113,11 @@ def main(argv=None) -> int:
 
     elector = None
     if opts.leader_elect:
-        from kubernetes_tpu.client.http import APIClient
         from kubernetes_tpu.utils.leaderelection import (APIResourceLock,
                                                          LeaderElector)
         identity = f"{socket.gethostname()}-{os.getpid()}"
         lock = APIResourceLock(
-            APIClient(opts.api_server, token=tok),
+            client(),
             name="kube-controller-manager")
         elector = LeaderElector(
             lock=lock, identity=identity,

@@ -60,9 +60,10 @@ def resolve_min_available(min_available, expected: int) -> int:
 
 class DisruptionController:
     def __init__(self, source: Union[MemStore, APIClient, str],
-                 sync_period: float = SYNC_PERIOD, token: str = ""):
+                 sync_period: float = SYNC_PERIOD, token: str = "",
+                 tls=None):
         if isinstance(source, str):
-            source = APIClient(source, token=token)
+            source = APIClient(source, token=token, tls=tls)
         self.store = source
         self.sync_period = sync_period
         self._pdbs: dict[str, dict] = {}

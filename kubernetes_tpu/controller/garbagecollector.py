@@ -54,12 +54,14 @@ DEPENDENT_KINDS = tuple(sorted(NAMESPACED_KINDS))
 
 class GarbageCollector:
     def __init__(self, source: Union[MemStore, APIClient, str],
-                 sync_period: float = SYNC_PERIOD, token: str = ""):
+                 sync_period: float = SYNC_PERIOD, token: str = "",
+                 tls=None):
         if isinstance(source, str):
             # The sweep is LIST-heavy by design (the reference GC is a
             # graph resync too); the default 5-QPS client would make one
             # sweep outlast the sync period on its own rate limiter.
-            source = APIClient(source, qps=200, burst=400, token=token)
+            source = APIClient(source, qps=200, burst=400, token=token,
+                               tls=tls)
         self.store = source
         self.sync_period = sync_period
         self._stop = threading.Event()

@@ -44,9 +44,10 @@ class NamespaceController:
     """Watches namespaces; GCs the contents of deleted ones."""
 
     def __init__(self, source: Union[MemStore, APIClient, str],
-                 token: str = ""):
+                 token: str = "",
+                 tls=None):
         if isinstance(source, str):
-            source = APIClient(source, token=token)
+            source = APIClient(source, token=token, tls=tls)
         self.store = source
         self._work: "queue.Queue[str | None]" = queue.Queue()
         self._stop = threading.Event()

@@ -38,9 +38,10 @@ class NodeLifecycleController:
                  monitor_grace: float = MONITOR_GRACE,
                  eviction_timeout: float = EVICTION_TIMEOUT,
                  sync_period: float = SYNC_PERIOD,
-                 evictions_per_sync: int = 10, token: str = ""):
+                 evictions_per_sync: int = 10, token: str = "",
+                 tls=None):
         if isinstance(source, str):
-            source = APIClient(source, token=token)
+            source = APIClient(source, token=token, tls=tls)
         self.store = source
         self.monitor_grace = monitor_grace
         self.eviction_timeout = eviction_timeout

@@ -30,9 +30,10 @@ DEFAULT_THRESHOLD = 1000
 class PodGCController:
     def __init__(self, source: Union[MemStore, APIClient, str],
                  threshold: int = DEFAULT_THRESHOLD,
-                 sync_period: float = SYNC_PERIOD, token: str = ""):
+                 sync_period: float = SYNC_PERIOD, token: str = "",
+                 tls=None):
         if isinstance(source, str):
-            source = APIClient(source, token=token)
+            source = APIClient(source, token=token, tls=tls)
         self.store = source
         self.threshold = threshold
         self.sync_period = sync_period

@@ -58,9 +58,10 @@ def compute_usage(pods: list[dict]) -> dict:
 
 class ResourceQuotaController:
     def __init__(self, source: Union[MemStore, APIClient, str],
-                 sync_period: float = SYNC_PERIOD, token: str = ""):
+                 sync_period: float = SYNC_PERIOD, token: str = "",
+                 tls=None):
         if isinstance(source, str):
-            source = APIClient(source, token=token)
+            source = APIClient(source, token=token, tls=tls)
         self.store = source
         self.sync_period = sync_period
         self._quotas: dict[str, dict] = {}

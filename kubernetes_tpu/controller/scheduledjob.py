@@ -88,7 +88,7 @@ class ScheduledJobController:
                  sync_period: float = SYNC_PERIOD, token: str = "",
                  clock=None):
         if isinstance(source, str):
-            source = APIClient(source, token=token)
+            source = APIClient(source, token=token, tls=tls)
         self.store = source
         self.sync_period = sync_period
         # Injectable clock (the reference's syncOne takes ``now`` for

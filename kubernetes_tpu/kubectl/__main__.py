@@ -545,6 +545,8 @@ def main(argv=None, out=sys.stdout) -> int:
                    help="apiserver base URL")
     p.add_argument("--token", default="",
                    help="bearer token for an authenticated apiserver")
+    from kubernetes_tpu.client.http import TLSConfig
+    TLSConfig.add_flags(p)
     sub = p.add_subparsers(dest="cmd", required=True)
 
     g = sub.add_parser("get")
@@ -601,7 +603,8 @@ def main(argv=None, out=sys.stdout) -> int:
     ro.add_argument("--timeout", type=float, default=60.0)
 
     opts = p.parse_args(argv)
-    client = APIClient(opts.server, qps=0, token=opts.token)
+    client = APIClient(opts.server, qps=0, token=opts.token,
+                       tls=TLSConfig.from_opts(opts))
     if opts.cmd == "get":
         return cmd_get(client, opts, out)
     if opts.cmd == "describe":

@@ -31,6 +31,8 @@ def main(argv=None) -> int:
     p.add_argument("--heartbeat-period", type=float, default=10.0)
     p.add_argument("--kube-api-token", default="",
                    help="bearer token for an authenticated apiserver")
+    from kubernetes_tpu.client.http import APIClient, TLSConfig
+    TLSConfig.add_flags(p)
     p.add_argument("--v", type=int, default=None)
     opts = p.parse_args(argv)
     configure(v=opts.v)
@@ -45,9 +47,10 @@ def main(argv=None) -> int:
         allocatable_memory=opts.memory_gib * 1024 ** 3,
         allocatable_pods=opts.pods,
         conditions=[api.NodeCondition("Ready", "True")])
-    kubelet = HollowKubelet(opts.api_server, node,
-                            heartbeat_period=opts.heartbeat_period,
-                            token=opts.kube_api_token).run()
+    source = APIClient(opts.api_server, token=opts.kube_api_token,
+                       tls=TLSConfig.from_opts(opts))
+    kubelet = HollowKubelet(source, node,
+                            heartbeat_period=opts.heartbeat_period).run()
     log.info("hollow kubelet %s running", opts.node_name)
 
     stop = threading.Event()

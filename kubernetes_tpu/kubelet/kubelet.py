@@ -45,9 +45,10 @@ class HollowKubelet:
     def __init__(self, source: Union[MemStore, APIClient, str],
                  node: api.Node,
                  heartbeat_period: float = HEARTBEAT_PERIOD,
-                 token: str = ""):
+                 token: str = "",
+                 tls=None):
         if isinstance(source, str):
-            source = APIClient(source, token=token)
+            source = APIClient(source, token=token, tls=tls)
         self.store = source
         self.node = node
         self.heartbeat_period = heartbeat_period

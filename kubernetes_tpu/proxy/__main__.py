@@ -22,10 +22,14 @@ def main(argv=None) -> int:
     p.add_argument("--api-server", required=True)
     p.add_argument("--kube-api-token", default="",
                    help="bearer token for an authenticated apiserver")
+    from kubernetes_tpu.client.http import APIClient, TLSConfig
+    TLSConfig.add_flags(p)
     p.add_argument("--v", type=int, default=None)
     opts = p.parse_args(argv)
     configure(v=opts.v)
-    proxy = HollowProxy(opts.api_server, token=opts.kube_api_token).run()
+    proxy = HollowProxy(APIClient(
+        opts.api_server, token=opts.kube_api_token,
+        tls=TLSConfig.from_opts(opts))).run()
     log.info("hollow kube-proxy running")
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

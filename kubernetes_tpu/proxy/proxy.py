@@ -24,9 +24,10 @@ log = get_logger("kube-proxy")
 
 class HollowProxy:
     def __init__(self, source: Union[MemStore, APIClient, str],
-                 token: str = ""):
+                 token: str = "",
+                 tls=None):
         if isinstance(source, str):
-            source = APIClient(source, token=token)
+            source = APIClient(source, token=token, tls=tls)
         self.store = source
         self._backends: dict[str, list[str]] = {}  # "ns/svc" -> pod IPs
         self._rr: dict[str, int] = {}              # round-robin cursors

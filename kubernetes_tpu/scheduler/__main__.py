@@ -56,6 +56,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kube-api-burst", type=int, default=100)
     p.add_argument("--kube-api-token", default="",
                    help="bearer token for an authenticated apiserver")
+    from kubernetes_tpu.client.http import TLSConfig
+    TLSConfig.add_flags(p)
     p.add_argument("--hard-pod-affinity-symmetric-weight", type=int,
                    default=None)
     p.add_argument("--leader-elect", action="store_true", default=False)
@@ -211,7 +213,11 @@ def main(argv=None) -> int:
     }
 
     if opts.api_server:
-        source = opts.api_server
+        from kubernetes_tpu.client.http import APIClient, TLSConfig
+        source = APIClient(opts.api_server, qps=opts.kube_api_qps,
+                           burst=opts.kube_api_burst,
+                           token=opts.kube_api_token,
+                           tls=TLSConfig.from_opts(opts))
     else:
         from kubernetes_tpu.apiserver.memstore import MemStore
         source = MemStore()
@@ -220,11 +226,12 @@ def main(argv=None) -> int:
             serve(source, port=opts.serve_apiserver)
             log.info("in-process apiserver on :%d", opts.serve_apiserver)
 
+    # source is a ready APIClient (credentials + TLS) or a MemStore;
+    # qps/burst still feed the factory's event-sink rate bucket.
     factory = ConfigFactory(source, policy=policy,
                             scheduler_name=opts.scheduler_name,
                             qps=opts.kube_api_qps,
-                            burst=opts.kube_api_burst,
-                            token=opts.kube_api_token)
+                            burst=opts.kube_api_burst)
     mux = _status_mux(factory, configz, opts.port)
     log.info("status http on :%d (healthz, metrics, configz)",
              mux.server_address[1])

@@ -190,16 +190,17 @@ class ConfigFactory:
                  policy: Optional[Policy] = None,
                  scheduler_name: str = api.DEFAULT_SCHEDULER_NAME,
                  batched: bool = True,
-                 qps: float = 50.0, burst: int = 100, token: str = ""):
+                 qps: float = 50.0, burst: int = 100, token: str = "",
+                 tls=None):
         if isinstance(store, str):
-            store = APIClient(store, qps=qps, burst=burst, token=token)
+            store = APIClient(store, qps=qps, burst=burst, token=token,
+                              tls=tls)
         self.store = store
         self.listers = Listers()
         self.algorithm = GenericScheduler(policy=policy, listers=self.listers)
         if isinstance(store, APIClient):
             binder = APIClientBinder(store)
-            events_client = APIClient(store.base_url, qps=0,
-                                      token=store.token)
+            events_client = store.clone(qps=0)
             from kubernetes_tpu.utils.events import async_sink
             # The batch sink carries its own rate bucket (broadcaster-
             # style drop beyond qps/burst, then one batch POST per drain).
