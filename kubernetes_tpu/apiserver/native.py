@@ -21,8 +21,14 @@ _BINARY = os.path.join(_NATIVE_DIR, "kube-apiserver-native")
 
 def native_binary(build: bool = True) -> Optional[str]:
     src = os.path.join(_NATIVE_DIR, "apiserver.cpp")
+    # The kind table is generated from types.py (one manifest for both
+    # servers), so a types.py edit must also trigger a rebuild.
+    types_py = os.path.join(_NATIVE_DIR, "..", "kubernetes_tpu", "api",
+                            "types.py")
     if os.path.exists(_BINARY) and os.path.exists(src) and \
-            os.path.getmtime(_BINARY) >= os.path.getmtime(src):
+            os.path.getmtime(_BINARY) >= os.path.getmtime(src) and \
+            (not os.path.exists(types_py) or
+             os.path.getmtime(_BINARY) >= os.path.getmtime(types_py)):
         return _BINARY
     if not build or not os.path.exists(src):
         return None

@@ -44,13 +44,8 @@ SYNC_PERIOD = 1.0   # the reference polls every 10 s (controller.go:103);
 SJ_LABEL = "scheduled-job-name"
 
 
-def _parse_time(text: str) -> datetime:
-    return datetime.strptime(text, "%Y-%m-%dT%H:%M:%SZ") \
-        .replace(tzinfo=timezone.utc)
-
-
-def _fmt_time(t: datetime) -> str:
-    return t.strftime("%Y-%m-%dT%H:%M:%SZ")
+from kubernetes_tpu.utils.timeutil import (format_rfc3339 as _fmt_time,
+                                           parse_rfc3339 as _parse_time)
 
 
 def _job_finished(job: dict) -> bool:

@@ -254,11 +254,66 @@ def cmd_create(client: APIClient, opts, out) -> int:
     return rc
 
 
+LAST_APPLIED_ANNOTATION = "kubectl.kubernetes.io/last-applied-configuration"
+
+
+def three_way_merge(last: dict, new: dict, live: dict) -> dict:
+    """apply.go's three-way patch (pkg/kubectl/cmd/apply.go:139-209 via
+    strategicpatch.CreateThreeWayMergePatch), dict-shaped:
+
+    * a field in the NEW manifest wins;
+    * a field the previous manifest set but the new one dropped is
+      DELETED from live (the user removed it declaratively);
+    * everything else keeps its LIVE value — a controller- or
+      scale-written field (e.g. an HPA's replicas) survives an apply
+      whose manifest never mentions it.
+
+    Lists replace wholesale (the reference's strategic merge keys some
+    lists by name; containers-by-name merging is out of scope here and
+    documented as such)."""
+    merged = dict(live)
+    for k, nv in new.items():
+        lv = live.get(k)
+        if isinstance(nv, dict) and isinstance(lv, dict):
+            lastv = last.get(k)
+            merged[k] = _three_way_inner(
+                lastv if isinstance(lastv, dict) else {}, nv, lv)
+        else:
+            merged[k] = nv
+    for k in last:
+        # Top-level metadata is never declaratively deleted (the live
+        # object's identity + server-managed fields live there); NESTED
+        # keys named metadata (e.g. spec.template.metadata) delete like
+        # any other field — _three_way_inner has no such guard.
+        if k not in new and k in merged and k != "metadata":
+            del merged[k]
+    return merged
+
+
+def _three_way_inner(last: dict, new: dict, live: dict) -> dict:
+    merged = dict(live)
+    for k, nv in new.items():
+        lv = live.get(k)
+        if isinstance(nv, dict) and isinstance(lv, dict):
+            lastv = last.get(k)
+            merged[k] = _three_way_inner(
+                lastv if isinstance(lastv, dict) else {}, nv, lv)
+        else:
+            merged[k] = nv
+    for k in last:
+        if k not in new and k in merged:
+            del merged[k]
+    return merged
+
+
 def cmd_apply(client: APIClient, opts, out) -> int:
     """kubectl apply (pkg/kubectl/cmd/apply.go, the declarative verb):
-    create the object if absent, else replace it — the submitted spec is
-    the desired state.  The replace carries the live resourceVersion so a
-    concurrent writer wins the CAS and apply reports the conflict."""
+    create the object if absent, else THREE-WAY merge — previous applied
+    config (the last-applied annotation) vs this manifest vs live state
+    — so fields other actors own (an HPA's replica count, controller
+    status) survive an apply that doesn't mention them.  The update
+    carries the live resourceVersion so a concurrent writer wins the CAS
+    and apply reports the conflict."""
     rc = 0
     for doc in _load_documents(opts.filename):
         kind_field = doc.get("kind", "Pod").lower()
@@ -275,18 +330,35 @@ def cmd_apply(client: APIClient, opts, out) -> int:
             key = f"{meta['namespace']}/{name}"
         else:
             key = name
+        # The annotation records THIS manifest (without itself) for the
+        # next apply's base (apply.go GetOriginalConfiguration).
+        applied_json = json.dumps(doc, sort_keys=True,
+                                  separators=(",", ":"))
         try:
             current = client.get(resource, key)
         except APIError:
             current = None
         try:
             if current is None:
+                meta.setdefault("annotations", {})[
+                    LAST_APPLIED_ANNOTATION] = applied_json
                 client.create(resource, doc)
                 print(f"{resource[:-1]}/{name} created", file=out)
             else:
-                meta["resourceVersion"] = \
+                last_raw = ((current.get("metadata") or {})
+                            .get("annotations") or {}) \
+                    .get(LAST_APPLIED_ANNOTATION, "")
+                try:
+                    last = json.loads(last_raw) if last_raw else {}
+                except ValueError:
+                    last = {}
+                merged = three_way_merge(last, doc, current)
+                mmeta = merged.setdefault("metadata", {})
+                mmeta.setdefault("annotations", {})[
+                    LAST_APPLIED_ANNOTATION] = applied_json
+                mmeta["resourceVersion"] = \
                     (current.get("metadata") or {}).get("resourceVersion")
-                client.update(resource, doc)
+                client.update(resource, merged)
                 print(f"{resource[:-1]}/{name} configured", file=out)
         except APIError as err:
             print(f"error applying {resource}/{name}: {err}",

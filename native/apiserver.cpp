@@ -429,12 +429,10 @@ static std::vector<std::string> validate(const std::string& kind,
 }
 
 // --------------------------------------------------------------- store --
-static const std::set<std::string> kNamespaced = {
-    "pods", "services", "persistentvolumeclaims", "replicationcontrollers",
-    "replicasets", "endpoints", "events", "deployments", "limitranges",
-    "resourcequotas", "daemonsets", "jobs", "roles", "rolebindings",
-    "horizontalpodautoscalers", "poddisruptionbudgets", "scheduledjobs",
-    "petsets", "secrets", "configmaps", "serviceaccounts"};
+// kNamespaced is GENERATED from kubernetes_tpu/api/types.py
+// NAMESPACED_KINDS (make's gen_kinds.py step): one manifest feeds both
+// servers, so a kind added in Python exists here without a second edit.
+#include "kinds.inc"
 
 // ------------------------------------------------------ field selectors --
 // pkg/fields ParseSelector subset: comma-separated `path=value`,

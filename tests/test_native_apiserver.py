@@ -68,6 +68,24 @@ def _pod(name):
             "spec": {"containers": [{"name": "c"}]}}
 
 
+def test_kind_table_matches_python_manifest(rig):
+    """Drift guard (VERDICT r4 weak #3): the native server's namespaced
+    kind table is GENERATED from api/types.py NAMESPACED_KINDS; every
+    kind the Python server namespaces must namespace-default here too.
+    A kind added in Python without rebuilding fails this test."""
+    from kubernetes_tpu.api.types import NAMESPACED_KINDS
+    for kind in sorted(NAMESPACED_KINDS):
+        code, created = _req(rig, "POST", f"/api/v1/{kind}",
+                             {"metadata": {"name": f"drift-{kind}"},
+                              "spec": {"containers": [{"name": "c"}]}})
+        assert code == 201, (kind, created)
+        assert created["metadata"].get("namespace") == "default", \
+            f"{kind} not namespaced on the native server"
+        code, _ = _req(rig, "GET",
+                       f"/api/v1/namespaces/default/{kind}/drift-{kind}")
+        assert code == 200, kind
+
+
 def test_crud_roundtrip(rig):
     code, created = _req(rig, "POST", "/api/v1/nodes",
                          {"metadata": {"name": "n0"},

@@ -479,6 +479,109 @@ class TestDisruption:
             srv.shutdown()
 
 
+# --------------------------------------------- hpa stabilization windows --
+
+class TestHPAStabilization:
+    """horizontal.go:67-68,357-376: after a rescale, scale-ups are
+    forbidden for 3 m and scale-downs for 5 m (keyed on
+    status.lastScaleTime) — a flapping metric produces exactly one scale
+    event per window, not one per 2 s sync."""
+
+    def _rig(self, now_box):
+        from kubernetes_tpu.controller.podautoscaler import (
+            HorizontalPodAutoscaler)
+        store = MemStore()
+        c = HorizontalPodAutoscaler(store, clock=lambda: now_box[0],
+                                    upscale_window=180.0,
+                                    downscale_window=300.0)
+        store.create("replicationcontrollers", {
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"replicas": 2, "selector": {"app": "web"}}})
+        store.create("horizontalpodautoscalers", {
+            "metadata": {"name": "web-hpa", "namespace": "default"},
+            "spec": {"scaleTargetRef": {"kind": "ReplicationController",
+                                        "name": "web"},
+                     "minReplicas": 1, "maxReplicas": 10,
+                     "targetCPUUtilizationPercentage": 50}})
+        return store, c
+
+    def _pods(self, store, n, cpu_each):
+        for i in range(n):
+            name = f"w{i}"
+            if store.get("pods", f"default/{name}") is None:
+                store.create("pods", {
+                    "metadata": {"name": name, "namespace": "default",
+                                 "labels": {"app": "web"}},
+                    "spec": {"containers": [{
+                        "name": "c", "resources": {
+                            "requests": {"cpu": "100m"}}}]},
+                    "status": {"phase": "Running",
+                               "cpuUsage": cpu_each}})
+            else:
+                pod = store.get("pods", f"default/{name}")
+                pod["status"]["cpuUsage"] = cpu_each
+                store.update("pods", pod)
+
+    def _feed_and_sync(self, c, store):
+        for kind, handler in (("horizontalpodautoscalers", c._on_hpa),
+                              ("pods", c._on_pod)):
+            for obj in store.list(kind)[0]:
+                handler("ADDED", obj)
+        c.sync_all()
+
+    def test_one_scale_event_per_window(self):
+        from datetime import datetime, timedelta, timezone
+        now_box = [datetime(2016, 9, 1, 12, 0, tzinfo=timezone.utc)]
+        store, c = self._rig(now_box)
+        self._pods(store, 2, "100m")  # 200% of request: scale up
+        self._feed_and_sync(c, store)
+        rc = store.get("replicationcontrollers", "default/web")
+        assert rc["spec"]["replicas"] == 4  # ceil(2 * 100/50)
+        hpa = store.get("horizontalpodautoscalers", "default/web-hpa")
+        first_stamp = hpa["status"]["lastScaleTime"]
+        assert first_stamp == "2016-09-01T12:00:00Z"
+
+        # Metric still hot 2 s later (the flap): NO second scale within
+        # the 3 m upscale window, however many syncs run.
+        for dt in (2, 30, 120, 179):
+            now_box[0] = datetime(2016, 9, 1, 12, 0,
+                                  tzinfo=timezone.utc) + \
+                timedelta(seconds=dt)
+            self._feed_and_sync(c, store)
+            assert store.get("replicationcontrollers",
+                             "default/web")["spec"]["replicas"] == 4
+            st = store.get("horizontalpodautoscalers",
+                           "default/web-hpa")["status"]
+            assert st["lastScaleTime"] == first_stamp
+            assert st["desiredReplicas"] == 4  # pinned while forbidden
+
+        # Past the window the still-hot metric scales again.
+        now_box[0] = datetime(2016, 9, 1, 12, 3, 1, tzinfo=timezone.utc)
+        self._feed_and_sync(c, store)
+        rc = store.get("replicationcontrollers", "default/web")
+        assert rc["spec"]["replicas"] == 8
+        assert store.get("horizontalpodautoscalers", "default/web-hpa")[
+            "status"]["lastScaleTime"] == "2016-09-01T12:03:01Z"
+
+    def test_downscale_window_is_longer(self):
+        from datetime import datetime, timedelta, timezone
+        now_box = [datetime(2016, 9, 1, 12, 0, tzinfo=timezone.utc)]
+        store, c = self._rig(now_box)
+        self._pods(store, 2, "100m")
+        self._feed_and_sync(c, store)  # up to 4, stamps lastScaleTime
+        self._pods(store, 2, "5m")     # load collapses: wants DOWN
+        # 4 minutes later: inside the 5 m downscale window -> no change.
+        now_box[0] += timedelta(minutes=4)
+        self._feed_and_sync(c, store)
+        assert store.get("replicationcontrollers",
+                         "default/web")["spec"]["replicas"] == 4
+        # 5+ minutes: the scale-down lands.
+        now_box[0] += timedelta(minutes=1, seconds=5)
+        self._feed_and_sync(c, store)
+        assert store.get("replicationcontrollers",
+                         "default/web")["spec"]["replicas"] < 4
+
+
 # ------------------------------------------- quota resync + garbage GC --
 
 class TestResourceQuotaController:
