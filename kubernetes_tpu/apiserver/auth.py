@@ -156,6 +156,173 @@ class ServiceAccountAuthenticator:
                     f"system:serviceaccounts:{ns}"))
 
 
+class BasicAuthenticator:
+    """HTTP basic auth from a password file (plugin/pkg/auth/
+    authenticator/password/passwordfile): CSV lines of
+    ``password,user,uid[,group1|group2]``; requests carry
+    ``Authorization: Basic base64(user:password)``."""
+
+    def __init__(self, entries: dict[str, tuple[str, UserInfo]]):
+        # user -> (password, UserInfo)
+        self._entries = dict(entries)
+
+    @classmethod
+    def from_file(cls, path: str) -> "BasicAuthenticator":
+        entries: dict[str, tuple[str, UserInfo]] = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = [p.strip() for p in line.split(",")]
+                if len(parts) < 3:
+                    raise ValueError(
+                        f"basic-auth line needs password,user,uid: "
+                        f"{line!r}")
+                groups = tuple(parts[3].split("|")) \
+                    if len(parts) > 3 and parts[3] else ()
+                entries[parts[1]] = (parts[0], UserInfo(
+                    name=parts[1], uid=parts[2], groups=groups))
+        return cls(entries)
+
+    def authenticate(self, authorization: str) -> UserInfo:
+        import base64
+        import hmac
+        scheme, _, blob = authorization.partition(" ")
+        if scheme.lower() != "basic" or not blob.strip():
+            raise AuthenticationError("expected basic credentials")
+        try:
+            user, _, password = base64.b64decode(
+                blob.strip()).decode().partition(":")
+        except Exception as err:  # noqa: BLE001 — garbage b64
+            raise AuthenticationError("malformed basic credentials") \
+                from err
+        entry = self._entries.get(user)
+        # Constant-time compare on BYTES (str compare_digest rejects
+        # non-ASCII with a TypeError — a remotely triggerable crash);
+        # an unknown user burns the same compare so the 401 timing
+        # doesn't enumerate accounts.
+        expected = entry[0] if entry else ""
+        if not hmac.compare_digest(password.encode(),
+                                   expected.encode()) or entry is None:
+            raise AuthenticationError("invalid user/password")
+        return entry[1]
+
+
+class WebhookTokenAuthenticator:
+    """Token-review webhook (plugin/pkg/auth/authenticator/token/
+    webhook): POST a TokenReview to the configured URL; the remote
+    answers ``status.authenticated`` + ``status.user``.  Positive AND
+    negative verdicts are cached with a TTL (the reference's
+    cached_token_authenticator) so a chatty client doesn't hammer the
+    webhook."""
+
+    def __init__(self, url: str, cache_ttl: float = 120.0,
+                 timeout: float = 5.0):
+        import threading
+        self.url = url
+        self.cache_ttl = cache_ttl
+        self.timeout = timeout
+        self._cache: dict[str, tuple[float, Optional[UserInfo]]] = {}
+        self._lock = threading.Lock()
+
+    def authenticate(self, authorization: str) -> UserInfo:
+        import time
+        scheme, _, token = authorization.partition(" ")
+        token = token.strip()
+        if scheme.lower() != "bearer" or not token:
+            raise AuthenticationError("expected a bearer token")
+        now = time.monotonic()
+        with self._lock:
+            hit = self._cache.get(token)
+            if hit is not None and now - hit[0] < self.cache_ttl:
+                if hit[1] is None:
+                    raise AuthenticationError("token rejected (cached)")
+                return hit[1]
+        user = self._review(token)
+        with self._lock:
+            self._cache[token] = (now, user)
+            if len(self._cache) > 4096:  # bound the negative cache
+                self._cache.pop(next(iter(self._cache)))
+        if user is None:
+            raise AuthenticationError("token rejected by webhook")
+        return user
+
+    def _review(self, token: str) -> Optional[UserInfo]:
+        import urllib.request
+        body = json.dumps({
+            "apiVersion": "authentication.k8s.io/v1beta1",
+            "kind": "TokenReview",
+            "spec": {"token": token}}).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                answer = json.loads(resp.read() or b"{}")
+        except Exception as err:  # noqa: BLE001 — webhook down: 401
+            raise AuthenticationError(
+                f"token webhook unavailable: {err}") from err
+        status = answer.get("status") or {}
+        if not status.get("authenticated"):
+            return None
+        u = status.get("user") or {}
+        return UserInfo(name=u.get("username", "") or "system:anonymous",
+                        uid=str(u.get("uid", "")),
+                        groups=tuple(u.get("groups") or ()))
+
+
+class WebhookAuthorizer:
+    """SubjectAccessReview webhook (plugin/pkg/auth/authorizer/webhook):
+    POST the request's attributes; the remote answers
+    ``status.allowed``.  Verdicts cached with a TTL (the reference's
+    authorized/unauthorized TTL pair)."""
+
+    def __init__(self, url: str, cache_ttl: float = 60.0,
+                 timeout: float = 5.0):
+        import threading
+        self.url = url
+        self.cache_ttl = cache_ttl
+        self.timeout = timeout
+        self._cache: dict[tuple, tuple[float, bool]] = {}
+        self._lock = threading.Lock()
+
+    def authorize(self, user: UserInfo, verb: str, resource: str,
+                  namespace: str = "") -> bool:
+        import time
+        import urllib.request
+        rbac_verb = _METHOD_VERBS.get(verb, verb.lower())
+        key = (user.name, user.groups, rbac_verb, resource, namespace)
+        now = time.monotonic()
+        with self._lock:
+            hit = self._cache.get(key)
+            if hit is not None and now - hit[0] < self.cache_ttl:
+                return hit[1]
+        body = json.dumps({
+            "apiVersion": "authorization.k8s.io/v1beta1",
+            "kind": "SubjectAccessReview",
+            "spec": {"user": user.name, "groups": list(user.groups),
+                     "resourceAttributes": {
+                         "verb": rbac_verb, "resource": resource,
+                         "namespace": namespace}}}).encode()
+        req = urllib.request.Request(
+            self.url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                answer = json.loads(resp.read() or b"{}")
+            allowed = bool((answer.get("status") or {}).get("allowed"))
+        except Exception:  # noqa: BLE001 — webhook down: deny
+            return False
+        with self._lock:
+            self._cache[key] = (now, allowed)
+            if len(self._cache) > 4096:
+                self._cache.pop(next(iter(self._cache)))
+        return allowed
+
+
 class UnionAuthenticator:
     """union.AuthenticatorRequest: first authenticator to accept wins;
     401 only when every one refuses."""
@@ -351,7 +518,8 @@ class AuthConfig:
         if self.authorizer is not None:
             if user is None:
                 user = UserInfo(name="system:anonymous")
-            if isinstance(self.authorizer, RBACAuthorizer):
+            if isinstance(self.authorizer,
+                          (RBACAuthorizer, WebhookAuthorizer)):
                 allowed = self.authorizer.authorize(user, verb, resource,
                                                     namespace)
             else:

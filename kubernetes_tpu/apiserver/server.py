@@ -63,14 +63,15 @@ _STATUS_LINES = {
 }
 
 
-def make_handler(store: MemStore, auth=None):
-    # Store-aware admission chain (anti-affinity veto -> LimitRanger
-    # defaulting -> ResourceQuota), built once per server.  Pod
-    # admit+create pairs serialize under one gate: ResourceQuota is
+def make_handler(store: MemStore, auth=None, admission_control=None):
+    # Store-aware admission chain (--admission-control order; default:
+    # NamespaceLifecycle -> ServiceAccount -> anti-affinity veto ->
+    # LimitRanger defaulting -> ResourceQuota), built once per server.
+    # Pod admit+create pairs serialize under one gate: ResourceQuota is
     # check-then-act against the stored pod list, and two concurrent
     # creates racing the same quota headroom must not both pass before
     # either lands (the reference serializes via CAS on quota status).
-    admission_chain = store_admission(store)
+    admission_chain = store_admission(store, admission_control)
     pod_write_gate = threading.Lock()
 
     class Handler(socketserver.StreamRequestHandler):
@@ -592,7 +593,7 @@ class _Server(socketserver.ThreadingTCPServer):
 def serve(store: MemStore, port: int = 0,
           host: str = "127.0.0.1", auth=None,
           tls_cert: str = "", tls_key: str = "",
-          client_ca: str = "") -> _Server:
+          client_ca: str = "", admission_control=None) -> _Server:
     """``auth``: an apiserver.auth.AuthConfig; None = the reference's
     insecure port (no authn/z).
 
@@ -602,7 +603,8 @@ def serve(store: MemStore, port: int = 0,
     name, O -> groups — the x509 request authenticator,
     plugin/pkg/auth/authenticator/request/x509), taking precedence over
     bearer tokens."""
-    server = _Server((host, port), make_handler(store, auth))
+    server = _Server((host, port),
+                     make_handler(store, auth, admission_control))
     if tls_cert:
         import ssl
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)

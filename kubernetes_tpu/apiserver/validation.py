@@ -560,6 +560,53 @@ class ResourceQuota:
                 "unset_cpu": unset_cpu, "unset_memory": unset_mem}
 
 
+class AlwaysPullImages:
+    """plugin/pkg/admission/alwayspullimages: force every container's
+    imagePullPolicy to Always — in a multitenant cluster a cached image
+    must not let one tenant run another's private bytes without
+    registry-side credential checks."""
+
+    name = "AlwaysPullImages"
+
+    def admit(self, kind: str, obj: dict, op: str = "create") -> None:
+        if kind != "pods":
+            return
+        for c in _pod_containers(obj):
+            c["imagePullPolicy"] = "Always"
+
+
+class SecurityContextDeny:
+    """plugin/pkg/admission/securitycontext/scdeny: reject pods that set
+    the privilege-adjacent SecurityContext fields (SELinuxOptions,
+    RunAsUser, SupplementalGroups, FSGroup) at the pod OR container
+    level — the cluster posture where user-controlled UID/SELinux
+    assignment is forbidden."""
+
+    name = "SecurityContextDeny"
+
+    _POD_FIELDS = ("seLinuxOptions", "runAsUser", "supplementalGroups",
+                   "fsGroup")
+    _CONTAINER_FIELDS = ("seLinuxOptions", "runAsUser")
+
+    def admit(self, kind: str, obj: dict, op: str = "create") -> None:
+        if kind != "pods":
+            return
+        spec = obj.get("spec") or {}
+        sc = spec.get("securityContext") or {}
+        for f in self._POD_FIELDS:
+            if sc.get(f) is not None:
+                raise AdmissionError(
+                    f"{self.name}: pod.spec.securityContext.{f} "
+                    f"is forbidden")
+        for c in _pod_containers(obj):
+            csc = c.get("securityContext") or {}
+            for f in self._CONTAINER_FIELDS:
+                if csc.get(f) is not None:
+                    raise AdmissionError(
+                        f"{self.name}: securityContext.{f} is forbidden "
+                        f"for container {c.get('name', '')}")
+
+
 SA_MOUNT_PATH = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
@@ -664,14 +711,56 @@ class NamespaceLifecycle:
 DEFAULT_ADMISSION = (LimitPodHardAntiAffinityTopology(),)
 
 
-def store_admission(store) -> tuple:
-    """The server's default chain, in the reference's plugin order:
-    namespace lifecycle first, ServiceAccount defaulting/mounting, the
-    anti-affinity veto, LimitRanger defaulting, then ResourceQuota
-    against the post-default requests."""
-    return (NamespaceLifecycle(store), ServiceAccount(store),
-            LimitPodHardAntiAffinityTopology(),
-            LimitRanger(store), ResourceQuota(store))
+# --admission-control registry (pkg/admission RegisterPlugin): name ->
+# factory(store).  AlwaysDeny/AlwaysAdmit are the reference's testing
+# plugins; the perf master runs AlwaysAdmit (master_utils.go:220).
+ADMISSION_PLUGINS = {
+    "NamespaceLifecycle": NamespaceLifecycle,
+    "ServiceAccount": ServiceAccount,
+    "LimitPodHardAntiAffinityTopology":
+        lambda store: LimitPodHardAntiAffinityTopology(),
+    "LimitRanger": LimitRanger,
+    "ResourceQuota": ResourceQuota,
+    "AlwaysPullImages": lambda store: AlwaysPullImages(),
+    "SecurityContextDeny": lambda store: SecurityContextDeny(),
+    "AlwaysAdmit": lambda store: None,
+    "AlwaysDeny": lambda store: _AlwaysDeny(),
+}
+
+# The default chain, in the reference's plugin order: namespace
+# lifecycle first, ServiceAccount defaulting/mounting, the
+# anti-affinity veto, LimitRanger defaulting, then ResourceQuota
+# against the post-default requests.
+DEFAULT_ADMISSION_CONTROL = (
+    "NamespaceLifecycle", "ServiceAccount",
+    "LimitPodHardAntiAffinityTopology", "LimitRanger", "ResourceQuota")
+
+
+class _AlwaysDeny:
+    name = "AlwaysDeny"
+
+    def admit(self, kind: str, obj: dict, op: str = "create") -> None:
+        raise AdmissionError("AlwaysDeny: admission is disabled")
+
+
+def store_admission(store, names=None) -> tuple:
+    """Build the admission chain in the order ``names`` lists the
+    plugins (the reference applies --admission-control in flag order);
+    None = the default chain.  Unknown names raise — a typo'd plugin
+    silently skipped would be a silently-open cluster."""
+    if names is None:
+        names = DEFAULT_ADMISSION_CONTROL
+    chain = []
+    for name in names:
+        name = name.strip()
+        if not name:
+            continue
+        if name not in ADMISSION_PLUGINS:
+            raise ValueError(f"unknown admission plugin {name!r}")
+        plugin = ADMISSION_PLUGINS[name](store)
+        if plugin is not None:  # AlwaysAdmit contributes nothing
+            chain.append(plugin)
+    return tuple(chain)
 
 
 def admit_and_validate(kind: str, obj: dict,

@@ -411,6 +411,113 @@ def cmd_scale(client: APIClient, opts, out) -> int:
     return 1
 
 
+def _cas_meta_edit(client: APIClient, kind: str, key: str, field: str,
+                   pairs: list[str], overwrite: bool, out,
+                   display: str) -> int:
+    """Shared label/annotate machinery (pkg/kubectl/cmd/label.go,
+    annotate.go): k=v sets, k- removes, CAS retry on conflict, and
+    no-overwrite protection unless --overwrite."""
+    from kubernetes_tpu.apiserver.memstore import ConflictError
+    for _ in range(5):
+        obj = client.get(kind, key)
+        if obj is None:
+            print(f'Error: {kind} "{key}" not found', file=sys.stderr)
+            return 1
+        bucket = obj.setdefault("metadata", {}).setdefault(field, {})
+        for pair in pairs:
+            if pair.endswith("-") and "=" not in pair:
+                bucket.pop(pair[:-1], None)
+                continue
+            k, sep, v = pair.partition("=")
+            if not sep:
+                print(f"error: {display} must be KEY=VALUE or KEY-: "
+                      f"{pair!r}", file=sys.stderr)
+                return 1
+            # validateNoOverwrites (label.go:116-124): ANY existing key
+            # errors without --overwrite, same value or not.
+            if not overwrite and k in bucket:
+                print(f"error: '{k}' already has a value "
+                      f"({bucket[k]}), and --overwrite is false",
+                      file=sys.stderr)
+                return 1
+            bucket[k] = v
+        try:
+            client.update(kind, obj)
+            name = (obj.get("metadata") or {}).get("name", "")
+            verbed = "labeled" if display == "label" else display + "d"
+            print(f"{kind[:-1]}/{name} {verbed}", file=out)
+            return 0
+        except ConflictError:
+            continue
+        except APIError as err:
+            print(f"error: {err}", file=sys.stderr)
+            return 1
+    print(f"error: too many conflicts while {display}-updating",
+          file=sys.stderr)
+    return 1
+
+
+def cmd_label(client: APIClient, opts, out) -> int:
+    """kubectl label (pkg/kubectl/cmd/label.go)."""
+    kind = _kind(opts.resource)
+    key = f"{opts.namespace}/{opts.name}" \
+        if kind in NAMESPACED_KINDS else opts.name
+    return _cas_meta_edit(client, kind, key, "labels", opts.pairs,
+                          opts.overwrite, out, "label")
+
+
+def cmd_annotate(client: APIClient, opts, out) -> int:
+    """kubectl annotate (pkg/kubectl/cmd/annotate.go)."""
+    kind = _kind(opts.resource)
+    key = f"{opts.namespace}/{opts.name}" \
+        if kind in NAMESPACED_KINDS else opts.name
+    return _cas_meta_edit(client, kind, key, "annotations", opts.pairs,
+                          opts.overwrite, out, "annotate")
+
+
+def cmd_expose(client: APIClient, opts, out) -> int:
+    """kubectl expose (pkg/kubectl/cmd/expose.go): generate a Service
+    selecting the workload's pods.  The selector comes from the
+    target's own selector (RC map selector / RS+Deployment
+    matchLabels)."""
+    kind = _kind(opts.resource)
+    if kind not in ("replicationcontrollers", "replicasets",
+                    "deployments"):
+        print(f'error: cannot expose "{kind}"', file=sys.stderr)
+        return 1
+    key = f"{opts.namespace}/{opts.name}"
+    obj = client.get(kind, key)
+    if obj is None:
+        print(f'Error: {kind} "{opts.name}" not found', file=sys.stderr)
+        return 1
+    sel = (obj.get("spec") or {}).get("selector") or {}
+    if "matchLabels" in sel or "matchExpressions" in sel:
+        if sel.get("matchExpressions"):
+            print("error: expose cannot express matchExpressions as a "
+                  "service selector (the reference has the same limit)",
+                  file=sys.stderr)
+            return 1
+        sel = sel.get("matchLabels") or {}
+    if not sel:
+        print(f"error: {kind}/{opts.name} has no selector to expose",
+              file=sys.stderr)
+        return 1
+    svc_name = opts.service_name or opts.name
+    svc = {"metadata": {"name": svc_name,
+                        "namespace": opts.namespace},
+           "spec": {"selector": dict(sel),
+                    "ports": [{"port": opts.port,
+                               "targetPort": opts.target_port
+                               or opts.port}]}}
+    try:
+        client.create("services", svc)
+    except APIError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    print(f"service/{svc_name} exposed", file=out)
+    return 0
+
+
 def cmd_rollout(client: APIClient, opts, out) -> int:
     """kubectl rollout status|history|undo (pkg/kubectl/rollout/)."""
     from kubernetes_tpu.controller.deployment import REVISION_ANN
@@ -666,6 +773,25 @@ def main(argv=None, out=sys.stdout) -> int:
     sc.add_argument("--replicas", type=int, required=True)
     sc.add_argument("-n", "--namespace", default="default")
 
+    for verb in ("label", "annotate"):
+        lb = sub.add_parser(verb)
+        lb.add_argument("resource")
+        lb.add_argument("name")
+        lb.add_argument("pairs", nargs="+",
+                        metavar="KEY=VAL|KEY-",
+                        help="set KEY=VAL, remove with KEY-")
+        lb.add_argument("--overwrite", action="store_true")
+        lb.add_argument("-n", "--namespace", default="default")
+
+    ex = sub.add_parser("expose")
+    ex.add_argument("resource")
+    ex.add_argument("name")
+    ex.add_argument("--port", type=int, required=True)
+    ex.add_argument("--target-port", type=int, default=0)
+    ex.add_argument("--service-name", default="",
+                    help="service name (defaults to the workload's)")
+    ex.add_argument("-n", "--namespace", default="default")
+
     ro = sub.add_parser("rollout")
     ro.add_argument("action", choices=["status", "history", "undo"])
     ro.add_argument("resource")
@@ -695,6 +821,12 @@ def main(argv=None, out=sys.stdout) -> int:
         return cmd_drain(client, opts, out)
     if opts.cmd == "scale":
         return cmd_scale(client, opts, out)
+    if opts.cmd == "label":
+        return cmd_label(client, opts, out)
+    if opts.cmd == "annotate":
+        return cmd_annotate(client, opts, out)
+    if opts.cmd == "expose":
+        return cmd_expose(client, opts, out)
     if opts.cmd == "rollout":
         return cmd_rollout(client, opts, out)
     return 2

@@ -290,6 +290,57 @@ class TestApply:
         assert store.get("pods", "team-z/p") is not None
 
 
+class TestLabelAnnotateExpose:
+    def test_label_set_overwrite_remove(self, rig):
+        store, base = rig
+        store.create("pods", _pod("p1"))
+        rc, out = run(base, "label", "po", "p1", "tier=web")
+        assert rc == 0 and "labeled" in out
+        assert store.get("pods", "default/p1")["metadata"]["labels"] \
+            == {"tier": "web"}
+        # No silent overwrite without --overwrite (label.go).
+        rc, _ = run(base, "label", "po", "p1", "tier=db")
+        assert rc == 1
+        rc, _ = run(base, "label", "po", "p1", "tier=db", "--overwrite")
+        assert rc == 0
+        assert store.get("pods", "default/p1")["metadata"]["labels"][
+            "tier"] == "db"
+        rc, _ = run(base, "label", "po", "p1", "tier-")
+        assert rc == 0
+        assert store.get("pods", "default/p1")["metadata"]["labels"] \
+            == {}
+
+    def test_annotate(self, rig):
+        store, base = rig
+        store.create("nodes", _node("n1"))
+        rc, out = run(base, "annotate", "no", "n1", "team=infra")
+        assert rc == 0 and "annotated" in out
+        assert store.get("nodes", "n1")["metadata"]["annotations"][
+            "team"] == "infra"
+
+    def test_expose_rc_creates_service(self, rig):
+        store, base = rig
+        store.create("replicationcontrollers", {
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"replicas": 2, "selector": {"run": "web"}}})
+        rc, out = run(base, "expose", "rc", "web", "--port", "80",
+                      "--target-port", "8080")
+        assert rc == 0 and "service/web exposed" in out
+        svc = store.get("services", "default/web")
+        assert svc["spec"]["selector"] == {"run": "web"}
+        assert svc["spec"]["ports"] == [{"port": 80, "targetPort": 8080}]
+        # A Deployment's matchLabels selector exposes too.
+        store.create("deployments", {
+            "metadata": {"name": "api", "namespace": "default"},
+            "spec": {"replicas": 1,
+                     "selector": {"matchLabels": {"run": "api"}}}})
+        rc, out = run(base, "expose", "deploy", "api", "--port", "443",
+                      "--service-name", "api-svc")
+        assert rc == 0
+        assert store.get("services", "default/api-svc")["spec"][
+            "selector"] == {"run": "api"}
+
+
 class TestDrainDaemonSets:
     def test_daemonset_pods_refused_then_left_in_place(self, rig):
         """Drain refuses DS pods without --ignore-daemonsets; with it they
