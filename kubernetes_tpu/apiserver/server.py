@@ -47,6 +47,19 @@ WATCH_HEARTBEAT_PERIOD = 10.0
 
 _NULL_GATE = contextlib.nullcontext()
 
+
+def _rebase_group_path(parts: list) -> list:
+    """Group API paths (/apis/{group}/{version}/...) serve the same kind
+    table as the legacy core path — the reference's clients address
+    extensions/v1beta1 replicasets, batch/v1 jobs, autoscaling/v1 HPAs
+    etc.; kind names are globally unique here, so the group/version
+    segments just route.  ONE helper used by both the auth block and
+    the dispatcher, so authorization always names the resource dispatch
+    serves."""
+    if len(parts) >= 3 and parts[0] == "apis":
+        return ["api", "v1"] + parts[3:]
+    return parts
+
 _STATUS_LINES = {
     200: b"HTTP/1.1 200 OK\r\n",
     201: b"HTTP/1.1 201 Created\r\n",
@@ -164,8 +177,9 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                         # Auth runs FIRST in the chain (pkg/apiserver:
                         # auth -> admission -> validation -> registry).
                         target_s = target.decode()
-                        parts = [p for p in
-                                 target_s.split("?", 1)[0].split("/") if p]
+                        parts = _rebase_group_path(
+                            [p for p in
+                             target_s.split("?", 1)[0].split("/") if p])
                         # Resource name for ABAC: the {kind} segment of
                         # API paths; top-level paths (healthz, metrics)
                         # are their own nameable resources.
@@ -227,7 +241,8 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
             """Route one request.  Returns False when the connection was
             taken over by a watch stream (caller must stop the loop)."""
             parsed = urlparse(target)
-            parts = [p for p in parsed.path.split("/") if p]
+            parts = _rebase_group_path(
+                [p for p in parsed.path.split("/") if p])
             query = parse_qs(parsed.query)
             if method == "GET":
                 return self._do_get(parts, query)

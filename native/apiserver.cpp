@@ -1092,6 +1092,13 @@ static bool dispatch(Conn* c, const std::string& method,
     query = target.substr(q + 1);
   }
   auto parts = split_path(path);
+  // Group API paths (/apis/{group}/{version}/...) alias the legacy core
+  // table — kind names are globally unique (matches the Python server).
+  if (parts.size() >= 3 && parts[0] == "apis") {
+    std::vector<std::string> rebased = {"api", "v1"};
+    rebased.insert(rebased.end(), parts.begin() + 3, parts.end());
+    parts = std::move(rebased);
+  }
   auto params = split_query(query);
 
   if (method == "GET") {

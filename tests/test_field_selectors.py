@@ -98,6 +98,42 @@ def _list(base, kind, sel):
     return body["items"]
 
 
+class TestGroupPaths:
+    """/apis/{group}/{version}/... serves the same kinds as the legacy
+    core path on BOTH servers (the reference's clients address
+    extensions/v1beta1 replicasets, batch/v1 jobs, autoscaling/v1
+    HPAs)."""
+
+    def test_group_paths_alias_core(self, base):
+        code, created = _req(
+            base, "POST", "/apis/extensions/v1beta1/replicasets",
+            {"metadata": {"name": "rs1"},
+             "spec": {"replicas": 1,
+                      "selector": {"matchLabels": {"a": "b"}}}})
+        assert code == 201, created
+        assert created["metadata"]["namespace"] == "default"
+        code, got = _req(
+            base, "GET",
+            "/apis/extensions/v1beta1/namespaces/default/"
+            "replicasets/rs1")
+        assert code == 200
+        # The same object is visible through the core path (one store).
+        code, got = _req(
+            base, "GET", "/api/v1/namespaces/default/replicasets/rs1")
+        assert code == 200
+        code, body = _req(base, "POST", "/apis/batch/v1/jobs",
+                          {"metadata": {"name": "j1"},
+                           "spec": {"completions": 1,
+                                    "template": {"spec": {
+                                        "containers": [{"name": "c"}]}}}})
+        assert code == 201
+        code, lst = _req(base, "GET", "/apis/batch/v1/jobs")
+        assert code == 200 and _names(lst["items"]) == ["j1"]
+        code, _ = _req(base, "DELETE",
+                       "/apis/batch/v1/namespaces/default/jobs/j1")
+        assert code == 200
+
+
 class TestListSelectors:
     def test_node_name_set_membership(self, base):
         _req(base, "POST", "/api/v1/pods", _pod("u1"))
