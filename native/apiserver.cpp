@@ -42,6 +42,7 @@
 #include <string>
 #include <sys/epoll.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <time.h>
 #include <unistd.h>
 #include <unordered_map>
@@ -574,6 +575,23 @@ struct Store {
   static constexpr size_t kWindow = 1024;
   std::vector<Conn*> watchers;  // flat: filtered per-event by kind
 
+  // --storage-dir durability (matches the Python store's contract,
+  // memstore.py: every write appends one JSON line to wal.jsonl, a full
+  // snapshot.json rotates every kSnapshotEvery appends, and recovery
+  // replays snapshot + WAL, preserving objects AND the rv counter so
+  // watches resume without a 410 storm; a torn final line from a crash
+  // is truncated at recovery).
+  std::string dir;           // empty = memory-only
+  FILE* wal = nullptr;
+  bool fsync_wal = false;
+  size_t wal_count = 0;
+  static constexpr size_t kSnapshotEvery = 4096;
+
+  void append_wal(const char* etype, const std::string& kind,
+                  const std::string& key, const std::string& obj_json);
+  void rotate_snapshot();
+  void recover();
+
   std::string object_key(const JValue& obj) const {
     auto meta = obj.get("metadata");
     std::string ns = meta ? meta->str_or("namespace", "") : "";
@@ -756,6 +774,7 @@ void Store::emit(const char* etype, const std::string& kind,
   auto obj_json = std::make_shared<std::string>();
   obj_json->reserve(256);
   jdump(*obj, *obj_json);
+  if (wal) append_wal(etype, kind, object_key(*obj), *obj_json);
   auto line = make_line(etype, *obj_json);
   window.push_back({rv, kind, etype, obj, prev, obj_json, line});
   if (window.size() > kWindow) window.pop_front();
@@ -786,6 +805,161 @@ void Store::emit(const char* etype, const std::string& kind,
     frame += "\r\n";
     conn_queue(c, frame);
     c->last_stream_write = now_s();
+  }
+}
+
+// ---------------------------------------------------------- durability --
+void Store::append_wal(const char* etype, const std::string& kind,
+                       const std::string& key,
+                       const std::string& obj_json) {
+  // SAME record format as the Python store (memstore.py _append_wal):
+  // {"t":...,"k":...,"key":...,"rv":N,"o":obj|null} — either server can
+  // recover the other's directory.
+  std::string rec = "{\"t\":\"";
+  rec += etype;
+  rec += "\",\"k\":\"";
+  jescape(kind, rec);
+  rec += "\",\"key\":\"";
+  jescape(key, rec);
+  rec += "\",\"rv\":";
+  rec += std::to_string(rv);
+  rec += ",\"o\":";
+  rec += strcmp(etype, "DELETED") ? obj_json : "null";
+  rec += "}\n";
+  fwrite(rec.data(), 1, rec.size(), wal);
+  fflush(wal);
+  if (fsync_wal) fsync(fileno(wal));
+  if (++wal_count >= kSnapshotEvery) rotate_snapshot();
+}
+
+void Store::rotate_snapshot() {
+  // Every I/O step is CHECKED: a failed snapshot must leave the old
+  // snapshot AND the WAL intact (the Python store raises on the failed
+  // write for the same reason) — silently installing a truncated
+  // snapshot and wiping the WAL would discard acknowledged writes.
+  std::string tmp = dir + "/snapshot.json.tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (!f) {
+    perror("snapshot open");
+    wal_count = 0;  // retry at the next rotation boundary
+    return;
+  }
+  bool ok = true;
+  auto put = [&](const std::string& s) {
+    if (ok && fwrite(s.data(), 1, s.size(), f) != s.size()) ok = false;
+  };
+  // Streamed per object (no whole-cluster string): at kubemark scale
+  // one buffered string would be a hundreds-of-MB transient allocation
+  // stalling the single-threaded event loop.
+  put("{\"rv\":" + std::to_string(rv) + ",\"objects\":{");
+  bool first_k = true;
+  std::string piece;
+  for (auto& kv : objects) {
+    if (kv.second.empty()) continue;
+    piece.clear();
+    if (!first_k) piece += ',';
+    first_k = false;
+    piece += '"';
+    jescape(kv.first, piece);
+    piece += "\":{";
+    put(piece);
+    bool first_o = true;
+    for (auto& ov : kv.second) {
+      piece.clear();
+      if (!first_o) piece += ',';
+      first_o = false;
+      piece += '"';
+      jescape(ov.first, piece);
+      piece += "\":";
+      jdump(*ov.second, piece);
+      put(piece);
+    }
+    put("}");
+  }
+  put("}}");
+  if (ok && fflush(f) != 0) ok = false;
+  if (ok && fsync(fileno(f)) != 0) ok = false;
+  if (fclose(f) != 0) ok = false;
+  if (!ok ||
+      rename(tmp.c_str(), (dir + "/snapshot.json").c_str()) != 0) {
+    perror("snapshot write");
+    unlink(tmp.c_str());
+    wal_count = 0;  // keep appending to the intact WAL; retry later
+    return;
+  }
+  // Only now is it safe to truncate the WAL.  fclose+fopen (not
+  // freopen, whose failure frees the stream and would leave a dangling
+  // FILE*): if the reopen fails, durability STOPS LOUDLY rather than
+  // writing through freed memory.
+  fclose(wal);
+  wal = fopen((dir + "/wal.jsonl").c_str(), "w");
+  if (!wal) perror("wal reopen; durability disabled");
+  wal_count = 0;
+}
+
+static std::string read_file(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return "";
+  std::string out;
+  char buf[65536];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof buf, f)) > 0) out.append(buf, n);
+  fclose(f);
+  return out;
+}
+
+void Store::recover() {
+  std::string snap = read_file(dir + "/snapshot.json");
+  if (!snap.empty()) {
+    JParser jp(snap);
+    JPtr root = jp.parse();
+    if (root && root->type == JValue::Obj) {
+      auto rvv = root->get("rv");
+      if (rvv && rvv->type == JValue::Num) rv = strtoull(
+          rvv->s.c_str(), nullptr, 10);
+      auto objs = root->get("objects");
+      if (objs && objs->type == JValue::Obj)
+        for (auto& kv : objs->obj)
+          if (kv.second->type == JValue::Obj)
+            for (auto& ov : kv.second->obj)
+              objects[kv.first][ov.first] = ov.second;
+    }
+  }
+  std::string walpath = dir + "/wal.jsonl";
+  std::string data = read_file(walpath);
+  size_t pos = 0, good_end = 0;
+  while (pos < data.size()) {
+    size_t eol = data.find('\n', pos);
+    if (eol == std::string::npos) break;  // torn final line
+    std::string line = data.substr(pos, eol - pos);
+    JParser jp(line);
+    JPtr rec = jp.parse();
+    if (!rec || rec->type != JValue::Obj) break;  // torn/garbage tail
+    std::string t = rec->str_or("t", "");
+    std::string k = rec->str_or("k", "");
+    std::string key = rec->str_or("key", "");
+    auto rvv = rec->get("rv");
+    uint64_t rrv = rvv && rvv->type == JValue::Num
+        ? strtoull(rvv->s.c_str(), nullptr, 10) : 0;
+    if (t == "DELETED") {
+      objects[k].erase(key);
+    } else {
+      auto o = rec->get("o");
+      if (o && o->type == JValue::Obj) objects[k][key] = o;
+    }
+    if (rrv > rv) rv = rrv;
+    wal_count++;
+    pos = good_end = eol + 1;
+  }
+  if (good_end < data.size()) {
+    // Drop the torn tail NOW (memstore.py:155-161): appending after it
+    // would weld the next record onto the fragment and lose every
+    // later acknowledged write at the restart after that.
+    FILE* f = fopen(walpath.c_str(), "rb+");
+    if (f) {
+      if (ftruncate(fileno(f), (off_t)good_end) != 0) { /* best effort */ }
+      fclose(f);
+    }
   }
 }
 
@@ -1323,11 +1497,26 @@ static bool process_input(Conn* c) {
 int main(int argc, char** argv) {
   int port = 8080;
   const char* host = "127.0.0.1";
-  for (int i = 1; i < argc - 1; i++) {
-    if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
-    if (!strcmp(argv[i], "--host")) host = argv[i + 1];
+  for (int i = 1; i < argc; i++) {
+    if (!strcmp(argv[i], "--port") && i + 1 < argc)
+      port = atoi(argv[i + 1]);
+    if (!strcmp(argv[i], "--host") && i + 1 < argc) host = argv[i + 1];
+    if (!strcmp(argv[i], "--storage-dir") && i + 1 < argc)
+      g_store.dir = argv[i + 1];
+    if (!strcmp(argv[i], "--storage-fsync")) g_store.fsync_wal = true;
   }
   signal(SIGPIPE, SIG_IGN);
+  if (!g_store.dir.empty()) {
+    mkdir(g_store.dir.c_str(), 0755);
+    g_store.recover();
+    g_store.wal = fopen((g_store.dir + "/wal.jsonl").c_str(), "a");
+    if (!g_store.wal) {
+      perror("wal");
+      return 1;
+    }
+    fprintf(stderr, "recovered %zu WAL records, rv=%llu\n",
+            g_store.wal_count, (unsigned long long)g_store.rv);
+  }
 
   int lfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   int one = 1;

@@ -68,6 +68,93 @@ def _pod(name):
             "spec": {"containers": [{"name": "c"}]}}
 
 
+class TestNativeDurability:
+    """--storage-dir on the native server: SIGKILL + restart on the same
+    directory preserves objects AND the rv counter (watch resume without
+    410), matching the Python store's snapshot+WAL contract — and the
+    WAL record format is SHARED, so either server recovers the other's
+    directory."""
+
+    def _spawn(self, binary, port, d):
+        return subprocess.Popen(
+            [binary, "--port", str(port), "--storage-dir", str(d)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def _wait_up(self, base):
+        deadline = time.time() + 10
+        while True:
+            try:
+                urllib.request.urlopen(base + "/healthz",
+                                       timeout=2).read()
+                return
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.05)
+
+    def test_kill_restart_preserves_objects_and_rv(self, binary,
+                                                   tmp_path):
+        d = tmp_path / "store"
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        proc = self._spawn(binary, port, d)
+        base = f"http://127.0.0.1:{port}"
+        self._wait_up(base)
+        for i in range(5):
+            _req(base, "POST", "/api/v1/pods", _pod(f"d{i}"))
+        _req(base, "POST", "/api/v1/namespaces/default/bindings",
+             {"metadata": {"name": "d0"},
+              "target": {"name": "n1"}})
+        _, lst = _req(base, "GET", "/api/v1/pods")
+        rv_before = int(lst["metadata"]["resourceVersion"])
+        proc.kill()  # SIGKILL: no graceful flush
+        proc.wait(timeout=10)
+
+        proc = self._spawn(binary, port, d)
+        try:
+            self._wait_up(base)
+            _, lst = _req(base, "GET", "/api/v1/pods")
+            assert len(lst["items"]) == 5
+            assert int(lst["metadata"]["resourceVersion"]) >= rv_before
+            _, got = _req(base, "GET",
+                          "/api/v1/namespaces/default/pods/d0")
+            assert got["spec"]["nodeName"] == "n1"
+            # Writes continue with monotone rv after recovery.
+            code, created = _req(base, "POST", "/api/v1/pods",
+                                 _pod("after"))
+            assert code == 201
+            assert int(created["metadata"]["resourceVersion"]) > \
+                rv_before
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_python_store_recovers_native_wal(self, binary, tmp_path):
+        """Shared WAL format: the Python MemStore replays a directory
+        the native server wrote."""
+        from kubernetes_tpu.apiserver.memstore import MemStore
+        d = tmp_path / "xstore"
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        proc = self._spawn(binary, port, d)
+        base = f"http://127.0.0.1:{port}"
+        self._wait_up(base)
+        _req(base, "POST", "/api/v1/pods", _pod("cross"))
+        _req(base, "DELETE", "/api/v1/namespaces/default/pods/cross")
+        _req(base, "POST", "/api/v1/pods", _pod("kept"))
+        _, lst = _req(base, "GET", "/api/v1/pods")
+        rv = int(lst["metadata"]["resourceVersion"])
+        proc.kill()
+        proc.wait(timeout=10)
+        store = MemStore(storage_dir=str(d))
+        items, srv = store.list("pods")
+        assert [o["metadata"]["name"] for o in items] == ["kept"]
+        assert srv >= rv
+        store.close()
+
+
 def test_kind_table_matches_python_manifest(rig):
     """Drift guard (VERDICT r4 weak #3): the native server's namespaced
     kind table is GENERATED from api/types.py NAMESPACED_KINDS; every
