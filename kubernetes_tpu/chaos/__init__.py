@@ -1,0 +1,15 @@
+"""Fault injection for the control plane (toxiproxy-style).
+
+``chaos.proxy.ChaosProxy`` is an in-process HTTP proxy that sits between
+any daemon and the apiserver and injects faults per rule: 5xx bursts, 409
+storms, connection resets, response latency, watch-stream mid-event cuts,
+and forced 410 Gone.  Rules are configurable programmatically and over a
+``/chaos/rules`` admin endpoint so multiprocess e2e rigs can drive it.
+"""
+
+from kubernetes_tpu.chaos.proxy import (FAULT_CUT_STREAM, FAULT_ERROR,
+                                        FAULT_LATENCY, FAULT_RESET,
+                                        ChaosProxy, Rule)
+
+__all__ = ["ChaosProxy", "Rule", "FAULT_ERROR", "FAULT_RESET",
+           "FAULT_LATENCY", "FAULT_CUT_STREAM"]

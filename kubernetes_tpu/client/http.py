@@ -14,8 +14,10 @@ from __future__ import annotations
 import http.client
 import json
 import queue
+import random
 import socket
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -24,10 +26,26 @@ from typing import Callable, Optional
 from kubernetes_tpu.api.types import NAMESPACED_KINDS
 from kubernetes_tpu.apiserver.memstore import (ConflictError, Event,
                                                TooOldError)
+from kubernetes_tpu.utils import metrics
 from kubernetes_tpu.utils.flowcontrol import TokenBucketRateLimiter
 
 DEFAULT_QPS = 5.0     # restclient/config.go:186 (perf rigs raise to 5000)
 DEFAULT_BURST = 10    # restclient/config.go:190
+
+# Retry policy for idempotent verbs (GET/HEAD list/get; watch reconnects
+# are paced by the reflector's relist backoff).  Non-idempotent verbs
+# (POST bindings!) are never retried here — their callers own the
+# semantics (the scheduler forgets + requeues on bind failure).
+RETRIABLE_STATUS = (429, 500, 502, 503, 504)
+DEFAULT_MAX_RETRIES = 3
+RETRY_BACKOFF_BASE = 0.05   # jittered, doubling per attempt
+RETRY_BACKOFF_CAP = 2.0
+# Retry budget (the reference's client-go retry budgets / Finagle shape):
+# retries spend from a token bucket refilled at a fraction of normal
+# traffic, so a flapping apiserver sees bounded retry amplification
+# instead of a coordinated storm from every cached client.
+RETRY_BUDGET_QPS = 5.0
+RETRY_BUDGET_BURST = 20
 
 
 class TLSConfig:
@@ -149,12 +167,18 @@ class APIClient:
 
     def __init__(self, base_url: str, qps: float = DEFAULT_QPS,
                  burst: int = DEFAULT_BURST, timeout: float = 10.0,
-                 token: str = "", tls: Optional[TLSConfig] = None):
+                 token: str = "", tls: Optional[TLSConfig] = None,
+                 max_retries: int = DEFAULT_MAX_RETRIES):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token  # bearer token (restclient.Config.BearerToken)
         self.tls = tls
+        self.max_retries = max_retries
         self.limiter = TokenBucketRateLimiter(qps, burst)
+        # Budget shared by every verb on this client (not per request):
+        # the amplification bound must cover the whole client's traffic.
+        self._retry_budget = TokenBucketRateLimiter(RETRY_BUDGET_QPS,
+                                                    RETRY_BUDGET_BURST)
         parsed = urllib.parse.urlparse(self.base_url)
         self._scheme = parsed.scheme or "http"
         self._host = parsed.hostname or "127.0.0.1"
@@ -169,7 +193,7 @@ class APIClient:
         client)."""
         return APIClient(self.base_url, qps=qps, burst=burst,
                          timeout=self.timeout, token=self.token,
-                         tls=self.tls)
+                         tls=self.tls, max_retries=self.max_retries)
 
 
     # -- verbs -----------------------------------------------------------
@@ -190,13 +214,11 @@ class APIClient:
             self._local.conn = c
         return c
 
-    def _request(self, method: str, path: str,
-                 obj: Optional[dict] = None) -> dict:
-        self.limiter.accept()
-        data = json.dumps(obj).encode() if obj is not None else None
-        headers = {"Content-Type": "application/json"} if data else {}
-        if self.token:
-            headers["Authorization"] = f"Bearer {self.token}"
+    def _send_once(self, method: str, path: str, data, headers
+                   ) -> tuple[int, bytes, Optional[float]]:
+        """One request/response exchange, absorbing stale keep-alives.
+        Returns (status, body, Retry-After seconds or None); raises the
+        transport error when the exchange could not complete safely."""
         for attempt in (0, 1):
             c = self._conn()
             try:
@@ -213,6 +235,7 @@ class APIClient:
             try:
                 resp = c.getresponse()
                 status = resp.status
+                retry_after = resp.getheader("Retry-After")
                 body = resp.read()
                 break
             except (http.client.HTTPException, OSError):
@@ -224,14 +247,66 @@ class APIClient:
                 self._local.conn = None
                 if attempt or method not in ("GET", "HEAD"):
                     raise
-        if status < 300:
-            return json.loads(body or b"{}")
-        text = body.decode(errors="replace")
-        if status == 409:
-            raise ConflictError(text)
-        if status == 410:
-            raise TooOldError(text)
-        raise APIError(status, text)
+        try:
+            after = float(retry_after) if retry_after else None
+        except ValueError:
+            after = None
+        return status, body, after
+
+    def _retry_permitted(self, attempt: int) -> bool:
+        """Bounded by max_retries AND the client-wide retry budget."""
+        if attempt >= self.max_retries:
+            return False
+        if not self._retry_budget.try_accept():
+            metrics.CLIENT_RETRY_BUDGET_EXHAUSTED.inc()
+            return False
+        return True
+
+    def _retry_sleep(self, attempt: int,
+                     retry_after: Optional[float] = None) -> None:
+        """Retry-After is honored exactly; otherwise jittered exponential
+        backoff (full jitter: U(0.5, 1.5) x base x 2^attempt, capped)."""
+        metrics.CLIENT_RETRIES.inc()
+        if retry_after is not None:
+            time.sleep(min(retry_after, RETRY_BACKOFF_CAP * 4))
+            return
+        delay = min(RETRY_BACKOFF_BASE * (2 ** attempt), RETRY_BACKOFF_CAP)
+        time.sleep(delay * (0.5 + random.random()))
+
+    def _request(self, method: str, path: str,
+                 obj: Optional[dict] = None) -> dict:
+        self.limiter.accept()
+        data = json.dumps(obj).encode() if obj is not None else None
+        headers = {"Content-Type": "application/json"} if data else {}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        idempotent = method in ("GET", "HEAD")
+        attempt = 0
+        while True:
+            try:
+                status, body, retry_after = self._send_once(
+                    method, path, data, headers)
+            except (http.client.HTTPException, OSError):
+                # Transport fault past the stale-keep-alive absorption:
+                # retriable only for idempotent verbs, within budget.
+                if not idempotent or not self._retry_permitted(attempt):
+                    raise
+                self._retry_sleep(attempt)
+                attempt += 1
+                continue
+            if status < 300:
+                return json.loads(body or b"{}")
+            if idempotent and status in RETRIABLE_STATUS and \
+                    self._retry_permitted(attempt):
+                self._retry_sleep(attempt, retry_after)
+                attempt += 1
+                continue
+            text = body.decode(errors="replace")
+            if status == 409:
+                raise ConflictError(text)
+            if status == 410:
+                raise TooOldError(text)
+            raise APIError(status, text)
 
     def _object_path(self, kind: str, key: str) -> str:
         if kind in self._NAMESPACED or "/" in key:
@@ -285,12 +360,14 @@ class APIClient:
              "metadata": {"name": pod_name, "namespace": namespace}})
 
     def bind_list(self, bindings: list[tuple[str, str, str]]
-                  ) -> list[Optional[str]]:
+                  ) -> list[Optional[tuple[int, str]]]:
         """Batch bindings: one POST carrying a Binding list; the server
         runs the same per-pod CAS as N single POSTs and returns a
-        per-item error string (None = bound).  This is the wire-gap
-        lever: the engine decides in multi-thousand-pod chunks, and one
-        request per chunk replaces one request per pod."""
+        per-item ``(status_code, error)`` (None = bound).  The code
+        matters to the caller: a 409 CAS conflict and a 404 require
+        different handling/counting.  This is the wire-gap lever: the
+        engine decides in multi-thousand-pod chunks, and one request per
+        chunk replaces one request per pod."""
         if not bindings:
             return []
         resp = self._request("POST", "/api/v1/namespaces/default/bindings", {
@@ -303,7 +380,7 @@ class APIClient:
             # every bind landed (nothing to detail).
             return [None] * len(bindings)
         return [None if r.get("code") == 201 else
-                r.get("error", f"HTTP {r.get('code')}")
+                (r.get("code", 0), r.get("error", f"HTTP {r.get('code')}"))
                 for r in resp.get("results", [])]
 
     def create_list(self, kind: str, objs: list[dict]) -> list[dict]:
@@ -420,6 +497,17 @@ class HTTPWatcher:
 
     def stop(self) -> None:
         self._stopped.set()
+        # Shut the socket down FIRST: the pump thread is usually blocked
+        # in recv() holding the response's buffer lock, and resp.close()
+        # waits on that lock — without the shutdown, stop() stalls until
+        # the next server heartbeat (up to WATCH_HEARTBEAT_PERIOD).
+        # shutdown() wakes the blocked read with EOF immediately.
+        try:
+            sock = getattr(self._conn, "sock", None)
+            if sock is not None:
+                sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._resp.close()
         except Exception:  # noqa: BLE001

@@ -14,13 +14,26 @@ when the chunked stream dies, which triggers the relist path."""
 
 from __future__ import annotations
 
+import random
 import threading
+import time
 from typing import Callable, Optional
 
 from kubernetes_tpu.api import fieldsel
 from kubernetes_tpu.apiserver.memstore import MemStore, TooOldError
+from kubernetes_tpu.utils import metrics
 
 Handler = Callable[[str, dict], None]
+
+# Relist backoff (PodBackoff-style doubling, factory.go:602-688 shape):
+# the first failure retries quickly, a persistently dead apiserver is
+# probed at the cap instead of hammered in a tight loop.
+RELIST_BACKOFF_INITIAL = 0.2
+RELIST_BACKOFF_MAX = 30.0
+# A stream must survive this long for the backoff to reset: a server that
+# lists fine but kills every stream instantly (mid-event cuts, a flapping
+# LB) must not relist the whole kind at full rate.
+STREAM_MIN_HEALTHY = 1.0
 
 
 class Reflector:
@@ -83,15 +96,32 @@ class Reflector:
 
     def run(self) -> threading.Thread:
         def loop():
+            backoff = RELIST_BACKOFF_INITIAL
+            first = True
             while not self._stop.is_set():
+                if not first:
+                    metrics.REFLECTOR_RELISTS.inc()
+                first = False
                 try:
                     rv = self._list()
                     watcher = self._open_watch(rv)
                 except TooOldError:
+                    # 410 Gone: the watch window fell behind — relist
+                    # immediately once, but back off if the server keeps
+                    # answering Gone (a tight relist loop IS the storm).
+                    self._stop.wait(backoff * random.uniform(0.5, 1.0)
+                                    if backoff > RELIST_BACKOFF_INITIAL
+                                    else 0.0)
+                    backoff = min(backoff * 2, RELIST_BACKOFF_MAX)
                     continue
                 except Exception:  # noqa: BLE001 — apiserver down: retry
-                    self._stop.wait(1.0)
+                    # Jittered doubling instead of the old fixed 1 s loop:
+                    # a fleet of reflectors against a flapping apiserver
+                    # must not relist in lockstep.
+                    self._stop.wait(backoff * random.uniform(0.5, 1.5))
+                    backoff = min(backoff * 2, RELIST_BACKOFF_MAX)
                     continue
+                stream_started = time.monotonic()
                 try:
                     while not self._stop.is_set():
                         ev = watcher.next(timeout=0.1)
@@ -112,6 +142,15 @@ class Reflector:
                         self.handler(ev.type, ev.object)
                 finally:
                     watcher.stop()
+                # Reset the backoff only when the stream actually lived:
+                # list + watch-open + a healthy stream means the server
+                # recovered.  Streams dying at birth back off like any
+                # other failure — instant relists ARE the storm.
+                if time.monotonic() - stream_started >= STREAM_MIN_HEALTHY:
+                    backoff = RELIST_BACKOFF_INITIAL
+                elif not self._stop.is_set():
+                    self._stop.wait(backoff * random.uniform(0.5, 1.5))
+                    backoff = min(backoff * 2, RELIST_BACKOFF_MAX)
         t = threading.Thread(target=loop, daemon=True,
                              name=f"reflector-{self.kind}")
         t.start()

@@ -81,7 +81,7 @@ def unmet_schedule_times(sj: dict, now: datetime) -> list[datetime]:
 class ScheduledJobController:
     def __init__(self, source: Union[MemStore, APIClient, str],
                  sync_period: float = SYNC_PERIOD, token: str = "",
-                 clock=None):
+                 tls=None, clock=None):
         if isinstance(source, str):
             source = APIClient(source, token=token, tls=tls)
         self.store = source
@@ -267,28 +267,41 @@ class ScheduledJobController:
             log.info("job %s/%s not created: %s", ns, job_name, err)
             return
         ref = {"namespace": ns, "name": job_name}
+        # The lastScheduleTime publish is NOT best-effort like the active-
+        # list reconcile: if it's lost, the next sync re-decides slot T
+        # from stale status and (under concurrencyPolicy=Replace)
+        # cascade-deletes and recreates the job it just started.  Retry
+        # the CAS a few times against a fresh read before giving up.
         self._publish(sj, {"lastScheduleTime": _fmt_time(scheduled)},
-                      add_active=ref)
+                      add_active=ref, retries=3)
 
     def _publish(self, sj: dict, patch: dict,
-                 add_active: dict | None = None) -> None:
+                 add_active: dict | None = None, retries: int = 1) -> None:
         """Merge ``patch`` into the FRESH stored status under CAS —
         a whole-status overwrite from a cache-derived dict would clobber
-        a lastScheduleTime written between our read and now."""
+        a lastScheduleTime written between our read and now.  ``retries``
+        bounds how many fresh-read + CAS rounds a lost race gets."""
         meta = sj.get("metadata") or {}
         key = f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
-        try:
-            cur = self.store.get("scheduledjobs", key)
-            if cur is None:
+        for attempt in range(max(1, retries)):
+            try:
+                cur = self.store.get("scheduledjobs", key)
+                if cur is None:
+                    return
+                status = dict(cur.get("status") or {})
+                status.update(patch)
+                if add_active is not None and \
+                        add_active not in (status.get("active") or []):
+                    status["active"] = list(status.get("active") or []) + \
+                        [add_active]
+                if (cur.get("status") or {}) != status:
+                    cas_update(self.store, "scheduledjobs",
+                               {**cur, "status": status})
                 return
-            status = dict(cur.get("status") or {})
-            status.update(patch)
-            if add_active is not None and \
-                    add_active not in (status.get("active") or []):
-                status["active"] = list(status.get("active") or []) + \
-                    [add_active]
-            if (cur.get("status") or {}) != status:
-                cas_update(self.store, "scheduledjobs",
-                           {**cur, "status": status})
-        except Exception:  # noqa: BLE001 — CAS race: next sync heals
-            pass
+            except Exception:  # noqa: BLE001 — CAS race or transport
+                if attempt + 1 >= max(1, retries):
+                    log.warning("scheduledjob %s: status publish %s lost "
+                                "after %d attempts", key, list(patch),
+                                attempt + 1)
+                    return
+                time.sleep(0.02 * (attempt + 1))

@@ -26,7 +26,10 @@ from kubernetes_tpu.api.policy import (Policy, default_provider,
                                        service_anti_affinity_labels)
 from kubernetes_tpu.cache.scheduler_cache import SchedulerCache
 from kubernetes_tpu.engine import solver as sv
-from kubernetes_tpu.engine.extender_client import ExtenderError, HTTPExtender
+from kubernetes_tpu.engine.extender_client import (ExtenderError,
+                                                   ExtenderUnavailable,
+                                                   HTTPExtender)
+from kubernetes_tpu.utils import metrics
 from kubernetes_tpu.features import batch as fb
 from kubernetes_tpu.features import padcap
 from kubernetes_tpu.features.volumes import compile_volsvc
@@ -229,8 +232,27 @@ class GenericScheduler:
         nodes = self.cache.nodes()
         candidates = [nodes[i] for i in range(len(nodes)) if feasible_np[i]]
         failed_ext: dict[str, list[str]] = {}
+        degraded = False
         for ext in self.extenders:
-            candidates, failed = ext.filter(pod, candidates)
+            try:
+                candidates, failed = ext.filter(pod, candidates)
+            except ExtenderUnavailable:
+                # Breaker open: the endpoint is known-dead.  Graceful
+                # degradation — schedule on built-in predicates alone
+                # rather than failing every pod until it recovers.  (A
+                # closed-breaker timeout still raises ExtenderError and
+                # fails THIS pod, the reference's filter-timeout
+                # semantics, api/types.go:128-130.)
+                if not degraded:
+                    degraded = True
+                    metrics.EXTENDER_DEGRADED_DECISIONS.inc()
+                    # debug, not warning: thousands of pods degrade per
+                    # 15 s open window — the breaker transition itself is
+                    # logged once (extender_client) and counted above.
+                    log.debug("extender %s unavailable (breaker open); "
+                              "scheduling %s with built-in predicates "
+                              "only", ext.config.url_prefix, pod.key)
+                continue
             for name, msg in failed.items():
                 failed_ext.setdefault(name, []).append(msg or "extender")
             if not candidates:

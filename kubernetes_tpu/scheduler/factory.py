@@ -25,6 +25,7 @@ from typing import Optional, Union
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.policy import Policy
 from kubernetes_tpu.apiserver.memstore import ConflictError, MemStore
+from kubernetes_tpu.client.http import APIError
 from kubernetes_tpu.cache.scheduler_cache import CLEANUP_PERIOD
 from kubernetes_tpu.client.http import APIClient
 from kubernetes_tpu.client.reflector import Reflector
@@ -98,9 +99,14 @@ class APIClientBinder:
                 return self._bind_many_fallback(chunk)
             if len(errors) != len(chunk):
                 return self._bind_many_fallback(chunk)
-            return [(pod, ConflictError(err))
-                    for (pod, _), err in zip(chunk, errors)
-                    if err is not None]
+            # Preserve the per-item status: only a 409 is a CAS conflict;
+            # wrapping a 404 (pod deleted mid-bind) as ConflictError
+            # would invert the conflict/failure metric split downstream.
+            return [(pod, ConflictError(err) if code == 409
+                     else APIError(code, err))
+                    for (pod, _), res in zip(chunk, errors)
+                    if res is not None
+                    for code, err in (res,)]
 
         chunks = [placed[i:i + self._BATCH]
                   for i in range(0, len(placed), self._BATCH)]

@@ -20,14 +20,25 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver.memstore import ConflictError
 from kubernetes_tpu.engine.extender_client import ExtenderError
 from kubernetes_tpu.engine.generic_scheduler import FitError, GenericScheduler
 from kubernetes_tpu.scheduler.backoff import PodBackoff
-from kubernetes_tpu.scheduler.binder import Binder, InMemoryBinder
+from kubernetes_tpu.scheduler.binder import Binder, BindConflict, InMemoryBinder
 from kubernetes_tpu.scheduler.queue import FIFO
+from kubernetes_tpu.utils import metrics as metrics_mod
 from kubernetes_tpu.utils.events import EventRecorder
 from kubernetes_tpu.utils.logging import get_logger
 from kubernetes_tpu.utils.metrics import SchedulerMetrics
+
+
+def _record_bind_failure(err) -> None:
+    """409/CAS conflicts and transport faults are different operator
+    stories: count them apart (both forget + requeue with backoff)."""
+    if isinstance(err, (BindConflict, ConflictError)):
+        metrics_mod.BIND_CONFLICTS.inc()
+    else:
+        metrics_mod.BIND_FAILURES.inc()
 
 log = get_logger("daemon")
 
@@ -306,7 +317,10 @@ class Scheduler:
         try:
             self.config.binder.bind(pod, dest)
         except Exception as err:  # noqa: BLE001 — bind errors requeue
-            # ForgetPod + error handler (scheduler.go:139-148).
+            # ForgetPod + error handler (scheduler.go:139-148).  409 and
+            # timeout alike: forget the optimistic assume, emit the event,
+            # requeue behind per-pod backoff — never silently drop.
+            _record_bind_failure(err)
             if assumed:
                 cache.forget_pod(pod)
             self._handle_failure(pod, "FailedScheduling",
@@ -335,6 +349,7 @@ class Scheduler:
             items = []
             for pod, dest in placed:
                 if pod.key in failed:
+                    _record_bind_failure(failed[pod.key])
                     cache.forget_pod(pod)
                     # Surface the real error: a CAS conflict and a
                     # network failure require different operator action.
@@ -352,6 +367,7 @@ class Scheduler:
                 try:
                     self.config.binder.bind(pod, dest)
                 except Exception as err:  # noqa: BLE001 — bind errors requeue
+                    _record_bind_failure(err)
                     cache.forget_pod(pod)
                     self._handle_failure(pod, "FailedScheduling",
                                          f"Binding rejected: {err}")

@@ -79,9 +79,117 @@ class Counter:
                 f"{self.name} {self.value}\n")
 
 
+class Gauge:
+    """prometheus.Gauge: a value that can go up and down (breaker state,
+    queue depths).  ``set_fn`` switches it to a callback gauge computed at
+    expose time (prometheus.GaugeFunc) — the right shape when the truth
+    lives in object lifetimes (e.g. a WeakSet of open breakers) rather
+    than in paired inc/dec calls that a dropped object would unbalance."""
+
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._value = 0.0
+        self._fn = None
+        self._lock = threading.Lock()
+
+    def set_fn(self, fn) -> None:
+        with self._lock:
+            self._fn = fn
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    def inc(self, by: float = 1.0) -> None:
+        with self._lock:
+            self._value += by
+
+    def dec(self, by: float = 1.0) -> None:
+        self.inc(-by)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+        if fn is not None:
+            return fn()
+        with self._lock:
+            return self._value
+
+    def expose(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} gauge\n"
+                f"{self.name} {self.value:g}\n")
+
+
 def exponential_buckets(start: float, factor: float, count: int) -> list[float]:
     """prometheus.ExponentialBuckets."""
     return [start * factor ** i for i in range(count)]
+
+
+# -- default registry --------------------------------------------------------
+#
+# Process-wide metrics the hardened failure paths record into (client
+# retries, reflector relists, breaker transitions, degraded decisions).
+# They are registered here rather than on a per-daemon metric set because
+# the recording sites (APIClient, Reflector, HTTPExtender) are shared
+# library code with no daemon handle; every /metrics endpoint appends
+# ``expose_registry()`` so the counters are observable wherever they
+# accumulate (the reference's prometheus.MustRegister default-registry
+# shape).
+
+_REGISTRY: list = []
+_REGISTRY_LOCK = threading.Lock()
+
+
+def register(metric):
+    """Add a metric to the default registry; returns it for assignment."""
+    with _REGISTRY_LOCK:
+        _REGISTRY.append(metric)
+    return metric
+
+
+def expose_registry() -> str:
+    with _REGISTRY_LOCK:
+        metrics = list(_REGISTRY)
+    return "".join(m.expose() for m in metrics)
+
+
+# Client -> apiserver path (client/http.py).
+CLIENT_RETRIES = register(Counter(
+    "apiclient_retries_total",
+    "Retries of idempotent apiserver verbs after 5xx/429/transport faults"))
+CLIENT_RETRY_BUDGET_EXHAUSTED = register(Counter(
+    "apiclient_retry_budget_exhausted_total",
+    "Retries skipped because the client retry budget was empty"))
+# Reflector list+watch loop (client/reflector.py).
+REFLECTOR_RELISTS = register(Counter(
+    "reflector_relists_total",
+    "Reflector relists after watch errors, stream EOF, or 410 Gone"))
+# Extender path (engine/extender_client.py + generic_scheduler.py).
+EXTENDER_RETRIES = register(Counter(
+    "extender_retries_total",
+    "Retries of extender filter/prioritize calls after transport faults"))
+EXTENDER_BREAKER_TRANSITIONS = register(Counter(
+    "extender_breaker_transitions_total",
+    "Extender circuit-breaker state transitions (closed/open/half-open)"))
+EXTENDER_BREAKER_OPEN = register(Gauge(
+    "extender_breaker_open",
+    "Number of currently-open extender circuit breakers (0 = none)"))
+EXTENDER_DEGRADED_DECISIONS = register(Counter(
+    "scheduler_extender_degraded_decisions_total",
+    "Scheduling decisions made with built-in predicates only because the "
+    "extender breaker was open"))
+# Bind path (scheduler/scheduler.py).
+BIND_CONFLICTS = register(Counter(
+    "scheduler_bind_conflicts_total",
+    "Bind attempts rejected by the apiserver CAS (409: nodeName already "
+    "set); each forgets the assumed pod and requeues with backoff"))
+BIND_FAILURES = register(Counter(
+    "scheduler_bind_failures_total",
+    "Bind attempts lost to transport faults or timeouts (non-conflict); "
+    "each forgets the assumed pod and requeues with backoff"))
 
 
 class SchedulerMetrics:
@@ -100,6 +208,9 @@ class SchedulerMetrics:
             "Binding latency", buckets)
 
     def expose(self) -> str:
+        # The default registry (retry/breaker/degradation counters) rides
+        # along so any daemon serving a SchedulerMetrics /metrics endpoint
+        # also exposes the failure-path observability.
         return "".join(h.expose() for h in (
             self.e2e_scheduling_latency, self.scheduling_algorithm_latency,
-            self.binding_latency))
+            self.binding_latency)) + expose_registry()

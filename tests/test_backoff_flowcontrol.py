@@ -1,0 +1,262 @@
+"""Coverage for the idle-GC and contention paths of the two shared
+rate-control primitives: ``PodBackoff.gc()`` (scheduler/backoff.py) and
+``TokenBucketRateLimiter`` (utils/flowcontrol.py) — plus regression tests
+for the ScheduledJobController constructor and status-publish retry."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.scheduler.backoff import PodBackoff
+from kubernetes_tpu.utils.flowcontrol import TokenBucketRateLimiter
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- PodBackoff -------------------------------------------------------------
+
+def test_podbackoff_gc_drops_idle_entries():
+    clock = FakeClock()
+    b = PodBackoff(default_duration=1.0, max_duration=60.0, now=clock)
+    assert b.get_backoff("a") == 1.0
+    clock.advance(10.0)
+    assert b.get_backoff("b") == 1.0
+    # "a" idles past max_duration; "b" was touched 10s ago and stays.
+    clock.advance(55.0)
+    b.gc()
+    assert "a" not in b._entries
+    assert "b" in b._entries
+    # A GC'd pod starts over at the default duration.
+    assert b.get_backoff("a") == 1.0
+    # "b" kept its doubled state across the GC.
+    assert b.get_backoff("b") == 2.0
+
+
+def test_podbackoff_gc_boundary_not_dropped():
+    clock = FakeClock()
+    b = PodBackoff(default_duration=1.0, max_duration=60.0, now=clock)
+    b.get_backoff("edge")
+    clock.advance(60.0)  # exactly max_duration idle: > is strict, kept
+    b.gc()
+    assert "edge" in b._entries
+
+
+def test_podbackoff_concurrent_get_backoff_single_doubling_chain():
+    """N threads hammering the same key must observe the one doubling
+    chain 1,2,4,... (each value at most once) — no lost updates."""
+    clock = FakeClock()
+    b = PodBackoff(default_duration=1.0, max_duration=float(1 << 60),
+                   now=clock)
+    seen: list[float] = []
+    lock = threading.Lock()
+
+    def worker():
+        for _ in range(4):
+            v = b.get_backoff("pod")
+            with lock:
+                seen.append(v)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(seen) == 32
+    assert sorted(seen) == [float(1 << i) for i in range(32)]
+
+
+def test_podbackoff_concurrent_gc_while_getting():
+    """gc() racing get_backoff must neither deadlock nor corrupt the
+    table; a just-touched entry survives."""
+    clock = FakeClock()
+    b = PodBackoff(default_duration=1.0, max_duration=5.0, now=clock)
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def getter():
+        try:
+            i = 0
+            while not stop.is_set():
+                b.get_backoff(f"pod-{i % 10}")
+                i += 1
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    def collector():
+        try:
+            while not stop.is_set():
+                clock.advance(1.0)
+                b.gc()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=getter) for _ in range(4)] + \
+              [threading.Thread(target=collector)]
+    for t in threads:
+        t.start()
+    import time
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors
+
+
+# -- TokenBucketRateLimiter -------------------------------------------------
+
+def test_token_bucket_contended_try_accept_never_oversubscribes():
+    """With a frozen clock, exactly ``burst`` try_accept() calls may win
+    across any number of threads."""
+    clock = FakeClock()
+    lim = TokenBucketRateLimiter(10.0, 5, now=clock)
+    wins = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(8)
+
+    def worker():
+        barrier.wait()
+        for _ in range(20):
+            if lim.try_accept():
+                with lock:
+                    wins.append(1)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(wins) == 5  # the burst, not a token more
+
+
+def test_token_bucket_refill_caps_at_burst():
+    clock = FakeClock()
+    lim = TokenBucketRateLimiter(10.0, 5, now=clock)
+    for _ in range(5):
+        assert lim.try_accept()
+    assert not lim.try_accept()
+    clock.advance(100.0)  # way past refill: capped at burst
+    got = sum(1 for _ in range(10) if lim.try_accept())
+    assert got == 5
+
+
+def test_token_bucket_concurrent_accept_blocks_for_tokens():
+    """accept() under contention: 8 threads x 5 tokens from a qps=200
+    burst=10 bucket must take ~(40-10)/200 = 0.15s, not return early."""
+    import time
+    lim = TokenBucketRateLimiter(200.0, 10)
+    start = time.monotonic()
+    threads = [threading.Thread(
+        target=lambda: [lim.accept() for _ in range(5)])
+        for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    elapsed = time.monotonic() - start
+    assert elapsed >= 0.10  # waited for refill
+    assert elapsed < 5.0    # and didn't livelock
+    assert lim.saturation() > 0.9
+
+
+def test_token_bucket_disabled_never_blocks():
+    lim = TokenBucketRateLimiter(0.0, 1)
+    for _ in range(1000):
+        assert lim.try_accept()
+    assert lim.saturation() == 0.0
+
+
+# -- ScheduledJobController regressions -------------------------------------
+
+def test_scheduledjob_controller_constructs_from_url():
+    """Regression: ``__init__`` referenced an undefined ``tls`` when
+    given a base-URL source (NameError before the ``tls=None``
+    parameter existed)."""
+    from kubernetes_tpu.client.http import APIClient, TLSConfig
+    from kubernetes_tpu.controller.scheduledjob import ScheduledJobController
+    c = ScheduledJobController("http://127.0.0.1:1")
+    assert isinstance(c.store, APIClient)
+    tls = TLSConfig(insecure_skip_verify=True)
+    c2 = ScheduledJobController("https://127.0.0.1:1", tls=tls)
+    assert c2.store.tls is tls
+
+
+class FlakyStore:
+    """MemStore wrapper whose update() fails N times before succeeding."""
+
+    def __init__(self, store, failures: int):
+        self._store = store
+        self.failures = failures
+        self.update_attempts = 0
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+    def update(self, kind, obj, **kw):
+        self.update_attempts += 1
+        if self.failures > 0:
+            self.failures -= 1
+            from kubernetes_tpu.apiserver.memstore import ConflictError
+            raise ConflictError("injected CAS loss")
+        return self._store.update(kind, obj, **kw)
+
+
+def test_scheduledjob_last_schedule_publish_retries_lost_cas():
+    """A lost CAS on the lastScheduleTime publish must be retried — an
+    unpublished slot would be re-decided next sync and (under Replace)
+    cascade-delete the job just started."""
+    from datetime import datetime, timezone
+
+    from kubernetes_tpu.apiserver.memstore import MemStore
+    from kubernetes_tpu.controller.scheduledjob import ScheduledJobController
+
+    store = MemStore()
+    flaky = FlakyStore(store, failures=2)
+    now = datetime(2026, 1, 1, 12, 0, 30, tzinfo=timezone.utc)
+    store.create("scheduledjobs", {
+        "metadata": {"name": "sj", "namespace": "default",
+                     "creationTimestamp": "2026-01-01T11:58:00Z"},
+        "spec": {"schedule": "* * * * *",
+                 "concurrencyPolicy": "Replace",
+                 "jobTemplate": {"spec": {"parallelism": 1}}}})
+    ctl = ScheduledJobController(flaky, clock=lambda: now)
+    sj = store.get("scheduledjobs", "default/sj")
+    ctl.sync_one(sj, now)
+    jobs, _ = store.list("jobs", None)
+    assert len(jobs) == 1
+    cur = store.get("scheduledjobs", "default/sj")
+    # The two injected CAS losses were retried through; the slot landed.
+    assert (cur.get("status") or {}).get("lastScheduleTime")
+    assert flaky.update_attempts >= 3
+
+
+def test_scheduledjob_publish_gives_up_after_bounded_retries():
+    """Persistent CAS loss must not loop forever: bounded attempts, then
+    the next sync owns recovery."""
+    from datetime import datetime, timezone
+
+    from kubernetes_tpu.apiserver.memstore import MemStore
+    from kubernetes_tpu.controller.scheduledjob import ScheduledJobController
+
+    store = MemStore()
+    flaky = FlakyStore(store, failures=10**6)
+    now = datetime(2026, 1, 1, 12, 0, 30, tzinfo=timezone.utc)
+    store.create("scheduledjobs", {
+        "metadata": {"name": "sj", "namespace": "default",
+                     "creationTimestamp": "2026-01-01T11:59:00Z"},
+        "spec": {"schedule": "* * * * *",
+                 "jobTemplate": {"spec": {}}}})
+    ctl = ScheduledJobController(flaky, clock=lambda: now)
+    ctl.sync_one(store.get("scheduledjobs", "default/sj"), now)
+    # Bounded: the publish tried a handful of times, not thousands.
+    assert flaky.update_attempts <= 10

@@ -1,0 +1,255 @@
+"""Unit coverage for the fault-injection layer itself: ChaosProxy rule
+semantics + admin endpoint, the circuit breaker state machine, the
+APIClient retry policy, and the reflector's relist backoff."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.apiserver.server import serve
+from kubernetes_tpu.chaos import ChaosProxy, Rule
+from kubernetes_tpu.client.http import APIClient, APIError
+from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils.circuitbreaker import (CLOSED, HALF_OPEN, OPEN,
+                                                 CircuitBreaker)
+
+
+@pytest.fixture()
+def rig():
+    """MemStore + apiserver + proxy + unthrottled client through it."""
+    store = MemStore()
+    srv = serve(store)
+    upstream = f"http://127.0.0.1:{srv.server_address[1]}"
+    proxy = ChaosProxy(upstream).start()
+    client = APIClient(proxy.base_url, qps=0)
+    yield store, proxy, client, upstream
+    proxy.stop()
+    srv.shutdown()
+
+
+def _admin(proxy, method: str, path: str, obj=None):
+    data = json.dumps(obj).encode() if obj is not None else None
+    req = urllib.request.Request(proxy.base_url + path, data=data,
+                                 method=method)
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read())
+
+
+# -- proxy ------------------------------------------------------------------
+
+class TestChaosProxy:
+    def test_passthrough_all_verbs(self, rig):
+        store, proxy, client, _ = rig
+        client.create("nodes", {"metadata": {"name": "n1"}})
+        assert store.get("nodes", "n1") is not None
+        obj = client.get("nodes", "n1")
+        obj["metadata"]["labels"] = {"a": "b"}
+        client.update("nodes", obj)
+        assert store.get("nodes", "n1")["metadata"]["labels"] == {"a": "b"}
+        items, _rv = client.list("nodes")
+        assert len(items) == 1
+        client.delete("nodes", "n1")
+        assert store.get("nodes", "n1") is None
+
+    def test_error_rule_count_is_exact(self, rig):
+        _, proxy, client, _ = rig
+        client.create("nodes", {"metadata": {"name": "n1"}})
+        proxy.add_rule(fault="error", method="GET", path="/nodes",
+                       status=500, count=2)
+        # Retries absorb exactly the two injected 500s.
+        assert client.get("nodes", "n1")["metadata"]["name"] == "n1"
+        stats = proxy.stats()
+        assert stats["injected"] == 2
+        assert stats["rules"][0]["count"] == 0
+        assert stats["rules"][0]["fired"] == 2
+
+    def test_probability_zero_never_fires(self, rig):
+        _, proxy, client, _ = rig
+        client.create("nodes", {"metadata": {"name": "n1"}})
+        proxy.add_rule(fault="error", status=500, probability=0.0)
+        for _ in range(10):
+            client.get("nodes", "n1")
+        assert proxy.stats()["injected"] == 0
+
+    def test_non_idempotent_verbs_not_retried(self, rig):
+        _, proxy, client, _ = rig
+        proxy.add_rule(fault="error", method="POST", status=503, count=5)
+        with pytest.raises(APIError) as ei:
+            client.create("nodes", {"metadata": {"name": "n1"}})
+        assert ei.value.status == 503
+        assert proxy.stats()["injected"] == 1  # no retry spent more
+
+    def test_retry_gives_up_past_max_retries(self, rig):
+        _, proxy, client, _ = rig
+        proxy.add_rule(fault="error", method="GET", status=500, count=50)
+        with pytest.raises(APIError):
+            client.get("nodes", "n1")
+        # 1 initial + max_retries attempts, not 50.
+        assert proxy.stats()["injected"] == 1 + client.max_retries
+
+    def test_latency_rule_delays(self, rig):
+        _, proxy, client, _ = rig
+        client.create("nodes", {"metadata": {"name": "n1"}})
+        proxy.add_rule(fault="latency", method="GET", delay_s=0.25)
+        t0 = time.monotonic()
+        client.get("nodes", "n1")
+        assert time.monotonic() - t0 >= 0.25
+
+    def test_retry_after_is_honored(self, rig):
+        _, proxy, client, _ = rig
+        client.create("nodes", {"metadata": {"name": "n1"}})
+        proxy.add_rule(fault="error", method="GET", status=429,
+                       retry_after=0.3, count=1)
+        t0 = time.monotonic()
+        assert client.get("nodes", "n1")["metadata"]["name"] == "n1"
+        assert time.monotonic() - t0 >= 0.3
+
+    def test_admin_endpoint_lifecycle(self, rig):
+        _, proxy, client, _ = rig
+        created = _admin(proxy, "POST", "/chaos/rules",
+                         {"fault": "error", "method": "GET",
+                          "path": "/pods", "status": 503, "count": 1})
+        rid = created["id"]
+        listed = _admin(proxy, "GET", "/chaos/rules")["rules"]
+        assert [r["id"] for r in listed] == [rid]
+        with pytest.raises(APIError) as ei:
+            client.max_retries = 0
+            client.get("pods", "default/p")
+        assert ei.value.status == 503
+        assert _admin(proxy, "DELETE", f"/chaos/rules/{rid}")["removed"] == 1
+        assert _admin(proxy, "GET", "/chaos/rules")["rules"] == []
+        _admin(proxy, "POST", "/chaos/rules", {"fault": "reset"})
+        assert _admin(proxy, "DELETE", "/chaos/rules")["removed"] == 1
+        stats = _admin(proxy, "GET", "/chaos/stats")
+        assert stats["requests"] >= 1
+
+    def test_bad_rule_rejected(self, rig):
+        _, proxy, _, _ = rig
+        req = urllib.request.Request(
+            proxy.base_url + "/chaos/rules",
+            data=json.dumps({"fault": "nonsense"}).encode(), method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+        with pytest.raises(ValueError):
+            Rule(fault="nonsense")
+
+    def test_watch_relay_and_cut_mid_event(self, rig):
+        store, proxy, client, upstream = rig
+        client.create("nodes", {"metadata": {"name": "n1"}})
+        # Healthy relay first.
+        w = client.watch("nodes", 0)
+        ev = w.next(timeout=3)
+        assert ev is not None and ev.type == "ADDED" and ev.key == "n1"
+        direct = APIClient(upstream, qps=0)
+        direct.create("nodes", {"metadata": {"name": "n2"}})
+        ev = w.next(timeout=3)
+        assert ev is not None and ev.key == "n2"
+        w.stop()
+        # Mid-event cut: one event passes, the second is half-delivered.
+        proxy.add_rule(fault="cut-stream", path=r"watch=1",
+                       after_events=1, count=1)
+        w = client.watch("nodes", 0)
+        types = []
+        for _ in range(4):
+            ev = w.next(timeout=2)
+            if ev is None:
+                break
+            types.append(ev.type)
+            if ev.type == "ERROR":
+                break
+        assert types == ["ADDED", "ERROR"]
+        w.stop()
+
+    def test_forced_410_gone_on_watch(self, rig):
+        from kubernetes_tpu.apiserver.memstore import TooOldError
+        _, proxy, client, _ = rig
+        client.create("nodes", {"metadata": {"name": "n1"}})
+        proxy.add_rule(fault="error", path=r"watch=1", status=410, count=1)
+        with pytest.raises(TooOldError):
+            client.watch("nodes", 0)
+        w = client.watch("nodes", 0)  # rule exhausted: healthy again
+        assert w.next(timeout=3).type == "ADDED"
+        w.stop()
+
+
+# -- circuit breaker --------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_and_half_open_recovers(self):
+        clock = [0.0]
+        transitions = []
+        b = CircuitBreaker(failure_threshold=3, reset_timeout=10.0,
+                           now=lambda: clock[0],
+                           on_transition=lambda o, n: transitions.append(
+                               (o, n)))
+        for _ in range(2):
+            assert b.allow()
+            b.record_failure()
+        assert b.state == CLOSED
+        b.record_failure()
+        assert b.state == OPEN
+        assert not b.allow()
+        clock[0] = 10.1
+        assert b.allow()           # the half-open trial
+        assert b.state == HALF_OPEN
+        assert not b.allow()       # concurrent caller refused mid-trial
+        b.record_success()
+        assert b.state == CLOSED
+        assert transitions == [(CLOSED, OPEN), (OPEN, HALF_OPEN),
+                               (HALF_OPEN, CLOSED)]
+
+    def test_half_open_failure_reopens(self):
+        clock = [0.0]
+        b = CircuitBreaker(failure_threshold=1, reset_timeout=5.0,
+                           now=lambda: clock[0])
+        b.record_failure()
+        assert b.state == OPEN
+        clock[0] = 5.1
+        assert b.allow()
+        b.record_failure()
+        assert b.state == OPEN
+        assert not b.allow()       # a fresh timeout window started
+        clock[0] = 10.3
+        assert b.allow()
+
+    def test_success_resets_consecutive_count(self):
+        b = CircuitBreaker(failure_threshold=2)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == CLOSED   # never two CONSECUTIVE failures
+
+
+# -- reflector backoff ------------------------------------------------------
+
+class _DeadSource:
+    def __init__(self):
+        self.lists = 0
+
+    def list(self, kind, selector, field_selector=""):
+        self.lists += 1
+        raise OSError("apiserver down")
+
+    def watch(self, kind, rv, field_selector=""):  # pragma: no cover
+        raise OSError("apiserver down")
+
+
+def test_reflector_backs_off_on_relist():
+    """A dead apiserver is probed with doubling backoff, not hammered."""
+    from kubernetes_tpu.client.reflector import Reflector
+    src = _DeadSource()
+    before = metrics.REFLECTOR_RELISTS.value
+    r = Reflector(src, "pods", lambda et, obj: None)
+    r.run()
+    time.sleep(0.7)
+    r.stop()
+    # Doubling from 0.2 s: ~3-5 attempts fit in 0.7 s; a tight loop
+    # would make hundreds.
+    assert 2 <= src.lists <= 8
+    assert metrics.REFLECTOR_RELISTS.value > before

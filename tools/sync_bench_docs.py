@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -25,12 +26,41 @@ BEGIN = "<!-- bench:begin -->"
 END = "<!-- bench:end -->"
 
 
+def _committed_bench_names() -> set[str] | None:
+    """BENCH artifacts tracked by git, or None when git is unavailable
+    (zero tracked artifacts returns an EMPTY set: the ratchet then
+    refuses uncommitted ones instead of silently falling back to them).
+
+    The docs ratchet compares against the newest COMMITTED artifact: a
+    BENCH_r{N}.json dropped into the worktree after the docs were last
+    synced (the bench driver writes one post-commit every round) must not
+    turn the suite red — the docs were correct at the snapshot they were
+    committed with ("green at snapshot")."""
+    try:
+        # ls-tree against HEAD, not ls-files: the index sees staged-but-
+        # uncommitted artifacts, which are exactly what the ratchet must
+        # ignore ("green at snapshot" = green against the last commit).
+        out = subprocess.run(
+            ["git", "-C", REPO, "ls-tree", "-r", "--name-only", "HEAD"],
+            capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if out.returncode != 0:
+        return None
+    return {n for n in out.stdout.splitlines()
+            if re.fullmatch(r"BENCH_r\d+\.json", n)}
+
+
 def latest_bench() -> tuple[str, dict]:
-    """(tag, parsed) for the highest-numbered BENCH_r*.json."""
+    """(tag, parsed) for the highest-numbered committed BENCH_r*.json
+    (falls back to all present artifacts outside a git checkout)."""
+    committed = _committed_bench_names()
     best_n, best = -1, None
     for name in os.listdir(REPO):
         m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
         if not m:
+            continue
+        if committed is not None and name not in committed:
             continue
         with open(os.path.join(REPO, name)) as f:
             data = json.load(f)
