@@ -162,6 +162,10 @@ def main() -> None:
         "elapsed_s_spread": {
             "min": round(min(r.elapsed_s for r in density_runs), 3),
             "max": round(max(r.elapsed_s for r in density_runs), 3)},
+        # Per-stage wall-time breakdown (best run): where the e2e time
+        # actually goes — queue_wait/snapshot/compile/transfer/solve/
+        # readback/assume/bind, from the stage histogram.
+        "stages": result.stages,
     }
     if joint is not None:
         out["joint"] = joint
@@ -183,6 +187,9 @@ def main() -> None:
             "warm_compile_s": round(wire.warm_s, 1),
             "runs": [round(v, 1) for v in vals],
             "median_pods_per_second": round(vals[len(vals) // 2], 1),
+            # The wire shape's own stage breakdown: diffed against the
+            # in-process one above, it says where the 5x wire gap lives.
+            "stages": wire.stages,
         }
     print(json.dumps(out))
 

@@ -30,6 +30,7 @@ import contextlib
 import json
 import socketserver
 import threading
+import time
 from urllib.parse import parse_qs, urlparse
 
 from kubernetes_tpu.api import fieldsel
@@ -39,6 +40,9 @@ from kubernetes_tpu.apiserver.memstore import (ConflictError, MemStore,
 from kubernetes_tpu.apiserver.validation import (AdmissionError,
                                                  admit_and_validate,
                                                  store_admission)
+from kubernetes_tpu.utils import trace as trace_mod
+from kubernetes_tpu.utils.metrics import (APISERVER_REQUEST_LATENCY,
+                                          expose_registry)
 
 # Idle watch streams carry a blank heartbeat chunk this often so clients'
 # read deadlines only fire on genuinely dead sockets.
@@ -46,6 +50,33 @@ WATCH_HEARTBEAT_PERIOD = 10.0
 
 
 _NULL_GATE = contextlib.nullcontext()
+
+
+def _resource_of(parts: list) -> str:
+    """The {kind} segment of an (already group-rebased) API path; top-level
+    paths (healthz, metrics) are their own nameable resources — the one
+    mapping both authorization and the request-latency metric use."""
+    if len(parts) >= 5 and parts[2] == "namespaces":
+        return parts[4]
+    if len(parts) >= 3 and parts[:2] == ["api", "v1"]:
+        return parts[2]
+    return parts[0] if parts else ""
+
+
+# Resource values admitted as a metric label: the known kind table plus
+# the cluster-scoped kinds and the mux's own top-level paths.  Everything
+# else (scanner probes, typos) collapses to "other" — label values are
+# memoized forever, so client-controlled strings must not mint series.
+_METRIC_RESOURCES = frozenset(_NAMESPACED) | frozenset({
+    "nodes", "namespaces", "persistentvolumes", "bindings", "watch",
+    "clusterroles", "clusterrolebindings", "healthz", "metrics", "debug"})
+_METRIC_VERBS = frozenset({"GET", "POST", "PUT", "DELETE", "HEAD",
+                           "PATCH", "WATCH"})
+
+
+def _metric_resource(parts: list) -> str:
+    resource = _resource_of(parts)
+    return resource if resource in _METRIC_RESOURCES else "other"
 
 
 def _rebase_group_path(parts: list) -> list:
@@ -141,6 +172,7 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                     return
                 clen = 0
                 authz = ""
+                traceparent = ""
                 chunked = False
                 while True:
                     h = self.rfile.readline(65536)
@@ -153,6 +185,11 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                             return
                     elif h[:18].lower() == b"transfer-encoding:":
                         chunked = True
+                    elif h[:12].lower() == b"traceparent:":
+                        # Trace propagation: the request span joins the
+                        # caller's trace (the scheduler's bind fan-out).
+                        traceparent = h[12:].strip().decode(
+                            errors="replace")
                     elif auth is not None and \
                             h[:14].lower() == b"authorization:":
                         authz = h[14:].strip().decode(errors="replace")
@@ -182,17 +219,11 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                              target_s.split("?", 1)[0].split("/") if p])
                         # Resource name for ABAC: the {kind} segment of
                         # API paths; top-level paths (healthz, metrics)
-                        # are their own nameable resources.
-                        ns = ""
-                        if len(parts) >= 5 and parts[2] == "namespaces":
-                            resource = parts[4]
-                            ns = parts[3]
-                        elif len(parts) >= 3 and parts[:2] == ["api", "v1"]:
-                            resource = parts[2]
-                        elif parts:
-                            resource = parts[0]
-                        else:
-                            resource = ""
+                        # are their own nameable resources — the same
+                        # mapping the request-latency metric labels use.
+                        resource = _resource_of(parts)
+                        ns = parts[3] if len(parts) >= 5 and \
+                            parts[2] == "namespaces" else ""
                         denied = auth.check(authz, method.decode(),
                                             resource, ns,
                                             peer_user=self._peer_user)
@@ -201,12 +232,13 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                             self._send_json(code, {"error": msg})
                             continue
                     if not self._dispatch(method.decode(), target.decode(),
-                                          raw):
+                                          raw, traceparent):
                         return  # watch served; connection consumed
                 except (BrokenPipeError, ConnectionResetError):
                     return
 
         def _send_json(self, code: int, obj) -> None:
+            self._code = code
             body = json.dumps(obj).encode()
             self.wfile.write(
                 _STATUS_LINES.get(code, _STATUS_LINES[400])
@@ -214,7 +246,17 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                 + str(len(body)).encode() + b"\r\n\r\n" + body)
             self.wfile.flush()
 
+        def _send_json_bytes(self, code: int, body: bytes) -> None:
+            """Pre-serialized JSON body (the trace export)."""
+            self._code = code
+            self.wfile.write(
+                _STATUS_LINES.get(code, _STATUS_LINES[400])
+                + b"Content-Type: application/json\r\nContent-Length: "
+                + str(len(body)).encode() + b"\r\n\r\n" + body)
+            self.wfile.flush()
+
         def _send_text(self, code: int, body: bytes) -> None:
+            self._code = code
             self.wfile.write(
                 _STATUS_LINES.get(code, _STATUS_LINES[400])
                 + b"Content-Type: text/plain\r\nContent-Length: "
@@ -237,13 +279,37 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                 return False
             return True
 
-        def _dispatch(self, method: str, target: str, raw: bytes) -> bool:
+        def _dispatch(self, method: str, target: str, raw: bytes,
+                      traceparent: str = "") -> bool:
             """Route one request.  Returns False when the connection was
-            taken over by a watch stream (caller must stop the loop)."""
+            taken over by a watch stream (caller must stop the loop).
+            Every handled request records its latency in the per-
+            verb/resource/code histogram and (when tracing is on) a
+            request span under the caller's propagated trace."""
             parsed = urlparse(target)
             parts = _rebase_group_path(
                 [p for p in parsed.path.split("/") if p])
             query = parse_qs(parsed.query)
+            is_watch = method == "GET" and \
+                query.get("watch", ["0"])[0] in ("1", "true")
+            t0 = time.perf_counter()
+            self._code = 200
+            try:
+                return self._dispatch_inner(method, parts, query, raw)
+            finally:
+                dur = time.perf_counter() - t0
+                verb = "WATCH" if is_watch else (
+                    method if method in _METRIC_VERBS else "other")
+                resource = _metric_resource(parts)
+                APISERVER_REQUEST_LATENCY.labels(
+                    verb=verb, resource=resource,
+                    code=str(self._code)).observe(dur * 1e6)
+                trace_mod.record_server_span(
+                    "apiserver.request", traceparent, dur,
+                    verb=verb, resource=resource, code=self._code)
+
+        def _dispatch_inner(self, method: str, parts: list, query,
+                            raw: bytes) -> bool:
             if method == "GET":
                 return self._do_get(parts, query)
             body_obj: dict = {}
@@ -273,6 +339,19 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
         def _do_get(self, parts, query) -> bool:
             if parts == ["healthz"]:
                 self._send_text(200, b"ok")
+                return True
+            if parts == ["metrics"]:
+                # Prometheus text exposition: the default registry carries
+                # the per-verb/resource/code request latencies this server
+                # records plus the shared client/breaker counters.
+                self._send_text(200, expose_registry().encode())
+                return True
+            if parts == ["debug", "traces"]:
+                # The span ring as Chrome trace-event JSON (Perfetto):
+                # request spans land here under the caller's trace id when
+                # a traceparent header was propagated.
+                self._send_json_bytes(200,
+                                      trace_mod.to_chrome_trace().encode())
                 return True
             if len(parts) == 3 and parts[:2] == ["api", "v1"]:
                 kind = parts[2]

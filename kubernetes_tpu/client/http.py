@@ -27,6 +27,7 @@ from kubernetes_tpu.api.types import NAMESPACED_KINDS
 from kubernetes_tpu.apiserver.memstore import (ConflictError, Event,
                                                TooOldError)
 from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import trace
 from kubernetes_tpu.utils.flowcontrol import TokenBucketRateLimiter
 
 DEFAULT_QPS = 5.0     # restclient/config.go:186 (perf rigs raise to 5000)
@@ -263,10 +264,11 @@ class APIClient:
         return True
 
     def _retry_sleep(self, attempt: int,
-                     retry_after: Optional[float] = None) -> None:
+                     retry_after: Optional[float] = None,
+                     verb: str = "GET") -> None:
         """Retry-After is honored exactly; otherwise jittered exponential
         backoff (full jitter: U(0.5, 1.5) x base x 2^attempt, capped)."""
-        metrics.CLIENT_RETRIES.inc()
+        metrics.CLIENT_RETRIES.labels(verb=verb).inc()
         if retry_after is not None:
             time.sleep(min(retry_after, RETRY_BACKOFF_CAP * 4))
             return
@@ -280,6 +282,12 @@ class APIClient:
         headers = {"Content-Type": "application/json"} if data else {}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
+        # Trace propagation: when this verb runs under an active span
+        # (the drain's bind fan-out), the server's request span joins the
+        # batch's trace.  One cheap call when tracing is off.
+        tp = trace.traceparent()
+        if tp:
+            headers["traceparent"] = tp
         idempotent = method in ("GET", "HEAD")
         attempt = 0
         while True:
@@ -291,14 +299,14 @@ class APIClient:
                 # retriable only for idempotent verbs, within budget.
                 if not idempotent or not self._retry_permitted(attempt):
                     raise
-                self._retry_sleep(attempt)
+                self._retry_sleep(attempt, verb=method)
                 attempt += 1
                 continue
             if status < 300:
                 return json.loads(body or b"{}")
             if idempotent and status in RETRIABLE_STATUS and \
                     self._retry_permitted(attempt):
-                self._retry_sleep(attempt, retry_after)
+                self._retry_sleep(attempt, retry_after, verb=method)
                 attempt += 1
                 continue
             text = body.decode(errors="replace")

@@ -100,7 +100,7 @@ class Reflector:
             first = True
             while not self._stop.is_set():
                 if not first:
-                    metrics.REFLECTOR_RELISTS.inc()
+                    metrics.REFLECTOR_RELISTS.labels(kind=self.kind).inc()
                 first = False
                 try:
                     rv = self._list()

@@ -48,6 +48,15 @@ from kubernetes_tpu.utils.logging import configure, get_logger
 log = get_logger("controller-manager")
 
 
+def status_mux(port: int = 10252):
+    """The controller-manager's status surface (the reference serves
+    healthz/metrics on 10252): default-registry metrics — every client
+    retry/relist counter the control loops feed — plus /debug/traces and
+    the /debug/pprof thread dump."""
+    from kubernetes_tpu.utils.debugmux import serve_status_mux
+    return serve_status_mux(port=port, name="controller-status-http")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="kube-controller-manager (kubernetes_tpu)", description=__doc__)
@@ -66,9 +75,19 @@ def main(argv=None) -> int:
     p.add_argument("--leader-elect-lease-duration", type=float, default=15.0)
     p.add_argument("--leader-elect-renew-deadline", type=float, default=10.0)
     p.add_argument("--leader-elect-retry-period", type=float, default=2.0)
+    p.add_argument("--port", type=int, default=10252,
+                   help="healthz/metrics/debug status port (the "
+                        "reference controller-manager's 10252; 0 = "
+                        "ephemeral, -1 = off)")
     p.add_argument("--v", type=int, default=None)
     opts = p.parse_args(argv)
     configure(v=opts.v)
+
+    mux = None
+    if opts.port >= 0:
+        mux = status_mux(opts.port)
+        log.info("status http on :%d (healthz, metrics, debug/traces)",
+                 mux.server_address[1])
 
     tok = opts.kube_api_token
     controllers: list = []
@@ -143,6 +162,8 @@ def main(argv=None) -> int:
         elector.stop()
     for c in controllers:
         c.stop()
+    if mux is not None:
+        mux.shutdown()
     return 0
 
 

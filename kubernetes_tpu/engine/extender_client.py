@@ -29,6 +29,7 @@ import weakref
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.policy import ExtenderConfig
 from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import trace
 from kubernetes_tpu.utils.circuitbreaker import OPEN, CircuitBreaker
 from kubernetes_tpu.utils.logging import get_logger
 
@@ -81,7 +82,7 @@ class HTTPExtender:
             on_transition=self._on_breaker_transition)
 
     def _on_breaker_transition(self, old: str, new: str) -> None:
-        metrics.EXTENDER_BREAKER_TRANSITIONS.inc()
+        metrics.EXTENDER_BREAKER_TRANSITIONS.labels(state=new).inc()
         # One line per state change (not per pod: the scheduler degrades
         # thousands of pods per open window — see generic_scheduler.py).
         log.warning("extender %s breaker %s -> %s",
@@ -94,9 +95,13 @@ class HTTPExtender:
     def _send(self, verb: str, args: dict):
         url = (f"{self.config.url_prefix.rstrip('/')}/"
                f"{self.config.api_version}/{verb}")
+        headers = {"Content-Type": "application/json"}
+        tp = trace.traceparent()
+        if tp:
+            headers["traceparent"] = tp
         req = urllib.request.Request(
             url, data=json.dumps(args).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
+            headers=headers, method="POST")
         with urllib.request.urlopen(
                 req, timeout=self.config.http_timeout_s) as resp:
             return json.loads(resp.read())
@@ -119,7 +124,7 @@ class HTTPExtender:
                 raise
             except TRANSPORT_ERRORS:
                 if attempt < EXTENDER_MAX_RETRIES:
-                    metrics.EXTENDER_RETRIES.inc()
+                    metrics.EXTENDER_RETRIES.labels(verb=verb).inc()
                     attempt += 1
                     time.sleep(EXTENDER_RETRY_SLEEP *
                                (0.5 + random.random()))

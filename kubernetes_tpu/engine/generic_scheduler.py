@@ -34,7 +34,7 @@ from kubernetes_tpu.features import batch as fb
 from kubernetes_tpu.features import padcap
 from kubernetes_tpu.features.volumes import compile_volsvc
 from kubernetes_tpu.utils.logging import get_logger
-from kubernetes_tpu.utils.trace import Trace
+from kubernetes_tpu.utils.trace import Trace, stage
 
 log = get_logger("engine")
 
@@ -162,33 +162,41 @@ class GenericScheduler:
         # volume/affinity pod lists, feature compilation, and the device
         # transfer itself — must see one consistent generation.
         with self.cache.lock:
-            nt, agg, ep, nodes = self.cache.snapshot()
-            # Tag for the device-aggregate handoff: the snapshot the solve
-            # starts from (assume_pods validates nothing changed since).
-            self._snapshot_generation = self.cache.generation
-            volsvc = compile_volsvc(
-                pods, nodes, nt.schedulable,
-                volume_pods=self.cache.volume_pods(), listers=self.listers,
-                service_affinity_labels=service_affinity_labels(self.policy),
-                service_anti_affinity_labels=service_anti_affinity_labels(
-                    self.policy),
-                node_label_args=node_label_args(self.policy),
-                node_label_prio_args=node_label_prio_args(self.policy),
-                service_peers=self.cache.service_peer_nodes,
-                first_peer=self.cache.first_peer_node)
-            batch = fb.compile_batch(
-                pods, nt, self.cache.space, ep=ep, nodes=nodes,
-                spread_selectors=self.listers.spread_selectors,
-                controller_refs=self.listers.controller_refs,
-                affinity_pods=self.cache.affinity_pods(),
-                hard_pod_affinity_weight=(
-                    self.policy.hard_pod_affinity_symmetric_weight),
-                volsvc=volsvc)
-            batch = padcap.apply_caps(batch, self._axis_caps)
-            # device=False keeps the batch pytree on host (the chunked
-            # drain slices it in numpy and transfers fixed-shape chunks).
-            db = sv.device_batch(batch) if device else sv.host_batch(batch)
-            dc = sv.device_cluster(nt, agg, self.cache.space)
+            with stage("snapshot", pods=len(pods)):
+                nt, agg, ep, nodes = self.cache.snapshot()
+                # Tag for the device-aggregate handoff: the snapshot the
+                # solve starts from (assume_pods validates nothing changed
+                # since).
+                self._snapshot_generation = self.cache.generation
+            with stage("compile", pods=len(pods)):
+                volsvc = compile_volsvc(
+                    pods, nodes, nt.schedulable,
+                    volume_pods=self.cache.volume_pods(),
+                    listers=self.listers,
+                    service_affinity_labels=service_affinity_labels(
+                        self.policy),
+                    service_anti_affinity_labels=(
+                        service_anti_affinity_labels(self.policy)),
+                    node_label_args=node_label_args(self.policy),
+                    node_label_prio_args=node_label_prio_args(self.policy),
+                    service_peers=self.cache.service_peer_nodes,
+                    first_peer=self.cache.first_peer_node)
+                batch = fb.compile_batch(
+                    pods, nt, self.cache.space, ep=ep, nodes=nodes,
+                    spread_selectors=self.listers.spread_selectors,
+                    controller_refs=self.listers.controller_refs,
+                    affinity_pods=self.cache.affinity_pods(),
+                    hard_pod_affinity_weight=(
+                        self.policy.hard_pod_affinity_symmetric_weight),
+                    volsvc=volsvc)
+                batch = padcap.apply_caps(batch, self._axis_caps)
+            with stage("transfer", device=device):
+                # device=False keeps the batch pytree on host (the chunked
+                # drain slices it in numpy and transfers fixed-shape
+                # chunks).
+                db = sv.device_batch(batch) if device \
+                    else sv.host_batch(batch)
+                dc = sv.device_cluster(nt, agg, self.cache.space)
         return batch, db, dc, nt
 
     # -- single-pod path (Schedule, generic_scheduler.go:78) -------------
@@ -245,7 +253,8 @@ class GenericScheduler:
                 # semantics, api/types.go:128-130.)
                 if not degraded:
                     degraded = True
-                    metrics.EXTENDER_DEGRADED_DECISIONS.inc()
+                    metrics.EXTENDER_DEGRADED_DECISIONS.labels(
+                        extender=ext.config.url_prefix).inc()
                     # debug, not warning: thousands of pods degrade per
                     # 15 s open window — the breaker transition itself is
                     # logged once (extender_client) and counted above.
@@ -303,9 +312,12 @@ class GenericScheduler:
         self._agg_handoff = None
         from kubernetes_tpu.utils.profiling import device_trace
         if joint:
-            with device_trace("solve_joint"):
+            with device_trace("solve_joint"), \
+                    stage("solve", pods=len(pods), mode="joint"):
                 choices, new_last, _ = self.solver.solve_joint(
                     db, dc, jnp.uint32(self.last_node_index), flags=flags)
+                choices.block_until_ready()
+            with stage("readback", pods=len(pods)):
                 rows = np.asarray(choices).tolist()
             self.last_node_index = np.uint32(new_last)
         else:
@@ -313,9 +325,15 @@ class GenericScheduler:
             # is a full RTT on a tunneled chip): choices + tie counter +
             # final aggregates.
             p, n = len(pods), dc.alloc.shape[0]
-            with device_trace("solve_sequential"):
-                host = np.asarray(self.solver.solve_sequential_packed(
-                    db, dc, jnp.uint32(self.last_node_index), flags))
+            with device_trace("solve_sequential"), \
+                    stage("solve", pods=p, mode="sequential"):
+                host_dev = self.solver.solve_sequential_packed(
+                    db, dc, jnp.uint32(self.last_node_index), flags)
+                # Block here so the solve stage measures device compute
+                # and readback measures only the D2H copy.
+                host_dev.block_until_ready()
+            with stage("readback", pods=p):
+                host = np.asarray(host_dev)
             rows = host[:p].tolist()
             self.last_node_index = np.uint32(host[p])
             # Device-aggregate handoff: the scan's final requested/nonzero
@@ -344,6 +362,64 @@ class GenericScheduler:
         h = getattr(self, "_agg_handoff", None)
         self._agg_handoff = None
         return h
+
+    # Cap on pods explained per call: one small compile + two device
+    # evaluations cover the whole explained set, but the host-side mask
+    # walk is O(pods x nodes x predicates).
+    EXPLAIN_CAP = 64
+
+    def explain_failures(self, pods: list[api.Pod]) -> dict:
+        """Per-predicate failure counts (and top-scoring nodes) for pods
+        that failed to place — the flight recorder's detail pass.  Runs
+        against the CURRENT cache snapshot, so a pod that only failed
+        because of in-batch contention may show zero failing predicates;
+        the counts answer "why does this pod not fit the cluster", the
+        reference ``FitError.failed_predicates`` aggregation.
+
+        Cost is one ``_compile`` + ``masks`` + ``evaluate`` over at most
+        ``EXPLAIN_CAP`` pods, paid only when a drain actually failed pods
+        (a fully-placed drain never calls this).  The batch is padded to
+        EXPLAIN_CAP with inert pods so every call hits ONE compiled
+        shape — unpadded, each distinct failed-pod count would mint its
+        own multi-second XLA compile in the drain path."""
+        pods = pods[:self.EXPLAIN_CAP]
+        if not pods:
+            return {}
+        nodes = self.cache.nodes()
+        if not nodes:
+            return {pod.key: {"message": "no nodes in cluster",
+                              "failed_predicates": {}}
+                    for pod in pods}
+        padded = list(pods) + [
+            api.Pod(name=f"__explain-pad-{i}", namespace="__pad__")
+            for i in range(self.EXPLAIN_CAP - len(pods))]
+        batch, db, dc, nt = self._compile(padded)
+        masks = {name: np.asarray(m) for name, m in
+                 self.solver.masks(db, dc).items()}
+        _, scores = self.solver.evaluate(db, dc, sv.batch_flags(batch))
+        scores = np.asarray(scores)
+        sched = np.asarray(nt.schedulable, dtype=bool)
+        n_sched = int(sched.sum())
+        out: dict = {}
+        for i, pod in enumerate(pods):
+            counts = {}
+            for name, m in masks.items():
+                failing = int(np.count_nonzero(sched & ~m[i]))
+                if failing:
+                    counts[name] = failing
+            top_idx = np.argsort(-scores[i])[:5]
+            out[pod.key] = {
+                "message": f"pod ({pod.name}) failed to fit in any node"
+                if counts else
+                f"pod ({pod.name}) fit no node in this batch (in-batch "
+                f"contention; predicates pass against the current "
+                f"snapshot)",
+                "nodes_considered": n_sched,
+                "failed_predicates": counts,
+                "top_scores": [{"node": nt.names[int(j)],
+                                "score": float(scores[i][int(j)])}
+                               for j in top_idx]}
+        return out
 
     def schedule_batch_stream(self, pods: list[api.Pod],
                               chunk_size: int = 2048):
@@ -400,7 +476,8 @@ class GenericScheduler:
         pending: list[tuple[int, jnp.ndarray]] = []
 
         def emit(start: int, choices) -> tuple[list, list]:
-            rows = np.asarray(choices)  # blocks only on this chunk
+            with stage("readback", chunk_at=start):
+                rows = np.asarray(choices)  # blocks only on this chunk
             stop = min(start + chunk_size, p)
             chunk_pods = pods[start:stop]
             placements = [nt.names[int(c)] if c >= 0 else None
@@ -414,10 +491,15 @@ class GenericScheduler:
             # Host-slice (free numpy views), then one batched device_put of
             # the fixed [chunk_size, ...] shapes: slicing ON DEVICE minted
             # a dynamic_slice program per distinct drain length.
-            db_k = jax.device_put(
-                sv.slice_pod_axis(hb, start, start + chunk_size))
-            live = jnp.asarray(live_np[start:start + chunk_size])
-            with device_trace("solve_stream_chunk"):
+            with stage("transfer", chunk_at=start):
+                db_k = jax.device_put(
+                    sv.slice_pod_axis(hb, start, start + chunk_size))
+                live = jnp.asarray(live_np[start:start + chunk_size])
+            # The launch is async: device time surfaces in the next
+            # chunk's readback, which is what keeps the pipeline
+            # overlapped — this stage measures dispatch only.
+            with device_trace("solve_stream_chunk"), \
+                    stage("solve", chunk_at=start, mode="stream"):
                 choices_k, counter, carry = self.solver._solve_scan(
                     db_k, dc, counter, None, flags, carry, live)
             if debug_t:

@@ -13,6 +13,10 @@ scheduler-relevant resources:
                                           spec.unschedulable toggles, the
                                           scheduler's ready filter honors it)
     ... get events [-n ns]
+    ... explain pod NAME [--scheduler http://...]
+                                         (the scheduler's decision flight
+                                          recorder: chosen node, or
+                                          per-predicate failure counts)
 
 Resource aliases match kubectl's (po/pods, no/nodes, svc/services, ev/events,
 pv, pvc, rc, rs).  Printers are the reference's table style: NAME, then
@@ -717,6 +721,58 @@ def cmd_drain(client: APIClient, opts, out) -> int:
     return 0
 
 
+def cmd_explain(opts, out) -> int:
+    """``explain pod NAME``: query the scheduler daemon's decision flight
+    recorder (/debug/scheduler/decisions) for the pod's latest recorded
+    decision — chosen node, or per-predicate failure counts and the
+    top-scoring candidate nodes for an unschedulable pod."""
+    import urllib.error
+    import urllib.request
+    key = opts.name if "/" in opts.name else \
+        f"{opts.namespace}/{opts.name}"
+    url = (opts.scheduler.rstrip("/") +
+           "/debug/scheduler/decisions?pod=" + key)
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            decision = json.loads(r.read())
+    except urllib.error.HTTPError as err:
+        if err.code == 404:
+            print(f'error: no recorded scheduling decision for pod '
+                  f'"{key}" (aged out of the flight recorder, or never '
+                  f'seen by this scheduler)', file=sys.stderr)
+            return 1
+        raise
+    except urllib.error.URLError as err:
+        print(f"error: cannot reach the scheduler at {opts.scheduler} "
+              f"({err.reason}); point --scheduler at the daemon's "
+              f"status port", file=sys.stderr)
+        return 1
+    if opts.output == "json":
+        print(json.dumps(decision, indent=2), file=out)
+        return 0
+    print(f"Pod:\t{decision.get('pod')}", file=out)
+    print(f"Result:\t{decision.get('result')}", file=out)
+    if decision.get("node"):
+        print(f"Node:\t{decision['node']}", file=out)
+    if decision.get("message"):
+        print(f"Message:\t{decision['message']}", file=out)
+    preds = decision.get("failed_predicates") or {}
+    if preds:
+        print("Failed predicates (nodes failing):", file=out)
+        for name, count in sorted(preds.items(),
+                                  key=lambda kv: -kv[1]):
+            print(f"  {name}\t{count}", file=out)
+    tops = decision.get("top_scores") or []
+    if tops:
+        print("Top-scoring nodes:", file=out)
+        for t in tops:
+            print(f"  {t.get('node')}\t{t.get('score'):g}", file=out)
+    if decision.get("trace_id"):
+        print(f"Trace:\t{decision['trace_id']} "
+              f"(see /debug/traces on the scheduler)", file=out)
+    return 0
+
+
 def main(argv=None, out=sys.stdout) -> int:
     p = argparse.ArgumentParser(prog="kubectl (kubernetes_tpu)",
                                 description=__doc__)
@@ -739,6 +795,19 @@ def main(argv=None, out=sys.stdout) -> int:
     d.add_argument("resource")
     d.add_argument("name")
     d.add_argument("-n", "--namespace", default="default")
+
+    ep = sub.add_parser(
+        "explain",
+        help="why was this pod (not) scheduled — asks the scheduler's "
+             "decision flight recorder")
+    ep.add_argument("resource", help='only "pod" is explainable')
+    ep.add_argument("name", help="pod name or ns/name")
+    ep.add_argument("-n", "--namespace", default="default")
+    ep.add_argument("-o", "--output", default="", choices=["", "json"])
+    ep.add_argument("--scheduler", default="http://127.0.0.1:10251",
+                    help="scheduler daemon status URL (the flight "
+                         "recorder lives on the scheduler, not the "
+                         "apiserver)")
 
     c = sub.add_parser("create")
     c.add_argument("-f", "--filename", required=True)
@@ -807,6 +876,12 @@ def main(argv=None, out=sys.stdout) -> int:
         return cmd_get(client, opts, out)
     if opts.cmd == "describe":
         return cmd_describe(client, opts, out)
+    if opts.cmd == "explain":
+        if _kind(opts.resource) != "pods":
+            print("error: only pods have recorded scheduling decisions",
+                  file=sys.stderr)
+            return 1
+        return cmd_explain(opts, out)
     if opts.cmd == "create":
         return cmd_create(client, opts, out)
     if opts.cmd == "apply":

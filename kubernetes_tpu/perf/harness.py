@@ -32,6 +32,31 @@ class DensityResult:
     scheduled: int
     pods_per_second: float
     algorithm_ms_per_pod: float
+    # Per-stage wall-time breakdown of the timed window (seconds +
+    # observation counts), harvested from the stage histogram.
+    stages: dict = None
+
+
+def _stage_snapshot() -> dict:
+    """Current per-stage (sum_us, count) from the labeled stage
+    histogram (kubernetes_tpu.utils.metrics.STAGE_LATENCY)."""
+    from kubernetes_tpu.utils.metrics import STAGE_LATENCY
+    return {key[0]: (child.sum, child.count)
+            for key, child in STAGE_LATENCY.children().items()}
+
+
+def stage_breakdown(before: dict, after: dict) -> dict:
+    """Per-stage wall time accumulated between two snapshots:
+    {stage: {"seconds": s, "count": n}} — the answer to *where* a run's
+    time went (and, diffed between the density and wire shapes, where the
+    wire path loses its gap)."""
+    out = {}
+    for name, (s1, n1) in sorted(after.items()):
+        s0, n0 = before.get(name, (0.0, 0))
+        if n1 > n0:
+            out[name] = {"seconds": round((s1 - s0) / 1e6, 6),
+                         "count": n1 - n0}
+    return out
 
 
 def _make_daemon(num_nodes: int, profile: str = "uniform",
@@ -65,10 +90,12 @@ def density(num_nodes: int, num_pods: int, profile: str = "uniform",
             alg.schedule_batch(pods)
     for pod in pods:
         daemon.enqueue(pod)
+    stages_before = _stage_snapshot()
     start = time.perf_counter()
     popped = daemon.schedule_pending(wait_first=False)
     daemon.wait_for_binds()
     elapsed = time.perf_counter() - start
+    stages = stage_breakdown(stages_before, _stage_snapshot())
     scheduled = daemon.config.binder.count()
     if not quiet:
         print(f"density {num_nodes} nodes x {num_pods} pods: "
@@ -78,7 +105,8 @@ def density(num_nodes: int, num_pods: int, profile: str = "uniform",
     return DensityResult(
         num_nodes=num_nodes, num_pods=num_pods, elapsed_s=elapsed,
         scheduled=scheduled, pods_per_second=scheduled / elapsed,
-        algorithm_ms_per_pod=elapsed / max(scheduled, 1) * 1e3)
+        algorithm_ms_per_pod=elapsed / max(scheduled, 1) * 1e3,
+        stages=stages)
 
 
 @dataclass
@@ -93,6 +121,9 @@ class WireDensityResult:
     # (elapsed_s, bound_count) samples every poll tick — the bind-progress
     # timeline, for diagnosing where a wire run's time goes.
     timeline: list = None
+    # Per-stage wall-time breakdown (daemon-side stages of the timed
+    # window; apiserver-side time shows up as bind wall time).
+    stages: dict = None
 
 
 def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
@@ -235,6 +266,7 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         expected = [len(pod_jsons[i:i + 1000])
                     for i in range(0, len(pod_jsons), 1000)]
 
+        stages_before = _stage_snapshot()
         start = time.perf_counter()
         # Each creator thread POSTs batch Lists of ~1000 pods — the
         # makePodsFromRC 30-way-parallel shape (util.go:85-170) with the
@@ -311,7 +343,8 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
             num_nodes=num_nodes, num_pods=num_pods, elapsed_s=elapsed,
             scheduled=int(bound),
             pods_per_second=int(bound) / max(elapsed, 1e-9),
-            create_s=create_s, warm_s=warm_s, timeline=timeline)
+            create_s=create_s, warm_s=warm_s, timeline=timeline,
+            stages=stage_breakdown(stages_before, _stage_snapshot()))
     finally:
         # Stop the daemon's reflector/scheduler threads on EVERY exit path
         # (left running they'd relist-spin against the dead apiserver).

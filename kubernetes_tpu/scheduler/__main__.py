@@ -131,6 +131,33 @@ def load_policy(opts):
     return policy
 
 
+def _decisions_route(daemon, query: str) -> tuple[int, bytes, str]:
+    """/debug/scheduler/decisions: the flight recorder's batch ring;
+    ``?pod=ns/name`` explains one pod's latest decision (chosen node, or
+    per-predicate failure counts and top-scoring candidates)."""
+    from urllib.parse import parse_qs
+    recorder = daemon.config.flight_recorder
+    if recorder is None:
+        return 404, b"flight recorder disabled", "text/plain"
+    q = parse_qs(query)
+    pod = q.get("pod", [""])[0]
+    if pod:
+        decision = recorder.explain(pod)
+        if decision is None:
+            return (404,
+                    json.dumps({"pod": pod,
+                                "error": "no recorded decision"}).encode(),
+                    "application/json")
+        return 200, json.dumps(decision).encode(), "application/json"
+    try:
+        limit = int(q.get("limit", ["0"])[0] or "0")
+    except ValueError:
+        return (400, b'{"error": "limit must be an integer"}',
+                "application/json")
+    return (200, json.dumps(recorder.snapshot(limit=limit)).encode(),
+            "application/json")
+
+
 def _status_mux(factory: ConfigFactory, configz: dict, port: int
                 ) -> ThreadingHTTPServer:
     """The daemon's own HTTP surface (server.go:93-109)."""
@@ -150,15 +177,16 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/healthz":
+            path, _, query = self.path.partition("?")
+            if path == "/healthz":
                 self._send(200, b"ok")
-            elif self.path == "/metrics":
+            elif path == "/metrics":
                 self._send(200,
                            factory.daemon.config.metrics.expose().encode())
-            elif self.path == "/configz":
+            elif path == "/configz":
                 self._send(200, json.dumps(configz).encode(),
                            "application/json")
-            elif self.path.startswith("/debug/pprof"):
+            elif path.startswith("/debug/pprof"):
                 # The goroutine-dump analogue (app/server.go:96-100): all
                 # live thread stacks.  EnableProfiling=false removes the
                 # handlers, as the reference's mux does (server.go:96).
@@ -167,7 +195,17 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
                     return
                 from kubernetes_tpu.utils.profiling import thread_stacks
                 self._send(200, thread_stacks().encode())
-            elif self.path == "/debug/vars":
+            elif path == "/debug/traces":
+                # The span ring as Chrome trace-event JSON: load in
+                # Perfetto and the queue_wait -> snapshot -> compile ->
+                # transfer -> solve -> readback -> assume -> bind pipeline
+                # is visible per batch.
+                from kubernetes_tpu.utils import trace
+                self._send(200, trace.to_chrome_trace().encode(),
+                           "application/json")
+            elif path == "/debug/scheduler/decisions":
+                self._send(*_decisions_route(factory.daemon, query))
+            elif path == "/debug/vars":
                 cache = factory.algorithm.cache
                 self._send(200, json.dumps({
                     "queueDepth": len(factory.daemon.queue),

@@ -25,20 +25,25 @@ from kubernetes_tpu.engine.extender_client import ExtenderError
 from kubernetes_tpu.engine.generic_scheduler import FitError, GenericScheduler
 from kubernetes_tpu.scheduler.backoff import PodBackoff
 from kubernetes_tpu.scheduler.binder import Binder, BindConflict, InMemoryBinder
+from kubernetes_tpu.scheduler.flightrecorder import FlightRecorder
 from kubernetes_tpu.scheduler.queue import FIFO
 from kubernetes_tpu.utils import metrics as metrics_mod
+from kubernetes_tpu.utils import trace as trace_mod
 from kubernetes_tpu.utils.events import EventRecorder
 from kubernetes_tpu.utils.logging import get_logger
 from kubernetes_tpu.utils.metrics import SchedulerMetrics
+from kubernetes_tpu.utils.trace import Trace, stage
 
 
-def _record_bind_failure(err) -> None:
+def _record_bind_failure(err) -> str:
     """409/CAS conflicts and transport faults are different operator
-    stories: count them apart (both forget + requeue with backoff)."""
+    stories: count them apart (both forget + requeue with backoff).
+    Returns the attempts-counter result label for the failure class."""
     if isinstance(err, (BindConflict, ConflictError)):
         metrics_mod.BIND_CONFLICTS.inc()
-    else:
-        metrics_mod.BIND_FAILURES.inc()
+        return "bind_conflict"
+    metrics_mod.BIND_FAILURES.inc()
+    return "bind_error"
 
 log = get_logger("daemon")
 
@@ -58,6 +63,10 @@ class SchedulerConfig:
     # (pod, reason, message) when scheduling fails.
     condition_updater: Optional[Callable[[api.Pod, str, str], None]] = None
     async_bind: bool = True
+    # Decision flight recorder (/debug/scheduler/decisions); None disables
+    # recording entirely (and the failure-detail device pass with it).
+    flight_recorder: Optional[FlightRecorder] = \
+        field(default_factory=FlightRecorder)
 
 
 class Scheduler:
@@ -65,6 +74,13 @@ class Scheduler:
         self.config = config
         self.queue = FIFO()
         self.backoff = PodBackoff()
+        # Live queue depth at expose time (a set-per-mutation gauge would
+        # put two lock acquisitions on every enqueue).
+        config.metrics.queue_depth.set_fn(lambda: len(self.queue))
+        # Failure-detail cooldown: an unschedulable pod requeues every
+        # backoff period and must not re-pay the explain device pass each
+        # round.
+        self._explain_ts: dict[str, float] = {}
         self._stop = threading.Event()
         self._bind_threads: list[threading.Thread] = []
         # Single requeue worker over a timer heap (a thread per failed pod
@@ -92,15 +108,31 @@ class Scheduler:
         if pod is None:
             return False
         start = time.perf_counter()
+        root = trace_mod.begin_span("schedule_one", pod=pod.key)
         try:
-            dest = self.config.algorithm.schedule(pod)
-        except (FitError, ExtenderError) as err:
-            self._handle_failure(pod, "FailedScheduling", str(err))
+            try:
+                dest = self.config.algorithm.schedule(pod)
+            except (FitError, ExtenderError) as err:
+                # Per-predicate failure counts straight off the FitError
+                # (failed_predicates: node -> [names]) for the recorder.
+                counts: dict[str, int] = {}
+                for preds in getattr(err, "failed_predicates",
+                                     {}).values():
+                    for name in preds:
+                        counts[name] = counts.get(name, 0) + 1
+                self._handle_failure(pod, "FailedScheduling", str(err),
+                                     failed_predicates=counts or None)
+                return True
+            algo_us = (time.perf_counter() - start) * 1e6
+            self.config.metrics.scheduling_algorithm_latency.observe(algo_us)
+            if self.config.flight_recorder is not None:
+                self.config.flight_recorder.record_batch(
+                    [pod], [dest], trace_id=root.trace_id,
+                    duration_s=algo_us / 1e6)
+            self._assume_and_bind(pod, dest, start)
             return True
-        algo_us = (time.perf_counter() - start) * 1e6
-        self.config.metrics.scheduling_algorithm_latency.observe(algo_us)
-        self._assume_and_bind(pod, dest, start)
-        return True
+        finally:
+            root.end()
 
     # -- batched path (the TPU drain) ------------------------------------
 
@@ -120,6 +152,16 @@ class Scheduler:
     # instead of one per queue length.
     _PAD_LIMIT = 4096
 
+    # Floor on the small-drain bucket: pad rows are numerically inert, so
+    # padding a 3-pod drain to 256 costs dead scan rows (microseconds),
+    # while every distinct bucket below the floor costs an XLA compile
+    # (seconds).  Measured on the 500-node kubemark rig: the arrival race
+    # produces drains of 1..700 pods, and the 1,2,4,...,128 ladder minted
+    # ~8 scan compiles (~4-8 s each on a small host) before the fleet
+    # settled; with the floor the ladder is {256, 512, 1024, 2048}.
+    STREAM_MIN_BUCKET = int(os.environ.get("KT_STREAM_MIN_BUCKET",
+                                           "256") or "256")
+
     # Arrival-coalescing window (seconds): when a drain pops fewer pods
     # than one stream chunk while more are clearly arriving, linger up to
     # this long topping the batch up.  A trickle-fed drain otherwise pays
@@ -132,6 +174,7 @@ class Scheduler:
                          timeout: Optional[float] = None) -> int:
         """Drain the queue and solve it as one device batch.  Returns the
         number of pods popped (scheduled or failed)."""
+        t_wait = time.perf_counter()
         pods = self.queue.pop_all(wait_first=wait_first, timeout=timeout)
         if not pods:
             return 0
@@ -145,8 +188,19 @@ class Scheduler:
                 more = self.queue.pop_all(wait_first=False)
                 idle_polls = 0 if more else idle_polls + 1
                 pods.extend(more)
+        # The batch root span is backdated to cover the wait: queue_wait
+        # (blocking pop + arrival coalescing) is the pipeline's first
+        # stage, even though the batch only existed at its end.
+        root = trace_mod.begin_span("schedule_batch", start=t_wait,
+                                    pods=len(pods))
+        trace_mod.record_stage("queue_wait", start=t_wait,
+                               pods=len(pods))
+        self.config.metrics.batch_size.set(len(pods))
+        tr = Trace(f"Scheduling batch of {len(pods)} pods")
+        tr.start = t_wait
+        tr.step("Queue drained")
         try:
-            return self._solve_drain(pods)
+            return self._solve_drain(pods, tr=tr, trace_id=root.trace_id)
         except Exception:  # noqa: BLE001 — HandleCrash analogue
             # The pods were already popped: requeue each through the
             # backoff path (condition + event + delayed retry) so a
@@ -160,10 +214,18 @@ class Scheduler:
                 # confirmed bound by the watch) made it through.
                 if not cache.contains(pod.key):
                     self._handle_failure(pod, "SchedulingError",
-                                         "internal error during scheduling")
+                                         "internal error during scheduling",
+                                         result="error")
             return len(pods)
+        finally:
+            root.end()
+            # The reference's 20 ms slow-log (generic_scheduler.go:79-85),
+            # now fed by the batched drain too; a slow batch also records
+            # as a span with the step breakdown.
+            tr.log_if_long()
 
-    def _solve_drain(self, pods: list) -> int:
+    def _solve_drain(self, pods: list, tr: Optional[Trace] = None,
+                     trace_id: str = "") -> int:
         from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATE
         joint = DEFAULT_FEATURE_GATE.enabled("JointSolver")
         # The joint solve needs the whole queue at once (prices couple
@@ -172,16 +234,21 @@ class Scheduler:
             and not joint
         if streaming and len(pods) >= self.STREAM_THRESHOLD and \
                 not self.config.algorithm.extenders:
-            return self._schedule_pending_stream(pods)
+            return self._schedule_pending_stream(pods, trace_id=trace_id)
         if streaming and len(pods) < self._PAD_LIMIT and \
                 not self.config.algorithm.extenders:
             # Small drain: one power-of-two stream chunk (live-flag
             # padded), so arrival races don't mint a new compiled shape
-            # per queue length.
-            bucket = 1 << (len(pods) - 1).bit_length()
-            return self._schedule_pending_stream(pods, chunk_size=bucket)
+            # per queue length; floored so the tail of the ladder doesn't
+            # either.
+            bucket = max(1 << (len(pods) - 1).bit_length(),
+                         self.STREAM_MIN_BUCKET)
+            return self._schedule_pending_stream(pods, chunk_size=bucket,
+                                                 trace_id=trace_id)
         start = time.perf_counter()
         placements = self.config.algorithm.schedule_batch(pods, joint=joint)
+        if tr is not None:
+            tr.step("Computed placements")
         algo_us = (time.perf_counter() - start) * 1e6 / len(pods)
         self.config.metrics.scheduling_algorithm_latency.observe_many(
             algo_us, len(pods))
@@ -189,8 +256,45 @@ class Scheduler:
             placed_n = sum(1 for d in placements if d is not None)
             log.debug("drained %d pods: %d placed, %.0f us/pod algorithm",
                       len(pods), placed_n, algo_us)
+        self._record_batch_decisions(pods, placements, trace_id,
+                                     time.perf_counter() - start)
         self._assume_and_bind_batch(pods, placements, start)
+        if tr is not None:
+            tr.step("Assumed and dispatched binds")
         return len(pods)
+
+    def _record_batch_decisions(self, pods: list, placements: list,
+                                trace_id: str, duration_s: float) -> None:
+        """Feed the flight recorder: the placement map always, plus the
+        engine's per-predicate failure detail for failed pods not
+        explained within the last 30 s (the explain pass costs a small
+        device evaluation, paid only when a drain actually failed pods)."""
+        recorder = self.config.flight_recorder
+        if recorder is None:
+            return
+        detail = None
+        failed = [pod for pod, dest in zip(pods, placements)
+                  if dest is None]
+        if failed:
+            now = time.monotonic()
+            fresh = [p for p in failed
+                     if now - self._explain_ts.get(p.key, -1e9) > 30.0]
+            if fresh:
+                try:
+                    detail = self.config.algorithm.explain_failures(fresh)
+                except Exception:  # noqa: BLE001 — explain is best-effort
+                    log.exception("failure-detail pass crashed; recording "
+                                  "decisions without predicate counts")
+                for p in fresh:
+                    self._explain_ts[p.key] = now
+                if len(self._explain_ts) > 4096:
+                    cutoff = now - 30.0
+                    self._explain_ts = {
+                        k: t for k, t in self._explain_ts.items()
+                        if t > cutoff}
+        recorder.record_batch(pods, placements, trace_id=trace_id,
+                              duration_s=duration_s,
+                              failure_detail=detail)
 
     def _assume_and_bind_batch(self, pods: list[api.Pod],
                                placements: list, start: float) -> None:
@@ -199,9 +303,10 @@ class Scheduler:
         log-and-proceed on assume errors (scheduler.go:116-120)."""
         placed = [(pod, dest) for pod, dest in zip(pods, placements)
                   if dest is not None]
-        skipped = set(self.config.algorithm.cache.assume_pods(
-            placed, strict=False,
-            agg_handoff=self.config.algorithm.take_agg_handoff()))
+        with stage("assume", pods=len(placed)):
+            skipped = set(self.config.algorithm.cache.assume_pods(
+                placed, strict=False,
+                agg_handoff=self.config.algorithm.take_agg_handoff()))
         if skipped:
             placed = [(pod, dest) for pod, dest in placed
                       if pod.key not in skipped]
@@ -212,7 +317,9 @@ class Scheduler:
                     f"pod ({pod.name}) failed to fit in any node")
         if self.config.async_bind:
             t = threading.Thread(target=self._bind_assumed_batch,
-                                 args=(placed, start), daemon=True)
+                                 args=(placed, start,
+                                       trace_mod.current_context()),
+                                 daemon=True)
             t.start()
             # Prune finished binders on append: a long-running daemon
             # drains every ~50 ms and must not accumulate dead Thread
@@ -232,7 +339,8 @@ class Scheduler:
         return self.stream_chunk or min(self.STREAM_THRESHOLD, 8192)
 
     def _schedule_pending_stream(self, pods: list[api.Pod],
-                                 chunk_size: Optional[int] = None) -> int:
+                                 chunk_size: Optional[int] = None,
+                                 trace_id: str = "") -> int:
         """The pipelined drain: as each device chunk lands, bulk-assume it
         and hand it to an async binder thread while the device scans the
         next chunk.  Same observable state machine as the one-shot path."""
@@ -242,6 +350,8 @@ class Scheduler:
                 self.config.algorithm.schedule_batch_stream(
                     pods, chunk_size=chunk_size or self.stream_chunk_size()):
             solve_done = time.perf_counter()
+            self._record_batch_decisions(chunk_pods, placements, trace_id,
+                                         solve_done - start)
             self._assume_and_bind_batch(chunk_pods, placements, start)
         # Algorithm latency spans until the LAST chunk's results landed
         # (interleaved assume/bind of earlier chunks overlaps the device
@@ -294,12 +404,15 @@ class Scheduler:
         # and binding proceeds anyway (scheduler.go:116-120).
         assumed = True
         try:
-            cache.assume_pod(pod, dest)
+            with stage("assume", pods=1):
+                cache.assume_pod(pod, dest)
         except ValueError:
             assumed = False
+        ctx = trace_mod.current_context()
 
         def bind():
-            self._bind_assumed(pod, dest, start, assumed=assumed)
+            with trace_mod.use_context(ctx):
+                self._bind_assumed(pod, dest, start, assumed=assumed)
 
         if self.config.async_bind:
             t = threading.Thread(target=bind, daemon=True)
@@ -315,30 +428,44 @@ class Scheduler:
         cache = self.config.algorithm.cache
         bind_start = time.perf_counter()
         try:
-            self.config.binder.bind(pod, dest)
+            with stage("bind", pods=1):
+                self.config.binder.bind(pod, dest)
         except Exception as err:  # noqa: BLE001 — bind errors requeue
             # ForgetPod + error handler (scheduler.go:139-148).  409 and
             # timeout alike: forget the optimistic assume, emit the event,
             # requeue behind per-pod backoff — never silently drop.
-            _record_bind_failure(err)
+            result = _record_bind_failure(err)
             if assumed:
                 cache.forget_pod(pod)
             self._handle_failure(pod, "FailedScheduling",
-                                 f"Binding rejected: {err}")
+                                 f"Binding rejected: {err}",
+                                 result=result)
             return
         us = (time.perf_counter() - bind_start) * 1e6
         self.config.metrics.binding_latency.observe(us)
         self.config.metrics.e2e_scheduling_latency.observe(
             (time.perf_counter() - start) * 1e6)
+        self.config.metrics.scheduling_attempts.labels(
+            result="scheduled").inc()
         self.config.recorder.eventf(
             pod.key, "Normal", "Scheduled",
             f"Successfully assigned {pod.name} to {dest}")
 
     def _bind_assumed_batch(self, placed: list[tuple[api.Pod, str]],
-                            start: float) -> None:
+                            start: float, trace_ctx=None) -> None:
         """Bind a solved batch: per-pod CAS binds (conflicts forget +
         requeue exactly like _bind_assumed), with the per-pod metric
-        observations amortized into one bucket pass each."""
+        observations amortized into one bucket pass each.  ``trace_ctx``
+        carries the batch's span context into the async bind thread so the
+        fan-out (and its HTTP requests) stays on the batch's trace."""
+        if trace_ctx is None:  # sync call: stay on the caller's context
+            trace_ctx = trace_mod.current_context()
+        with trace_mod.use_context(trace_ctx), \
+                stage("bind", pods=len(placed)):
+            self._bind_assumed_batch_inner(placed, start)
+
+    def _bind_assumed_batch_inner(self, placed: list[tuple[api.Pod, str]],
+                                  start: float) -> None:
         cache = self.config.algorithm.cache
         recorder = self.config.recorder
         bind_start = time.perf_counter()
@@ -349,13 +476,14 @@ class Scheduler:
             items = []
             for pod, dest in placed:
                 if pod.key in failed:
-                    _record_bind_failure(failed[pod.key])
+                    result = _record_bind_failure(failed[pod.key])
                     cache.forget_pod(pod)
                     # Surface the real error: a CAS conflict and a
                     # network failure require different operator action.
                     self._handle_failure(
                         pod, "FailedScheduling",
-                        f"Binding rejected: {failed[pod.key]}")
+                        f"Binding rejected: {failed[pod.key]}",
+                        result=result)
                 else:
                     ok += 1
                     items.append((pod.key, "Normal", "Scheduled",
@@ -367,10 +495,11 @@ class Scheduler:
                 try:
                     self.config.binder.bind(pod, dest)
                 except Exception as err:  # noqa: BLE001 — bind errors requeue
-                    _record_bind_failure(err)
+                    result = _record_bind_failure(err)
                     cache.forget_pod(pod)
                     self._handle_failure(pod, "FailedScheduling",
-                                         f"Binding rejected: {err}")
+                                         f"Binding rejected: {err}",
+                                         result=result)
                     continue
                 ok += 1
                 recorder.eventf(
@@ -381,10 +510,22 @@ class Scheduler:
             (done - bind_start) * 1e6 / max(len(placed), 1), ok)
         self.config.metrics.e2e_scheduling_latency.observe_many(
             (done - start) * 1e6, ok)
+        if ok:
+            self.config.metrics.scheduling_attempts.labels(
+                result="scheduled").inc(ok)
 
-    def _handle_failure(self, pod: api.Pod, reason: str, message: str) -> None:
-        """Event + condition update + backoff requeue (factory.go:512-556)."""
+    def _handle_failure(self, pod: api.Pod, reason: str, message: str,
+                        result: str = "unschedulable",
+                        failed_predicates: Optional[dict] = None) -> None:
+        """Event + condition update + backoff requeue (factory.go:512-556).
+        Every failure class funnels through here, so this is also where
+        the attempts counter and the flight recorder see it."""
         log.debug("scheduling failure for %s: %s", pod.key, message)
+        self.config.metrics.scheduling_attempts.labels(result=result).inc()
+        if self.config.flight_recorder is not None:
+            self.config.flight_recorder.record_failure(
+                pod.key, reason, message,
+                failed_predicates=failed_predicates)
         self.config.recorder.eventf(pod.key, "Warning", reason, message)
         if self.config.condition_updater is not None:
             self.config.condition_updater(pod, "Unschedulable", message)

@@ -478,17 +478,21 @@ def make_handler(core: ExtenderCore):
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/healthz":
-                self._send(200, b"ok", "text/plain")
-            elif self.path == "/metrics":
-                self._send(200, core.metrics.expose().encode(), "text/plain")
-            elif self.path == "/configz":
+            if self.path == "/configz":
                 cfg = {"predicates": [p.name for p in core.policy.predicates],
                        "priorities": [(s.name, s.weight)
                                       for s in core.policy.priorities]}
                 self._send(200, json.dumps(cfg).encode())
-            else:
+                return
+            # healthz / metrics / debug tree: the shared daemon routes.
+            from kubernetes_tpu.utils.debugmux import common_route
+            resolved = common_route(self.path,
+                                    metrics_fn=core.metrics.expose)
+            if resolved is None:
                 self._send(404, b"not found", "text/plain")
+            else:
+                code, body, ctype = resolved
+                self._send(code, body, ctype)
 
         def do_POST(self):
             length = int(self.headers.get("Content-Length", 0))
@@ -501,10 +505,17 @@ def make_handler(core: ExtenderCore):
                 self._send(404, b'{"error": "unknown verb"}')
                 return
             import time
+
+            from kubernetes_tpu.utils import trace
             start = time.perf_counter()
             body = core.handle(verb, raw)
-            us = (time.perf_counter() - start) * 1e6
-            core.metrics.scheduling_algorithm_latency.observe(us)
+            dur = time.perf_counter() - start
+            core.metrics.scheduling_algorithm_latency.observe(dur * 1e6)
+            # The verb span joins the calling scheduler's trace when it
+            # propagated a traceparent header.
+            trace.record_server_span(
+                "extender." + verb,
+                self.headers.get("traceparent", ""), dur)
             self._send(200, body)
 
     return Handler

@@ -3,105 +3,244 @@
 The scheduler's three histograms (plugin/pkg/scheduler/metrics/metrics.go:
 31-55): microseconds, exponential buckets 1ms * 2^k for 15 buckets, exposed
 at /metrics in the Prometheus text format every daemon serves.
+
+Label sets are supported the prometheus way: a metric constructed with
+``labelnames`` is a family; ``.labels(k=v, ...)`` returns (and memoizes)
+the child carrying that label set, and the family's ``value`` aggregates
+across children.  Exposition follows the text-format spec: HELP text is
+escaped (``\\`` and newlines), label values are escaped (``\\``, ``"``,
+newlines), histogram buckets are exposed cumulatively but stored
+per-bucket so ``observe()`` is one bisect instead of a walk over every
+upper bound.
 """
 
 from __future__ import annotations
 
 import threading
+from bisect import bisect_left
 from typing import Iterable
 
 
-class Histogram:
-    """prometheus.Histogram with ExponentialBuckets semantics."""
+def _escape_help(text: str) -> str:
+    """HELP escaping per the exposition spec: backslash and line feed."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label_value(text: str) -> str:
+    """Label-value escaping: backslash, double-quote, line feed."""
+    return text.replace("\\", "\\\\").replace('"', '\\"') \
+               .replace("\n", "\\n")
+
+
+def _label_str(labelnames: tuple, labelvalues: tuple,
+               extra: str = "") -> str:
+    parts = [f'{n}="{_escape_label_value(str(v))}"'
+             for n, v in zip(labelnames, labelvalues)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Family:
+    """Shared family machinery: labelnames, memoized children, one lock."""
 
     def __init__(self, name: str, help_text: str,
-                 buckets: Iterable[float]):
+                 labelnames: Iterable[str] = ()):
         self.name = name
         self.help = help_text
+        self._labelnames = tuple(labelnames)
+        self._children: dict = {}
+        self._lock = threading.Lock()
+
+    def labels(self, **kw):
+        """The child metric for this label set (created on first use)."""
+        if not self._labelnames:
+            raise ValueError(f"{self.name} has no labels")
+        try:
+            key = tuple(kw[n] for n in self._labelnames)
+        except KeyError:
+            raise ValueError(
+                f"{self.name} expects labels {self._labelnames}, "
+                f"got {tuple(kw)}") from None
+        if len(kw) != len(self._labelnames):
+            raise ValueError(
+                f"{self.name} expects labels {self._labelnames}, "
+                f"got {tuple(kw)}")
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make_child(key)
+            return child
+
+    def children(self) -> dict:
+        """Label-values tuple -> child metric (a snapshot)."""
+        with self._lock:
+            return dict(self._children)
+
+    def _check_unlabeled(self) -> None:
+        if self._labelnames:
+            raise ValueError(
+                f"{self.name} is labeled {self._labelnames}; "
+                f"use .labels(...)")
+
+    def _sorted_children(self) -> list:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def _header(self, type_name: str) -> list[str]:
+        return [f"# HELP {self.name} {_escape_help(self.help)}",
+                f"# TYPE {self.name} {type_name}"]
+
+
+class Histogram(_Family):
+    """prometheus.Histogram with ExponentialBuckets semantics.  Counts are
+    stored per-bucket (non-cumulative) and cumulated at expose time, so
+    ``observe`` costs one bisect, not a pass over every upper bound."""
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: Iterable[float],
+                 labelnames: Iterable[str] = ()):
+        super().__init__(name, help_text, labelnames)
         self.uppers = sorted(buckets)
         self._counts = [0] * len(self.uppers)
         self._sum = 0.0
         self._count = 0
-        self._lock = threading.Lock()
+
+    def _make_child(self, key) -> "Histogram":
+        child = Histogram(self.name, self.help, self.uppers)
+        child._labelvalues = key  # rendered by the family's expose
+        return child
 
     def observe(self, value: float) -> None:
+        self._check_unlabeled()
+        i = bisect_left(self.uppers, value)
         with self._lock:
             self._sum += value
             self._count += 1
-            for i, upper in enumerate(self.uppers):
-                if value <= upper:
-                    self._counts[i] += 1
+            if i < len(self._counts):
+                self._counts[i] += 1
 
     def observe_many(self, value: float, count: int) -> None:
-        """``count`` observations of the same value in one bucket pass —
+        """``count`` observations of the same value in one bucket update —
         the batched drain amortizes one solve across the whole batch, so
         every pod records the same per-pod latency."""
         if count <= 0:
             return
+        self._check_unlabeled()
+        i = bisect_left(self.uppers, value)
         with self._lock:
             self._sum += value * count
             self._count += count
-            for i, upper in enumerate(self.uppers):
-                if value <= upper:
-                    self._counts[i] += count
+            if i < len(self._counts):
+                self._counts[i] += count
+
+    @property
+    def count(self) -> int:
+        if self._labelnames:
+            return sum(c._count for _, c in self._sorted_children())
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        if self._labelnames:
+            return sum(c._sum for _, c in self._sorted_children())
+        with self._lock:
+            return self._sum
+
+    def _sample_lines(self, labelvalues: tuple = ()) -> list[str]:
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        lines = []
+        cum = 0
+        for upper, n in zip(self.uppers, counts):
+            cum += n
+            lab = _label_str(self._family_labelnames, labelvalues,
+                             f'le="{upper:g}"')
+            lines.append(f"{self.name}_bucket{lab} {cum}")
+        lab = _label_str(self._family_labelnames, labelvalues,
+                         'le="+Inf"')
+        lines.append(f"{self.name}_bucket{lab} {total}")
+        plain = _label_str(self._family_labelnames, labelvalues)
+        lines.append(f"{self.name}_sum{plain} {s:g}")
+        lines.append(f"{self.name}_count{plain} {total}")
+        return lines
+
+    # Children render with the FAMILY's labelnames; the family itself
+    # (unlabeled) renders with none.
+    _family_labelnames: tuple = ()
 
     def expose(self) -> str:
-        with self._lock:
-            lines = [f"# HELP {self.name} {self.help}",
-                     f"# TYPE {self.name} histogram"]
-            for upper, count in zip(self.uppers, self._counts):
-                lines.append(f'{self.name}_bucket{{le="{upper:g}"}} {count}')
-            lines.append(f'{self.name}_bucket{{le="+Inf"}} {self._count}')
-            lines.append(f"{self.name}_sum {self._sum:g}")
-            lines.append(f"{self.name}_count {self._count}")
-            return "\n".join(lines) + "\n"
+        lines = self._header("histogram")
+        if self._labelnames:
+            for key, child in self._sorted_children():
+                child._family_labelnames = self._labelnames
+                lines.extend(child._sample_lines(key))
+        else:
+            lines.extend(self._sample_lines())
+        return "\n".join(lines) + "\n"
 
 
-class Counter:
-    def __init__(self, name: str, help_text: str):
-        self.name = name
-        self.help = help_text
+class Counter(_Family):
+    def __init__(self, name: str, help_text: str,
+                 labelnames: Iterable[str] = ()):
+        super().__init__(name, help_text, labelnames)
         self._value = 0
-        self._lock = threading.Lock()
+
+    def _make_child(self, key) -> "Counter":
+        return Counter(self.name, self.help)
 
     def inc(self, by: int = 1) -> None:
+        self._check_unlabeled()
         with self._lock:
             self._value += by
 
     @property
     def value(self) -> int:
+        if self._labelnames:
+            return sum(c.value for _, c in self._sorted_children())
         with self._lock:
             return self._value
 
     def expose(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} counter\n"
-                f"{self.name} {self.value}\n")
+        lines = self._header("counter")
+        if self._labelnames:
+            for key, child in self._sorted_children():
+                lab = _label_str(self._labelnames, key)
+                lines.append(f"{self.name}{lab} {child.value}")
+        else:
+            lines.append(f"{self.name} {self.value}")
+        return "\n".join(lines) + "\n"
 
 
-class Gauge:
+class Gauge(_Family):
     """prometheus.Gauge: a value that can go up and down (breaker state,
     queue depths).  ``set_fn`` switches it to a callback gauge computed at
     expose time (prometheus.GaugeFunc) — the right shape when the truth
     lives in object lifetimes (e.g. a WeakSet of open breakers) rather
     than in paired inc/dec calls that a dropped object would unbalance."""
 
-    def __init__(self, name: str, help_text: str):
-        self.name = name
-        self.help = help_text
+    def __init__(self, name: str, help_text: str,
+                 labelnames: Iterable[str] = ()):
+        super().__init__(name, help_text, labelnames)
         self._value = 0.0
         self._fn = None
-        self._lock = threading.Lock()
+
+    def _make_child(self, key) -> "Gauge":
+        return Gauge(self.name, self.help)
 
     def set_fn(self, fn) -> None:
         with self._lock:
             self._fn = fn
 
     def set(self, value: float) -> None:
+        self._check_unlabeled()
         with self._lock:
             self._value = value
 
     def inc(self, by: float = 1.0) -> None:
+        self._check_unlabeled()
         with self._lock:
             self._value += by
 
@@ -110,6 +249,8 @@ class Gauge:
 
     @property
     def value(self) -> float:
+        if self._labelnames:
+            return sum(c.value for _, c in self._sorted_children())
         with self._lock:
             fn = self._fn
         if fn is not None:
@@ -118,9 +259,14 @@ class Gauge:
             return self._value
 
     def expose(self) -> str:
-        return (f"# HELP {self.name} {self.help}\n"
-                f"# TYPE {self.name} gauge\n"
-                f"{self.name} {self.value:g}\n")
+        lines = self._header("gauge")
+        if self._labelnames:
+            for key, child in self._sorted_children():
+                lab = _label_str(self._labelnames, key)
+                lines.append(f"{self.name}{lab} {child.value:g}")
+        else:
+            lines.append(f"{self.name} {self.value:g}")
+        return "\n".join(lines) + "\n"
 
 
 def exponential_buckets(start: float, factor: float, count: int) -> list[float]:
@@ -150,37 +296,46 @@ def register(metric):
     return metric
 
 
-def expose_registry() -> str:
+def registry_metrics() -> list:
     with _REGISTRY_LOCK:
-        metrics = list(_REGISTRY)
-    return "".join(m.expose() for m in metrics)
+        return list(_REGISTRY)
 
 
-# Client -> apiserver path (client/http.py).
+def expose_registry() -> str:
+    return "".join(m.expose() for m in registry_metrics())
+
+
+# Client -> apiserver path (client/http.py), labeled by verb.
 CLIENT_RETRIES = register(Counter(
     "apiclient_retries_total",
-    "Retries of idempotent apiserver verbs after 5xx/429/transport faults"))
+    "Retries of idempotent apiserver verbs after 5xx/429/transport faults",
+    labelnames=("verb",)))
 CLIENT_RETRY_BUDGET_EXHAUSTED = register(Counter(
     "apiclient_retry_budget_exhausted_total",
     "Retries skipped because the client retry budget was empty"))
-# Reflector list+watch loop (client/reflector.py).
+# Reflector list+watch loop (client/reflector.py), labeled by kind.
 REFLECTOR_RELISTS = register(Counter(
     "reflector_relists_total",
-    "Reflector relists after watch errors, stream EOF, or 410 Gone"))
+    "Reflector relists after watch errors, stream EOF, or 410 Gone",
+    labelnames=("kind",)))
 # Extender path (engine/extender_client.py + generic_scheduler.py).
 EXTENDER_RETRIES = register(Counter(
     "extender_retries_total",
-    "Retries of extender filter/prioritize calls after transport faults"))
+    "Retries of extender filter/prioritize calls after transport faults",
+    labelnames=("verb",)))
 EXTENDER_BREAKER_TRANSITIONS = register(Counter(
     "extender_breaker_transitions_total",
-    "Extender circuit-breaker state transitions (closed/open/half-open)"))
+    "Extender circuit-breaker state transitions, labeled by the state "
+    "entered (closed/open/half-open)",
+    labelnames=("state",)))
 EXTENDER_BREAKER_OPEN = register(Gauge(
     "extender_breaker_open",
     "Number of currently-open extender circuit breakers (0 = none)"))
 EXTENDER_DEGRADED_DECISIONS = register(Counter(
     "scheduler_extender_degraded_decisions_total",
     "Scheduling decisions made with built-in predicates only because the "
-    "extender breaker was open"))
+    "extender breaker was open",
+    labelnames=("extender",)))
 # Bind path (scheduler/scheduler.py).
 BIND_CONFLICTS = register(Counter(
     "scheduler_bind_conflicts_total",
@@ -191,9 +346,30 @@ BIND_FAILURES = register(Counter(
     "Bind attempts lost to transport faults or timeouts (non-conflict); "
     "each forgets the assumed pod and requeues with backoff"))
 
+# The hot loop's named stages (utils/trace.stage): queue_wait, snapshot,
+# compile, transfer, solve, readback, assume, bind.  Registered here (not
+# per-daemon) because the recording sites span the engine and the daemon.
+STAGE_LATENCY = register(Histogram(
+    "scheduler_batch_stage_latency_microseconds",
+    "Per-stage wall time of the batched scheduling pipeline "
+    "(queue_wait/snapshot/compile/transfer/solve/readback/assume/bind)",
+    exponential_buckets(100, 2, 18), labelnames=("stage",)))
+
+# Apiserver request latency by verb/resource/code (the reference's
+# apiserver_request_latencies, pkg/apiserver/metrics).  Recorded by the
+# Python apiserver's request loop; rides the default registry so the
+# apiserver's /metrics endpoint (and only meaningfully that one) shows it.
+APISERVER_REQUEST_LATENCY = register(Histogram(
+    "apiserver_request_latency_microseconds",
+    "Apiserver request latency by verb, resource and response code",
+    exponential_buckets(100, 2, 15),
+    labelnames=("verb", "resource", "code")))
+
 
 class SchedulerMetrics:
-    """The scheduler's metric set (metrics.go:31-55), microseconds."""
+    """The scheduler's metric set (metrics.go:31-55), microseconds, plus
+    the daemon-scoped observability additions: queue-depth and batch-size
+    gauges and the per-result scheduling-attempts counter."""
 
     def __init__(self) -> None:
         buckets = exponential_buckets(1000, 2, 15)
@@ -206,11 +382,23 @@ class SchedulerMetrics:
         self.binding_latency = Histogram(
             "scheduler_binding_latency_microseconds",
             "Binding latency", buckets)
+        self.queue_depth = Gauge(
+            "scheduler_pending_queue_depth",
+            "Pods currently waiting in the scheduling queue")
+        self.batch_size = Gauge(
+            "scheduler_last_batch_size",
+            "Size of the most recent drained scheduling batch")
+        self.scheduling_attempts = Counter(
+            "scheduler_pod_scheduling_attempts_total",
+            "Pod scheduling attempts by result (scheduled/unschedulable/"
+            "bind_conflict/bind_error/error)",
+            labelnames=("result",))
 
     def expose(self) -> str:
-        # The default registry (retry/breaker/degradation counters) rides
-        # along so any daemon serving a SchedulerMetrics /metrics endpoint
-        # also exposes the failure-path observability.
-        return "".join(h.expose() for h in (
+        # The default registry (retry/breaker/degradation counters, stage
+        # latencies) rides along so any daemon serving a SchedulerMetrics
+        # /metrics endpoint also exposes the shared-path observability.
+        return "".join(m.expose() for m in (
             self.e2e_scheduling_latency, self.scheduling_algorithm_latency,
-            self.binding_latency)) + expose_registry()
+            self.binding_latency, self.queue_depth, self.batch_size,
+            self.scheduling_attempts)) + expose_registry()

@@ -1,0 +1,359 @@
+"""Strict Prometheus text-format round-trip of every daemon's /metrics
+endpoint (scheduler, apiserver, extender, controller-manager), plus the
+exposition-spec details the hand-rolled writer must honor: HELP escaping,
+label-value escaping, monotone cumulative buckets, _sum/_count
+consistency, and labeled failure-path counters (the chaos-suite
+assertion: breaker/degraded counters carry labels after PR 1's fault
+scenarios)."""
+
+from __future__ import annotations
+
+import re
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.utils import metrics as m
+
+from tests.helpers import make_node, make_pod
+
+# -- a strict parser --------------------------------------------------------
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_SAMPLE_RE = re.compile(
+    rf"^({_NAME})(?:\{{(.*)\}})? (-?(?:[0-9.e+-]+|Inf|NaN))$")
+_LABEL_RE = re.compile(rf'({_NAME})="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(value: str) -> str:
+    return value.replace('\\"', '"').replace("\\n", "\n") \
+                .replace("\\\\", "\\")
+
+
+def parse_prometheus(text: str) -> dict:
+    """Parse an exposition strictly.  Returns
+    {family: {"type": t, "help": h, "samples": [(name, labels, value)]}}
+    and raises AssertionError on any malformation: samples without a TYPE,
+    TYPE without HELP, duplicate (name, labels) samples, bad label syntax,
+    unparseable values."""
+    families: dict = {}
+    seen: set = set()
+    current = None
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            assert name not in families, f"line {lineno}: duplicate HELP " \
+                                         f"for {name}"
+            families[name] = {"type": None, "help": help_text,
+                              "samples": []}
+            current = name
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, type_name = rest.partition(" ")
+            assert name in families, f"line {lineno}: TYPE before HELP " \
+                                     f"for {name}"
+            assert type_name in ("counter", "gauge", "histogram",
+                                 "summary", "untyped"), \
+                f"line {lineno}: bad type {type_name!r}"
+            families[name]["type"] = type_name
+            current = name
+            continue
+        assert not line.startswith("#"), \
+            f"line {lineno}: unexpected comment {line!r}"
+        match = _SAMPLE_RE.match(line)
+        assert match, f"line {lineno}: malformed sample {line!r}"
+        name, label_blob, value = match.groups()
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        family = name if name in families else base
+        assert family in families and families[family]["type"], \
+            f"line {lineno}: sample {name} without HELP/TYPE"
+        if families[family]["type"] == "histogram":
+            assert name != family, \
+                f"line {lineno}: bare histogram sample {name}"
+        labels = {}
+        if label_blob:
+            consumed = 0
+            for lm in _LABEL_RE.finditer(label_blob):
+                labels[lm.group(1)] = _unescape(lm.group(2))
+                consumed = lm.end()
+            rest = label_blob[consumed:].strip(", ")
+            assert not rest, f"line {lineno}: bad label syntax " \
+                             f"{label_blob!r}"
+        key = (name, tuple(sorted(labels.items())))
+        assert key not in seen, f"line {lineno}: duplicate sample {key}"
+        seen.add(key)
+        families[family]["samples"].append((name, labels, float(value)))
+    return families
+
+
+def assert_histograms_consistent(families: dict) -> None:
+    """Cumulative bucket monotonicity, le ordering, and
+    +Inf == _count for every label-set series of every histogram."""
+    for fname, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        series: dict = {}
+        for name, labels, value in fam["samples"]:
+            rest = tuple(sorted((k, v) for k, v in labels.items()
+                                if k != "le"))
+            series.setdefault(rest, {"buckets": [], "sum": None,
+                                     "count": None})
+            if name.endswith("_bucket"):
+                series[rest]["buckets"].append((labels["le"], value))
+            elif name.endswith("_sum"):
+                series[rest]["sum"] = value
+            elif name.endswith("_count"):
+                series[rest]["count"] = value
+        for rest, s in series.items():
+            assert s["buckets"], f"{fname}{rest}: no buckets"
+            assert s["sum"] is not None and s["count"] is not None, \
+                f"{fname}{rest}: missing _sum/_count"
+            uppers = [float(le) for le, _ in s["buckets"]]
+            assert uppers == sorted(uppers), \
+                f"{fname}{rest}: le not ascending"
+            assert uppers[-1] == float("inf"), \
+                f"{fname}{rest}: no +Inf bucket"
+            counts = [v for _, v in s["buckets"]]
+            assert counts == sorted(counts), \
+                f"{fname}{rest}: buckets not cumulative-monotone"
+            assert counts[-1] == s["count"], \
+                f"{fname}{rest}: +Inf bucket != _count"
+
+
+def _fetch(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        assert r.status == 200
+        return r.read().decode()
+
+
+# -- exposition-spec details ------------------------------------------------
+
+class TestExpositionSpec:
+    def test_help_escaping(self):
+        c = m.Counter("esc_help_total", "line one\nline two with \\ slash")
+        text = c.expose()
+        assert "# HELP esc_help_total line one\\nline two with " \
+               "\\\\ slash" in text
+        fams = parse_prometheus(text)
+        assert fams["esc_help_total"]["help"] == \
+            "line one\\nline two with \\\\ slash"
+
+    def test_label_value_escaping_roundtrip(self):
+        c = m.Counter("esc_label_total", "h", labelnames=("path",))
+        c.labels(path='a"b\\c\nd').inc()
+        fams = parse_prometheus(c.expose())
+        (_, labels, value), = fams["esc_label_total"]["samples"]
+        assert labels["path"] == 'a"b\\c\nd'
+        assert value == 1
+
+    def test_histogram_observe_is_one_bucket_and_cumulative_on_expose(self):
+        h = m.Histogram("bis_us", "h", [1, 2, 4, 8])
+        h.observe(2)       # exactly on an upper bound: le="2" bucket
+        h.observe(3)
+        h.observe(100)     # beyond the last bound: only +Inf
+        # Internal storage is per-bucket (non-cumulative)...
+        assert h._counts == [0, 1, 1, 0]
+        # ...but the exposition is cumulative and monotone.
+        fams = parse_prometheus(h.expose())
+        assert_histograms_consistent(fams)
+        buckets = {labels["le"]: v for name, labels, v in
+                   fams["bis_us"]["samples"] if name.endswith("_bucket")}
+        assert buckets == {"1": 0, "2": 1, "4": 2, "8": 2, "+Inf": 3}
+
+    def test_observe_many_matches_repeated_observe(self):
+        h1 = m.Histogram("om1_us", "h", [1, 10, 100])
+        h2 = m.Histogram("om2_us", "h", [1, 10, 100])
+        h1.observe_many(5.0, 7)
+        for _ in range(7):
+            h2.observe(5.0)
+        assert h1._counts == h2._counts
+        assert h1.sum == h2.sum and h1.count == h2.count
+
+    def test_labeled_family_aggregates_and_rejects_bare_ops(self):
+        c = m.Counter("agg_total", "h", labelnames=("x",))
+        c.labels(x="a").inc(2)
+        c.labels(x="b").inc(3)
+        assert c.value == 5
+        with pytest.raises(ValueError):
+            c.inc()
+        with pytest.raises(ValueError):
+            c.labels(wrong="a")
+
+
+# -- the four daemon endpoints ---------------------------------------------
+
+def _roundtrip(text: str, expect: list[str]) -> dict:
+    fams = parse_prometheus(text)
+    assert_histograms_consistent(fams)
+    for name in expect:
+        assert name in fams, f"{name} missing from exposition"
+    return fams
+
+
+class TestEndpointRoundTrips:
+    def test_scheduler_metrics_endpoint(self):
+        """The daemon mux: SchedulerMetrics + the default registry, with
+        stage/attempt labels present after a real drain."""
+        from kubernetes_tpu.apiserver.memstore import MemStore
+        from kubernetes_tpu.scheduler.__main__ import _status_mux
+        from kubernetes_tpu.scheduler.factory import ConfigFactory
+        from kubernetes_tpu.api.types import node_to_json, pod_to_json
+        store = MemStore()
+        store.create("nodes", node_to_json(make_node("mn1",
+                                                     milli_cpu=4000)))
+        factory = ConfigFactory(store).run()
+        mux = _status_mux(factory, {"enableProfiling": True}, 0)
+        try:
+            store.create("pods", pod_to_json(make_pod("mp1", cpu="100m")))
+            store.create("pods", pod_to_json(make_pod("mhuge",
+                                                      cpu="64000m")))
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                obj = store.get("pods", "default/mp1")
+                if (obj.get("spec") or {}).get("nodeName"):
+                    break
+                time.sleep(0.05)
+            factory.daemon.wait_for_binds()
+            port = mux.server_address[1]
+            fams = _roundtrip(
+                _fetch(f"http://127.0.0.1:{port}/metrics"),
+                ["scheduler_e2e_scheduling_latency_microseconds",
+                 "scheduler_binding_latency_microseconds",
+                 "scheduler_pending_queue_depth",
+                 "scheduler_last_batch_size",
+                 "scheduler_pod_scheduling_attempts_total",
+                 "scheduler_batch_stage_latency_microseconds",
+                 "scheduler_bind_conflicts_total"])
+            stages = {labels.get("stage") for _, labels, _ in
+                      fams["scheduler_batch_stage_latency_microseconds"]
+                      ["samples"]}
+            for want in ("snapshot", "compile", "transfer", "solve",
+                         "readback", "assume", "bind", "queue_wait"):
+                assert want in stages, f"stage {want} not observed"
+            results = {labels["result"]: v for _, labels, v in
+                       fams["scheduler_pod_scheduling_attempts_total"]
+                       ["samples"]}
+            assert results.get("scheduled", 0) >= 1
+            assert results.get("unschedulable", 0) >= 1
+        finally:
+            factory.stop()
+            mux.shutdown()
+
+    def test_apiserver_metrics_endpoint(self):
+        """The hand-parsed server's /metrics: per-verb/resource/code
+        request latencies with correct labels."""
+        from kubernetes_tpu.apiserver.memstore import MemStore
+        from kubernetes_tpu.apiserver.server import serve
+        from kubernetes_tpu.api.types import node_to_json
+        srv = serve(MemStore(), port=0)
+        try:
+            port = srv.server_address[1]
+            url = f"http://127.0.0.1:{port}"
+            # Drive one of each verb class (including a 404).
+            req = urllib.request.Request(
+                url + "/api/v1/nodes",
+                data=__import__("json").dumps(
+                    node_to_json(make_node("an1"))).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            urllib.request.urlopen(req, timeout=5).read()
+            _fetch(url + "/api/v1/nodes")
+            with pytest.raises(urllib.error.HTTPError):
+                _fetch(url + "/api/v1/nodes/nope")
+            fams = _roundtrip(
+                _fetch(url + "/metrics"),
+                ["apiserver_request_latency_microseconds"])
+            samples = fams["apiserver_request_latency_microseconds"][
+                "samples"]
+            label_sets = {tuple(sorted(labels.items()))
+                          for name, labels, _ in samples
+                          if name.endswith("_count")}
+            assert any(dict(ls).get("verb") == "POST" and
+                       dict(ls).get("resource") == "nodes" and
+                       dict(ls).get("code") == "201"
+                       for ls in label_sets)
+            assert any(dict(ls).get("verb") == "GET" and
+                       dict(ls).get("code") == "404"
+                       for ls in label_sets)
+            for ls in label_sets:
+                assert set(dict(ls)) == {"verb", "resource", "code"}
+        finally:
+            srv.shutdown()
+
+    def test_extender_metrics_endpoint(self):
+        from kubernetes_tpu.server.extender import serve_in_thread
+        srv = serve_in_thread(port=0)
+        try:
+            port = srv.server_address[1]
+            _roundtrip(
+                _fetch(f"http://127.0.0.1:{port}/metrics"),
+                ["scheduler_e2e_scheduling_latency_microseconds",
+                 "scheduler_scheduling_algorithm_latency_microseconds"])
+        finally:
+            srv.shutdown()
+
+    def test_controller_metrics_endpoint(self):
+        from kubernetes_tpu.controller.__main__ import status_mux
+        mux = status_mux(port=0)
+        try:
+            port = mux.server_address[1]
+            _roundtrip(
+                _fetch(f"http://127.0.0.1:{port}/metrics"),
+                ["apiclient_retries_total", "reflector_relists_total",
+                 "extender_breaker_transitions_total"])
+            # /healthz and /debug/traces ride the same mux.
+            assert _fetch(f"http://127.0.0.1:{port}/healthz") == "ok"
+            assert "traceEvents" in _fetch(
+                f"http://127.0.0.1:{port}/debug/traces")
+        finally:
+            mux.shutdown()
+
+
+# -- chaos-suite label assertion -------------------------------------------
+
+def test_breaker_and_degraded_counters_carry_labels():
+    """PR 1's fault scenarios feed labeled counters: trip the breaker on a
+    dead extender and assert the open-transition and degraded-decision
+    samples are labeled (state=..., extender=...)."""
+    import socket
+
+    from kubernetes_tpu.api.policy import ExtenderConfig
+    from kubernetes_tpu.engine.extender_client import (ExtenderError,
+                                                       HTTPExtender)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    url = f"http://127.0.0.1:{dead_port}/ext"
+    ext = HTTPExtender(ExtenderConfig(
+        url_prefix=url, filter_verb="filter", http_timeout_s=0.3))
+    pod = make_pod("chaos-label")
+    nodes = [make_node("cn1")]
+    for _ in range(3):   # BREAKER_THRESHOLD consecutive transport faults
+        with pytest.raises(ExtenderError):
+            ext.filter(pod, nodes)
+    exposed = m.expose_registry()
+    assert 'extender_breaker_transitions_total{state="open"}' in exposed
+    # Engine-side degradation while the breaker is open is labeled by
+    # extender url.
+    from kubernetes_tpu.engine.generic_scheduler import GenericScheduler
+    eng = GenericScheduler()
+    eng.cache.add_node(make_node("cn1", milli_cpu=4000))
+    eng.extenders = [ext]
+    try:
+        dest = eng.schedule(make_pod("chaos-degraded", cpu="100m"))
+        assert dest == "cn1"
+        exposed = m.expose_registry()
+        assert re.search(
+            r'scheduler_extender_degraded_decisions_total\{extender="'
+            + re.escape(url) + r'"\} [1-9]', exposed)
+        fams = parse_prometheus(exposed)
+        assert_histograms_consistent(fams)
+    finally:
+        # The open-breaker gauge is process-global; close it for other
+        # tests.
+        ext.breaker.record_success()

@@ -1,0 +1,31 @@
+"""Metric-inventory ratchet (tools/check_metrics.py), hooked into tier-1
+alongside the bench-docs ratchet: a metric registered in code but absent
+from ARCHITECTURE.md's Observability inventory (or vice versa) fails the
+suite."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "check_metrics", os.path.join(REPO, "tools", "check_metrics.py"))
+check = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(check)
+
+
+def test_code_scan_finds_the_known_metrics():
+    code = check.metrics_in_code()
+    # Spot-check the three layers: daemon, shared registry, apiserver.
+    assert "scheduler_e2e_scheduling_latency_microseconds" in code
+    assert "scheduler_batch_stage_latency_microseconds" in code
+    assert "apiserver_request_latency_microseconds" in code
+    assert "extender_breaker_transitions_total" in code
+
+
+def test_inventory_in_sync():
+    assert check.main() == 0, \
+        "metric inventory drifted — update ARCHITECTURE.md's " \
+        "Observability table (see tools/check_metrics.py output)"

@@ -1,0 +1,373 @@
+"""End-to-end scheduling telemetry: span tracer semantics, batch-stage
+spans at /debug/traces with trace-id propagation into apiserver request
+spans (over real HTTP), the decision flight recorder + kubectl explain,
+and the tracing overhead guard (lazy ring, sampling flag, one-branch off
+path)."""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.utils import trace
+
+from tests.helpers import make_node, make_pod
+
+REQUIRED_STAGES = {"queue_wait", "snapshot", "transfer", "compile",
+                   "solve", "readback", "assume", "bind"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Each test starts with an empty ring and tracing on; global state is
+    restored afterwards so this module can't poison the suite."""
+    trace.reset()
+    trace.set_enabled(True)
+    trace.set_sample(1.0)
+    yield
+    trace.reset()
+    trace.set_enabled(True)
+    trace.set_sample(1.0)
+
+
+def _fetch(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+# -- tracer semantics -------------------------------------------------------
+
+class TestSpanTracer:
+    def test_nesting_parent_links_and_attrs(self):
+        with trace.span("outer", kind="batch") as outer:
+            with trace.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+        spans = {s["name"]: s for s in trace.snapshot()}
+        assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+        assert spans["outer"]["attrs"] == {"kind": "batch"}
+        assert spans["inner"]["trace_id"] == spans["outer"]["trace_id"]
+
+    def test_chrome_trace_shape(self):
+        with trace.span("evt"):
+            pass
+        doc = json.loads(trace.to_chrome_trace())
+        (ev,) = doc["traceEvents"]
+        assert ev["ph"] == "X" and ev["name"] == "evt"
+        assert {"ts", "dur", "pid", "tid"} <= set(ev)
+        assert len(ev["args"]["trace_id"]) == 32
+
+    def test_traceparent_roundtrip(self):
+        with trace.span("x"):
+            header = trace.traceparent()
+            ctx = trace.current_context()
+        parsed = trace.parse_traceparent(header)
+        assert parsed == (ctx[0], ctx[1], True)
+        assert trace.parse_traceparent("garbage") is None
+        assert trace.parse_traceparent("00-short-ff-01") is None
+
+    def test_cross_thread_context(self):
+        import threading
+        got = {}
+        with trace.span("root"):
+            ctx = trace.current_context()
+
+        def work():
+            with trace.use_context(ctx):
+                with trace.span("child"):
+                    pass
+                got["ok"] = True
+        t = threading.Thread(target=work)
+        t.start()
+        t.join()
+        spans = {s["name"]: s for s in trace.snapshot()}
+        assert got["ok"]
+        assert spans["child"]["trace_id"] == spans["root"]["trace_id"]
+
+    def test_server_span_joins_propagated_trace(self):
+        header = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        trace.record_server_span("apiserver.request", header, 0.001,
+                                 verb="POST")
+        (s,) = trace.snapshot()
+        assert s["trace_id"] == "ab" * 16
+        assert s["parent_id"] == "cd" * 8
+
+    def test_slow_trace_records_span_and_fast_one_does_not(self):
+        tr = trace.Trace("batch")
+        tr.step("solve")
+        tr.log_if_long()                 # fast: below 20 ms, no span
+        assert trace.snapshot() == []
+        tr.start -= 0.050                # backdate past the threshold
+        tr.log_if_long()
+        (s,) = trace.snapshot()
+        assert s["name"] == "slow_trace"
+        assert s["attrs"]["trace_name"] == "batch"
+        assert "solve" in s["attrs"]
+
+
+class TestOverheadGuard:
+    def test_ring_is_lazy_and_off_path_records_nothing(self):
+        trace.set_enabled(False)
+        assert not trace.ring_allocated()
+        with trace.span("nope"):
+            with trace.stage("solve"):
+                pass
+        assert not trace.ring_allocated()
+        assert trace.traceparent() is None
+
+    def test_sampling_flag_honored(self):
+        trace.set_sample(0.0)
+        for _ in range(20):
+            with trace.span("sampled-out"):
+                with trace.span("child"):
+                    pass
+        assert trace.snapshot() == []
+        assert not trace.ring_allocated()
+
+    def test_sampling_decision_is_per_trace_not_per_span(self):
+        """Children of an unsampled root must follow the root's decision
+        (not re-flip their own coin and record as orphan roots)."""
+        trace.set_sample(0.0)
+        with trace.span("unsampled-root"):
+            trace.set_sample(1.0)   # children still skip: root decided
+            with trace.span("child"):
+                pass
+            assert trace.traceparent() is None
+        with trace.span("fresh-root"):   # next trace samples again
+            pass
+        assert [s["name"] for s in trace.snapshot()] == ["fresh-root"]
+
+    def test_disabled_span_overhead_is_one_branch_cheap(self):
+        """The off path must be a branch, not a machine: 100k disabled
+        span entries in well under a second (~µs each would be 0.1 s)."""
+        trace.set_enabled(False)
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            with trace.span("off"):
+                pass
+        assert time.perf_counter() - t0 < 1.0
+
+    def test_density_smoke_tracing_disabled_within_noise(self):
+        """The density micro-bench with tracing disabled is within noise
+        of the traced run (generous bound — this guards against the off
+        path growing real per-pod work, not against scheduler noise); the
+        ring buffer must stay unallocated for the disabled run."""
+        from kubernetes_tpu.perf.harness import density
+        density(20, 100, quiet=True)           # warm compiles off-clock
+        trace.set_enabled(True)
+        on = density(20, 100, quiet=True)
+        trace.reset()
+        trace.set_enabled(False)
+        off = density(20, 100, quiet=True)
+        assert not trace.ring_allocated()
+        assert off.scheduled == 100
+        assert off.elapsed_s < on.elapsed_s * 2 + 0.5
+        # The stage metrics stay on either way: breakdowns survive
+        # tracing-disabled runs (what bench.py relies on).
+        assert REQUIRED_STAGES <= set(off.stages)
+
+
+# -- the daemon surface: /debug/traces + propagation ------------------------
+
+class TestDebugTraces:
+    def test_batch_stages_and_apiserver_propagation_over_http(self):
+        """Acceptance: /debug/traces on the scheduler daemon returns
+        Chrome trace-event JSON containing all eight stages for a
+        scheduled batch, with the trace id propagated into the
+        apiserver-side request spans for the same batch's bind calls."""
+        from kubernetes_tpu.api.types import node_to_json, pod_to_json
+        from kubernetes_tpu.apiserver.memstore import MemStore
+        from kubernetes_tpu.apiserver.server import serve
+        from kubernetes_tpu.scheduler.__main__ import _status_mux
+        from kubernetes_tpu.scheduler.factory import ConfigFactory
+        store = MemStore()
+        srv = serve(store, port=0)
+        api_url = f"http://127.0.0.1:{srv.server_address[1]}"
+        for i in range(3):
+            store.create("nodes",
+                         node_to_json(make_node(f"tn{i}", milli_cpu=4000)))
+        factory = ConfigFactory(api_url, qps=5000, burst=5000).run()
+        mux = _status_mux(factory, {"enableProfiling": True}, 0)
+        mux_url = f"http://127.0.0.1:{mux.server_address[1]}"
+        try:
+            trace.reset()
+            for i in range(6):
+                store.create("pods",
+                             pod_to_json(make_pod(f"tp{i}", cpu="100m")))
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                items, _ = store.list("pods")
+                if items and all((p.get("spec") or {}).get("nodeName")
+                                 for p in items):
+                    break
+                time.sleep(0.05)
+            factory.daemon.wait_for_binds()
+            time.sleep(0.2)  # let the async bind span land in the ring
+
+            status, body = _fetch(mux_url + "/debug/traces")
+            assert status == 200
+            events = json.loads(body)["traceEvents"]
+            names = {e["name"] for e in events}
+            assert REQUIRED_STAGES <= names, \
+                f"missing stages: {REQUIRED_STAGES - names}"
+            roots = [e for e in events if e["name"] == "schedule_batch"]
+            assert roots, "no batch root span"
+            root_ids = {e["args"]["trace_id"] for e in roots}
+            # Stage spans belong to batch traces.
+            for stage_name in REQUIRED_STAGES:
+                stage_events = [e for e in events
+                                if e["name"] == stage_name]
+                assert any(e["args"]["trace_id"] in root_ids
+                           for e in stage_events), \
+                    f"stage {stage_name} not on a batch trace"
+            # The SAME trace id shows up in the apiserver's request spans
+            # for the batch's bind calls (propagated via traceparent; the
+            # in-thread server shares this process's ring, so both
+            # /debug/traces endpoints serve it).
+            _, api_body = _fetch(api_url + "/debug/traces")
+            api_events = json.loads(api_body)["traceEvents"]
+            bind_spans = [e for e in api_events
+                          if e["name"] == "apiserver.request"
+                          and e["args"].get("resource") == "bindings"]
+            assert bind_spans, "no apiserver bind request spans"
+            assert any(e["args"]["trace_id"] in root_ids
+                       for e in bind_spans), \
+                "bind request spans not linked to the batch trace"
+        finally:
+            factory.stop()
+            mux.shutdown()
+            srv.shutdown()
+
+
+# -- decisions: flight recorder endpoint + kubectl explain ------------------
+
+class TestDecisions:
+    def _rig(self):
+        from kubernetes_tpu.engine.generic_scheduler import GenericScheduler
+        from kubernetes_tpu.scheduler.scheduler import (Scheduler,
+                                                        SchedulerConfig)
+        algo = GenericScheduler()
+        for i in range(3):
+            algo.cache.add_node(make_node(f"dn{i}", milli_cpu=2000))
+        return Scheduler(SchedulerConfig(algorithm=algo, async_bind=False))
+
+    def test_unschedulable_pod_is_explained_with_predicate_counts(self):
+        """Acceptance: /debug/scheduler/decisions explains an
+        unschedulable pod with per-predicate failure counts."""
+        daemon = self._rig()
+        for i in range(3):
+            daemon.enqueue(make_pod(f"dp{i}", cpu="100m"))
+        daemon.enqueue(make_pod("dhuge", cpu="64000m"))
+        assert daemon.schedule_pending(wait_first=False) == 4
+        daemon.wait_for_binds()
+        rec = daemon.config.flight_recorder
+        decision = rec.explain("default/dhuge")
+        assert decision["result"] == "unschedulable"
+        assert decision["failed_predicates"].get("PodFitsResources") == 3
+        assert decision["reason"] == "FailedScheduling"
+        assert len(decision["top_scores"]) > 0
+        ok = rec.explain("default/dp0")
+        assert ok["result"] == "scheduled"
+        assert ok["node"] in {"dn0", "dn1", "dn2"}
+        # The batch trace id links the decision to its spans.
+        assert ok["trace_id"]
+
+    def test_decisions_http_endpoint_and_kubectl_explain(self):
+        from kubernetes_tpu.scheduler.__main__ import _decisions_route
+        daemon = self._rig()
+        daemon.enqueue(make_pod("whale", cpu="64000m"))
+        daemon.schedule_pending(wait_first=False)
+        daemon.wait_for_binds()
+
+        # The endpoint body, without/with ?pod=.
+        code, body, ctype = _decisions_route(daemon, "")
+        assert code == 200 and ctype == "application/json"
+        summary = json.loads(body)
+        assert summary["batches"][0]["failed"] == 1
+        code, body, _ = _decisions_route(daemon, "pod=default/whale")
+        assert code == 200
+        decision = json.loads(body)
+        assert decision["result"] == "unschedulable"
+        assert "PodFitsResources" in decision["failed_predicates"]
+        code, _, _ = _decisions_route(daemon, "pod=default/ghost")
+        assert code == 404
+
+        # kubectl explain against a live mux serving this daemon.
+        from kubernetes_tpu.utils.debugmux import serve_status_mux
+        mux = serve_status_mux(
+            port=0,
+            extra={"/debug/scheduler/decisions":
+                   lambda path, q: _decisions_route(daemon, q)})
+        try:
+            from kubernetes_tpu.kubectl.__main__ import main as kubectl
+            mux_url = f"http://127.0.0.1:{mux.server_address[1]}"
+            out = io.StringIO()
+            rc = kubectl(["-s", "http://unused.invalid", "explain",
+                          "pod", "whale", "--scheduler", mux_url], out=out)
+            assert rc == 0
+            text = out.getvalue()
+            assert "unschedulable" in text
+            assert "PodFitsResources" in text
+            # JSON output mode and the not-found path.
+            out = io.StringIO()
+            rc = kubectl(["-s", "http://unused.invalid", "explain",
+                          "pod", "whale", "--scheduler", mux_url,
+                          "-o", "json"], out=out)
+            assert rc == 0
+            assert json.loads(out.getvalue())["result"] == "unschedulable"
+            rc = kubectl(["-s", "http://unused.invalid", "explain",
+                          "pod", "ghost", "--scheduler", mux_url],
+                         out=io.StringIO())
+            assert rc == 1
+        finally:
+            mux.shutdown()
+
+    def test_bind_conflict_demotes_recorded_decision(self):
+        """A bind failure arriving after the batch record amends it: the
+        pod's decision flips to unschedulable with the bind reason."""
+        daemon = self._rig()
+
+        class ConflictBinder:
+            def bind(self, pod, node_name):
+                from kubernetes_tpu.scheduler.binder import BindConflict
+                raise BindConflict(f"pod {pod.key} already bound")
+
+        daemon.config.binder = ConflictBinder()
+        daemon.enqueue(make_pod("cbind", cpu="100m"))
+        daemon.schedule_pending(wait_first=False)
+        daemon.wait_for_binds()
+        decision = daemon.config.flight_recorder.explain("default/cbind")
+        assert decision["result"] == "unschedulable"
+        assert "Binding rejected" in decision["message"]
+        attempts = daemon.config.metrics.scheduling_attempts
+        assert attempts.labels(result="bind_conflict").value >= 1
+
+    def test_explain_cooldown_bounds_device_work(self):
+        """A pod requeued by backoff is not re-explained within the 30 s
+        cooldown window (the detail pass costs a device evaluation)."""
+        daemon = self._rig()
+        calls = []
+        orig = daemon.config.algorithm.explain_failures
+
+        def counting(pods):
+            calls.append(len(pods))
+            return orig(pods)
+
+        daemon.config.algorithm.explain_failures = counting
+        pod = make_pod("cool", cpu="64000m")
+        daemon.enqueue(pod)
+        daemon.schedule_pending(wait_first=False)
+        pod.node_name = ""
+        daemon.enqueue(pod)
+        daemon.schedule_pending(wait_first=False)
+        assert calls == [1]
+        # The cooled-down re-drain must neither shadow the explained
+        # detail nor churn the ring with duplicate single-pod records.
+        decision = daemon.config.flight_recorder.explain("default/cool")
+        assert "PodFitsResources" in decision["failed_predicates"]
+        snap = daemon.config.flight_recorder.snapshot()
+        assert len(snap["batches"]) == 1

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Metric-inventory drift check: every metric registered in code must be
+listed in ARCHITECTURE.md's Observability inventory, and vice versa.
+
+The docs ratchet (tools/sync_bench_docs.py) exists because hand-edited
+numbers drifted three rounds running; metric names drift the same way — a
+counter added in code but absent from the inventory is invisible to
+operators, and a documented metric that no code registers is a lie.  This
+check runs in the tier-1 suite (tests/test_metrics_inventory.py) alongside
+the bench-docs ratchet.
+
+Code side: every ``Counter(``/``Gauge(``/``Histogram(`` construction with a
+literal name under ``kubernetes_tpu/``.  Docs side: backticked names in
+inventory table rows (``| `name` | ...``) of the ARCHITECTURE.md
+"Observability" section.
+
+Usage: ``python tools/check_metrics.py`` — exit 1 + a diff on drift.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# A literal first argument to a metric constructor.  \s* spans newlines:
+# registrations wrap (register(Counter(\n    "name", ...)).
+_CODE_RE = re.compile(
+    r"\b(?:Counter|Gauge|Histogram)\(\s*\"([a-z][a-z0-9_]+)\"")
+
+_DOC_ROW_RE = re.compile(r"^\|\s*`([a-z][a-z0-9_]+)`\s*\|", re.MULTILINE)
+
+
+def metrics_in_code() -> set[str]:
+    names: set[str] = set()
+    pkg = os.path.join(REPO, "kubernetes_tpu")
+    for dirpath, _, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                names.update(_CODE_RE.findall(f.read()))
+    return names
+
+
+def metrics_in_docs() -> set[str]:
+    with open(os.path.join(REPO, "ARCHITECTURE.md")) as f:
+        text = f.read()
+    m = re.search(r"^## Observability$(.*?)(?=^## |\Z)", text,
+                  re.MULTILINE | re.DOTALL)
+    if m is None:
+        return set()
+    return set(_DOC_ROW_RE.findall(m.group(1)))
+
+
+def main() -> int:
+    code = metrics_in_code()
+    docs = metrics_in_docs()
+    if not docs:
+        print("ARCHITECTURE.md has no '## Observability' metric inventory",
+              file=sys.stderr)
+        return 1
+    missing_from_docs = sorted(code - docs)
+    missing_from_code = sorted(docs - code)
+    if missing_from_docs:
+        print("registered in code but missing from the ARCHITECTURE.md "
+              "inventory:", file=sys.stderr)
+        for name in missing_from_docs:
+            print(f"  {name}", file=sys.stderr)
+    if missing_from_code:
+        print("listed in the ARCHITECTURE.md inventory but registered "
+              "nowhere in code:", file=sys.stderr)
+        for name in missing_from_code:
+            print(f"  {name}", file=sys.stderr)
+    if missing_from_docs or missing_from_code:
+        return 1
+    print(f"metric inventory in sync ({len(code)} metrics)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

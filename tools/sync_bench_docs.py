@@ -115,6 +115,14 @@ def render_readme(tag: str, parsed: dict) -> str:
     return "\n".join(lines)
 
 
+def _stage_cell(stages: dict) -> str:
+    """'solve 0.42 s · bind 0.31 s · ...' — stages sorted by time desc."""
+    items = sorted(stages.items(),
+                   key=lambda kv: -kv[1].get("seconds", 0.0))
+    return " · ".join(f"{name} {d.get('seconds', 0.0):.2f} s"
+                      for name, d in items)
+
+
 def render_arch(tag: str, parsed: dict) -> str:
     pods, nodes = _shape(parsed)
     pps = parsed["value"]
@@ -134,6 +142,14 @@ def render_arch(tag: str, parsed: dict) -> str:
             f"process, live pod arrivals, binds at QPS 5000) | "
             f"{wire['elapsed_s']:.1f} s ≈ {wire['pods_per_second']:,.0f} "
             f"pods/s | ~{wire['pods_per_second'] / 8:,.0f}× |")
+    # Per-stage breakdown rows (artifacts produced before the stage
+    # histogram existed simply omit them).
+    if parsed.get("stages"):
+        rows.append(f"| ↳ density stage breakdown | "
+                    f"{_stage_cell(parsed['stages'])} | — |")
+    if wire and wire.get("stages"):
+        rows.append(f"| ↳ wire stage breakdown (daemon side) | "
+                    f"{_stage_cell(wire['stages'])} | — |")
     lines = [f"Numbers from `{tagc}.json` (best of "
              f"{len(parsed.get('runs', [1]))}; median "
              f"{parsed.get('median', parsed['value']):,.0f} pods/s):", ""]
