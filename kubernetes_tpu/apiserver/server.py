@@ -446,6 +446,16 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                 if len(parts) == 5 and parts[2] == "namespaces" and \
                         parts[4] == "bindings":
                     ns = parts[3]
+                    if isinstance(body.get("triples"), list):
+                        # Compact bulk-bind fast path: [ns, pod, node]
+                        # rows, no per-item Binding scaffolding to parse.
+                        self._do_bind_triples([
+                            ((t[0] if len(t) > 0 and t[0] else ns),
+                             t[1] if len(t) > 1 else "",
+                             t[2] if len(t) > 2 else "")
+                            for t in body["triples"]
+                            if isinstance(t, (list, tuple))])
+                        return
                     if isinstance(body.get("items"), list):
                         self._do_bind_list(ns, body["items"])
                         return
@@ -563,6 +573,11 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                 triples.append((meta.get("namespace") or default_ns,
                                 meta.get("name", ""),
                                 (it.get("target") or {}).get("name", "")))
+            self._do_bind_triples(triples)
+
+        def _do_bind_triples(self, triples: list) -> None:
+            """Bulk CAS over fully-resolved (ns, pod, node) rows; callers
+            default empty namespaces before reaching here."""
             errors = store.bind_many(triples)
             failed = sum(1 for e in errors if e is not None)
             if failed == 0:

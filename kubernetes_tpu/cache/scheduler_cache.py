@@ -74,6 +74,17 @@ class SchedulerCache:
         self._ep: Optional[fc.ExistingPodTensors] = None
         self._dirty_nodes = True
         self.generation = 0
+        # Device-residency protocol: ``tensor_epoch`` bumps whenever row
+        # identity changes (full rebuild, node append — the [N, ...]
+        # shapes or the row->node mapping moved), telling the device
+        # mirror (engine/solver.ResidentCluster) to re-upload everything.
+        # ``_dirty_rows`` collects the row indices whose CONTENT changed
+        # in place (node updates, pod attach/detach aggregates) since the
+        # mirror last synced; the engine consumes it under self.lock via
+        # take_dirty_rows().  One device mirror per cache, by design —
+        # the same 1:1 engine/cache pairing _compile already assumes.
+        self.tensor_epoch = 0
+        self._dirty_rows: set[int] = set()
         # Churn observability: full rebuilds vs incremental row updates.
         self.stats = {"rebuilds": 0, "rebuild_s": 0.0,
                       "incremental_node_updates": 0}
@@ -90,16 +101,19 @@ class SchedulerCache:
             self._mark_nodes_dirty()
         elif known:
             # Duplicate ADDED (relist Replace): treat as update in place.
-            fc.update_node_row(self._nt, self._nt.name_to_idx[node.name],
-                               node, self.space)
+            idx = self._nt.name_to_idx[node.name]
+            fc.update_node_row(self._nt, idx, node, self.space)
+            self._dirty_rows.add(idx)
             self.stats["incremental_node_updates"] += 1
             self.generation += 1
         else:
             # Incremental append: one new row across the node tensors +
             # zero aggregates; no 5k-row recompile per joining node.
+            # Capacity growth: the device mirror re-uploads (epoch bump).
             fc.append_node_row(self._nt, node, self.space)
             fc.append_aggregate_row(self._agg)
             self._node_order.append(node.name)
+            self.tensor_epoch += 1
             self.stats["incremental_node_updates"] += 1
             self.generation += 1
 
@@ -121,6 +135,7 @@ class SchedulerCache:
             # snapshot + feature compile + the device transfer; after the
             # transfer the solver reads device copies, not these arrays.
             fc.update_node_row(self._nt, idx, node, self.space)
+            self._dirty_rows.add(idx)
             self.stats["incremental_node_updates"] += 1
             self.generation += 1
 
@@ -218,6 +233,7 @@ class SchedulerCache:
                     self._agg, idxs, pods, self.space)
             self._ep = fc.existing_pods_add_bulk(
                 self._ep, pods, idxs, self.space)
+            self._dirty_rows.update(idxs)
         self.generation += len(assignments)
         return skipped
 
@@ -365,6 +381,7 @@ class SchedulerCache:
                 return
             self._agg = fc.add_pod_to_aggregates(self._agg, idx, pod, self.space)
             self._ep = fc.existing_pods_add(self._ep, pod, idx, self.space)
+            self._dirty_rows.add(idx)
         self.generation += 1
 
     def _detach(self, pod: api.Pod) -> None:
@@ -381,6 +398,7 @@ class SchedulerCache:
                 self._agg = fc.remove_pod_from_aggregates(
                     self._agg, idx, pod, self.space, list(pods.values()))
                 self._ep = fc.existing_pods_remove(self._ep, pod.key)
+                self._dirty_rows.add(idx)
         self.generation += 1
 
     def _ensure_tensors(self) -> None:
@@ -410,8 +428,23 @@ class SchedulerCache:
             self._ep = fc.existing_pods_add_bulk(
                 self._ep, pods, idxs, self.space)
         self._dirty_nodes = False
+        # Relist/rebuild: row identity moved — the device mirror must
+        # re-upload; any pending per-row deltas are subsumed.
+        self.tensor_epoch += 1
+        self._dirty_rows.clear()
         self.stats["rebuilds"] += 1
         self.stats["rebuild_s"] += time.perf_counter() - t0
+
+    @_locked
+    def take_dirty_rows(self) -> set[int]:
+        """Row indices mutated in place since the last take, cleared on
+        read — the device mirror's incremental-update feed.  Call in the
+        same locked section as ``snapshot()`` (the engine's _compile
+        holds ``self.lock`` across both) so the row set and the row
+        contents are one consistent generation."""
+        dirty = self._dirty_rows
+        self._dirty_rows = set()
+        return dirty
 
     @_locked
     def snapshot(self) -> tuple[fc.NodeTensors, fc.NodeAggregates,

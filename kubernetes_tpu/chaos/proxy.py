@@ -23,7 +23,9 @@ Faults (``Rule.fault``):
 
 Rules match on ``method`` (empty = any) and ``path`` (regex, searched in
 the full request target including the query string), fire with
-``probability``, and at most ``count`` times (-1 = unlimited).
+``probability`` — or deterministically on every ``every_nth`` matching
+request (the Nth, 2Nth, ... match fires; 0 = off) — and at most
+``count`` times (-1 = unlimited).
 
 Admin endpoints (served by the proxy itself, never faulted):
 
@@ -71,6 +73,7 @@ class Rule:
     path: str = ""            # regex searched in the full request target
     probability: float = 1.0
     count: int = -1           # max fires; -1 = unlimited
+    every_nth: int = 0        # fire on every Nth matching request (0 = off)
     status: int = 500         # for fault="error"
     body: str = ""            # error body ("" = a default message)
     retry_after: float | None = None   # Retry-After header seconds
@@ -78,6 +81,7 @@ class Rule:
     after_events: int = 0     # for fault="cut-stream": events to pass first
     id: int = 0
     fired: int = 0
+    seen: int = 0             # matching requests observed (every_nth cadence)
 
     def __post_init__(self):
         if self.fault not in _FAULTS:
@@ -100,8 +104,9 @@ class Rule:
     @classmethod
     def from_json(cls, d: dict) -> "Rule":
         known = {k: d[k] for k in (
-            "fault", "method", "path", "probability", "count", "status",
-            "body", "retry_after", "delay_s", "after_events") if k in d}
+            "fault", "method", "path", "probability", "count", "every_nth",
+            "status", "body", "retry_after", "delay_s", "after_events")
+            if k in d}
         return cls(**known)
 
 
@@ -197,6 +202,12 @@ class ChaosProxy:
             for rule in self._rules:
                 if rule.count == 0 or not rule.matches(method, target):
                     continue
+                if rule.every_nth:
+                    # Deterministic cadence: the Nth, 2Nth, ... matching
+                    # request fires (e.g. "409 every 3rd bind").
+                    rule.seen += 1
+                    if rule.seen % rule.every_nth:
+                        continue
                 if rule.probability < 1.0 and \
                         random.random() >= rule.probability:
                     continue

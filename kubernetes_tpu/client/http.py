@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
 import queue
 import random
 import socket
@@ -186,6 +187,11 @@ class APIClient:
         self._port = parsed.port or (443 if self._scheme == "https"
                                      else 80)
         self._local = threading.local()
+        # Lazy bind_list pipeline workers; creation is locked because
+        # concurrent async-bind threads share this client and a lost
+        # race would orphan a ThreadPoolExecutor for process lifetime.
+        self._bind_pool = None
+        self._bind_pool_lock = threading.Lock()
 
     def clone(self, qps: float = DEFAULT_QPS,
               burst: int = DEFAULT_BURST) -> "APIClient":
@@ -367,22 +373,72 @@ class APIClient:
             {"apiVersion": "policy/v1alpha1", "kind": "Eviction",
              "metadata": {"name": pod_name, "namespace": namespace}})
 
-    def bind_list(self, bindings: list[tuple[str, str, str]]
+    # bind_list request shaping: bindings per POST (bounds request size
+    # and keeps per-item results cheap server-side) and the number of
+    # concurrent in-flight chunk POSTs, each on its own per-thread
+    # keep-alive connection.
+    BIND_CHUNK = 4096
+    BIND_PIPELINE = int(os.environ.get("KT_BIND_PIPELINE", "4") or "4")
+
+    def bind_list(self, bindings: list[tuple[str, str, str]],
+                  chunk_size: Optional[int] = None
                   ) -> list[Optional[tuple[int, str]]]:
-        """Batch bindings: one POST carrying a Binding list; the server
-        runs the same per-pod CAS as N single POSTs and returns a
-        per-item ``(status_code, error)`` (None = bound).  The code
-        matters to the caller: a 409 CAS conflict and a 404 require
-        different handling/counting.  This is the wire-gap lever: the
-        engine decides in multi-thousand-pod chunks, and one request per
-        chunk replaces one request per pod."""
+        """Batch bindings: POSTs carrying compact ``triples`` Binding
+        lists; the server runs the same per-pod CAS as N single POSTs and
+        returns a per-item ``(status_code, error)`` (None = bound).  The
+        code matters to the caller: a 409 CAS conflict and a 404 require
+        different handling/counting.
+
+        This is the wire-gap lever twice over: one request per chunk
+        replaces one request per pod, and when the list spans several
+        chunks the chunk POSTs are PIPELINED over up to ``BIND_PIPELINE``
+        persistent connections instead of waiting out each round-trip —
+        the server CASes chunk k while chunk k+1's bytes are in flight.
+        Results come back in input order regardless.
+
+        Failure granularity is PER CHUNK: a transport fault (or a
+        whole-request HTTP error) on one pipelined chunk yields
+        ``(0, reason)`` for exactly that chunk's items — the other
+        in-flight chunks' results stand, and the caller retries/requeues
+        only the affected pods (code 0 = "delivery unknown", distinct
+        from every real per-item CAS status)."""
         if not bindings:
             return []
+        chunk_size = chunk_size or self.BIND_CHUNK
+        if len(bindings) <= chunk_size:
+            return self._bind_list_chunk(bindings)
+        chunks = [bindings[i:i + chunk_size]
+                  for i in range(0, len(bindings), chunk_size)]
+        if self._bind_pool is None:
+            with self._bind_pool_lock:
+                if self._bind_pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+                    self._bind_pool = ThreadPoolExecutor(
+                        max_workers=max(self.BIND_PIPELINE, 1),
+                        thread_name_prefix="bind-list")
+
+        def one_chunk(chunk):
+            try:
+                return self._bind_list_chunk(chunk)
+            except Exception as err:  # noqa: BLE001 — isolate the chunk
+                return [(0, f"bulk bind chunk failed: {err}")] * len(chunk)
+
+        out: list[Optional[tuple[int, str]]] = []
+        # Executor.map preserves chunk order, so per-item results stay
+        # positionally attributable to their bindings.
+        for res in self._bind_pool.map(one_chunk, chunks):
+            out.extend(res)
+        return out
+
+    def _bind_list_chunk(self, bindings: list[tuple[str, str, str]]
+                         ) -> list[Optional[tuple[int, str]]]:
+        """One bulk-bind POST.  The compact ``triples`` form ([namespace,
+        pod, node] rows) is the bulk-bind fast path both servers parse
+        without per-item object scaffolding — ~3x fewer request bytes
+        than the Binding-object ``items`` form it supersedes."""
         resp = self._request("POST", "/api/v1/namespaces/default/bindings", {
             "kind": "BindingList",
-            "items": [{"metadata": {"name": pod, "namespace": ns},
-                       "target": {"kind": "Node", "name": node}}
-                      for ns, pod, node in bindings]})
+            "triples": [[ns, pod, node] for ns, pod, node in bindings]})
         if resp.get("failed") == 0:
             # Success fast path: the server omits per-item results when
             # every bind landed (nothing to detail).
@@ -473,22 +529,43 @@ class HTTPWatcher:
         self._thread.start()
 
     def _pump(self) -> None:
+        # Decode fast path: bulk read1() into ONE reused bytearray and
+        # json.loads straight off the line slices, instead of the
+        # per-line readline() -> str dance (each line there paid a
+        # buffered-readline call plus strip/str copies — reflector-thread
+        # GIL time stolen from the solve at density event rates).
         try:
-            for line in self._resp:
-                if self._stopped.is_set():
+            q_put = self._q.put
+            kind = self.kind
+            buf = bytearray()
+            while True:
+                chunk = self._resp.read1(65536)
+                if not chunk or self._stopped.is_set():
                     break
-                line = line.strip()
-                if not line:
-                    continue
-                d = json.loads(line)
-                obj = d.get("object") or {}
-                meta = obj.get("metadata") or {}
-                ns = meta.get("namespace")
-                key = f"{ns}/{meta.get('name')}" if ns else meta.get("name")
-                self._q.put(Event(
-                    type=d.get("type", ""), kind=self.kind, key=key or "",
-                    object=obj,
-                    rv=int(meta.get("resourceVersion", "0") or "0")))
+                buf += chunk
+                start = 0
+                while True:
+                    nl = buf.find(b"\n", start)
+                    if nl < 0:
+                        break
+                    end = nl - 1 if nl > start and buf[nl - 1] == 0x0d \
+                        else nl  # trim one \r without a strip() copy
+                    line = bytes(memoryview(buf)[start:end])
+                    start = nl + 1
+                    if not line:
+                        continue  # heartbeat
+                    d = json.loads(line)
+                    obj = d.get("object") or {}
+                    meta = obj.get("metadata") or {}
+                    ns = meta.get("namespace")
+                    key = f"{ns}/{meta.get('name')}" if ns \
+                        else meta.get("name")
+                    q_put(Event(
+                        type=d.get("type", ""), kind=kind, key=key or "",
+                        object=obj,
+                        rv=int(meta.get("resourceVersion", "0") or "0")))
+                if start:
+                    del buf[:start]
         except Exception:  # noqa: BLE001 — stream died: deliver EOF
             pass
         finally:

@@ -1,0 +1,87 @@
+"""Persistent XLA compilation cache configuration.
+
+Every scheduler start used to pay the full XLA compile tax (19.6 s cold,
+3.8-10.9 s "warm" per BENCH_r05) because jit executables lived only in
+process memory.  This module points JAX's persistent compilation cache at
+a per-machine directory so the cost is paid once per (machine, jaxlib,
+program) and every later start deserializes the executables instead of
+re-running XLA:
+
+* default location: ``~/.cache/kubernetes_tpu/xla``
+* ``KT_COMPILE_CACHE=<dir>`` overrides the directory
+* ``KT_COMPILE_CACHE=0`` (or ``off``/``none``/``disabled``) disables it
+
+The cache thresholds are dropped to zero so *every* executable persists —
+the drain path's small shapes (the stream bucket ladder, the explain-pass
+batch) individually compile in under JAX's default 1 s floor but add up
+to the multi-second warm-start stall the ladder pre-warm then re-pays.
+
+``configure()`` is idempotent and must run before the first jit trace to
+cover it; ``GenericScheduler.__init__`` calls it, which puts it ahead of
+every Solver executable in every rig (daemon, bench, tests).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+DEFAULT_CACHE_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "kubernetes_tpu", "xla")
+
+_DISABLED_VALUES = ("0", "off", "none", "disabled", "false")
+
+_lock = threading.Lock()
+_configured = False
+_dir: Optional[str] = None
+
+
+def configure() -> Optional[str]:
+    """Point JAX's persistent compilation cache at the per-machine
+    directory (created on demand).  Returns the directory, or None when
+    disabled via ``KT_COMPILE_CACHE=0`` or when the runtime lacks the
+    cache knobs.  Safe to call from any thread, any number of times; the
+    environment is read ONCE — like the stream bucket floor, a mid-run
+    change must not silently split state between two directories."""
+    global _configured, _dir
+    with _lock:
+        if _configured:
+            return _dir
+        _configured = True
+        raw = os.environ.get("KT_COMPILE_CACHE", "").strip()
+        if raw.lower() in _DISABLED_VALUES:
+            return None
+        path = raw or DEFAULT_CACHE_DIR
+        try:
+            os.makedirs(path, exist_ok=True)
+            import jax
+            jax.config.update("jax_compilation_cache_dir", path)
+        except Exception:  # noqa: BLE001 — cache is an optimization only
+            return None
+        # Persist everything: the bucket-ladder scans and explain-pass
+        # shapes each compile below the default 1 s floor but together
+        # are the warm-start stall this cache exists to kill.
+        for knob, value in (
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", 0)):
+            try:
+                jax.config.update(knob, value)
+            except Exception:  # noqa: BLE001 — older jaxlib: best effort
+                pass
+        _dir = path
+        return _dir
+
+
+def cache_dir() -> Optional[str]:
+    """The active cache directory (None = disabled or not configured)."""
+    with _lock:
+        return _dir
+
+
+def _reset_for_tests() -> None:
+    """Drop the idempotence latch (tests exercising the env contract)."""
+    global _configured, _dir
+    with _lock:
+        _configured = False
+        _dir = None

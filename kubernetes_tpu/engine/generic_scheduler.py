@@ -10,6 +10,7 @@ device solve (the TPU win).
 
 from __future__ import annotations
 
+import functools
 import os
 import sys
 import time
@@ -127,9 +128,19 @@ class GenericScheduler:
         self.policy = policy or default_provider()
         self.cache = cache or SchedulerCache()
         self.listers = listers or Listers()
+        # Persistent XLA compilation cache, configured before the first
+        # trace: warm starts deserialize executables instead of paying
+        # the multi-second compile tax again (engine/compile_cache.py).
+        from kubernetes_tpu.engine import compile_cache
+        compile_cache.configure()
         # Shared per policy signature: a fresh Solver per engine would
         # re-trace and re-compile every executable (see Solver.for_policy).
         self.solver = sv.Solver.for_policy(self.policy)
+        # Device-resident cluster mirror: per drain only the cache's
+        # dirty rows are scattered into the resident (nodes x features)
+        # arrays; a full re-upload happens only on relist or capacity
+        # growth (sv.ResidentCluster).
+        self.resident = sv.ResidentCluster()
         self.extenders = [HTTPExtender(cfg) for cfg in self.policy.extenders]
         self.last_node_index = np.uint32(0)
         # Monotonic compile state (features.padcap): table-axis capacities
@@ -196,7 +207,14 @@ class GenericScheduler:
                 # chunks).
                 db = sv.device_batch(batch) if device \
                     else sv.host_batch(batch)
-                dc = sv.device_cluster(nt, agg, self.cache.space)
+                # Cluster state syncs through the device-resident mirror:
+                # dirty rows scatter into the resident arrays; the full
+                # snapshot transfer happens only on relist or capacity
+                # growth.  Same locked section as the snapshot, so the
+                # dirty set and the row contents are one generation.
+                dc = self.resident.sync(nt, agg, self.cache.space,
+                                        self.cache.take_dirty_rows(),
+                                        self.cache.tensor_epoch)
         return batch, db, dc, nt
 
     # -- single-pod path (Schedule, generic_scheduler.go:78) -------------
@@ -422,7 +440,8 @@ class GenericScheduler:
         return out
 
     def schedule_batch_stream(self, pods: list[api.Pod],
-                              chunk_size: int = 2048):
+                              chunk_size: int = 2048,
+                              defer_readback: bool = False):
         """Pipelined batched drain: one host compile, then the scan runs in
         equal-shaped chunks with device-carried state (identical choices to
         ``schedule_batch`` — each chunk continues the previous chunk's
@@ -431,6 +450,13 @@ class GenericScheduler:
         chunk — the double-buffered decide/commit pipeline the reference
         gets from its async-bind goroutine (scheduler.go:122-153), stretched
         over the whole queue.
+
+        With ``defer_readback=True`` each yield is ``(chunk_pods,
+        resolve)`` instead, where ``resolve()`` performs the blocking
+        device->host readback and returns the placements — the daemon's
+        overlapped pipeline calls it on the binder pool so the drain
+        thread never blocks on the device and batch N's scan runs while
+        batch N-1 commits (scheduler.Scheduler._schedule_pending_stream).
 
         The last chunk is padded with inert pods (live=False rows are
         infeasible everywhere and bump no tie counter) so every chunk hits
@@ -443,7 +469,9 @@ class GenericScheduler:
         if not self.cache.nodes():
             for start in range(0, p, chunk_size):
                 chunk = pods[start:start + chunk_size]
-                yield chunk, [None] * len(chunk)
+                empty = [None] * len(chunk)
+                yield (chunk, (lambda c=chunk, e=empty: (c, e))) \
+                    if defer_readback else (chunk, empty)
             return
         n_chunks = (p + chunk_size - 1) // chunk_size
         padded = n_chunks * chunk_size
@@ -506,13 +534,22 @@ class GenericScheduler:
                 t1 = time.perf_counter()
             pending.append((start, choices_k))
             if len(pending) > 1:
-                yield emit(*pending.pop(0))
+                s_k, c_k = pending.pop(0)
+                if defer_readback:
+                    yield (pods[s_k:min(s_k + chunk_size, p)],
+                           functools.partial(emit, s_k, c_k))
+                else:
+                    yield emit(s_k, c_k)
             if debug_t:
                 print(f"KT_STREAM chunk@{start}: put+launch "
                       f"{t1 - t0:.3f}s emit {time.perf_counter() - t1:.3f}s",
                       file=sys.stderr)
         for start, choices_k in pending:
-            yield emit(start, choices_k)
+            if defer_readback:
+                yield (pods[start:min(start + chunk_size, p)],
+                       functools.partial(emit, start, choices_k))
+            else:
+                yield emit(start, choices_k)
         self.last_node_index = np.uint32(counter)
 
     def _schedule_batch_via_extenders(self, pods: list[api.Pod]

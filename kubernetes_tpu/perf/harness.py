@@ -35,6 +35,12 @@ class DensityResult:
     # Per-stage wall-time breakdown of the timed window (seconds +
     # observation counts), harvested from the stage histogram.
     stages: dict = None
+    # Wall time of the pre-clock warm trace (XLA compile or — with the
+    # persistent compilation cache populated — deserialization).  The
+    # first rig's warm_s in a fresh process IS the cold-start compile
+    # tax; bench.py's cold_vs_warm phase re-measures it in a second
+    # process against the populated cache.
+    warm_s: float = 0.0
 
 
 def _stage_snapshot() -> dict:
@@ -78,9 +84,11 @@ def density(num_nodes: int, num_pods: int, profile: str = "uniform",
     daemon path, wall-clock throughput."""
     daemon = _make_daemon(num_nodes, profile, preexisting)
     pods = synth.make_pods(num_pods, profile=profile)
+    warm_s = 0.0
     if warm:
         # Pre-trace the device program at the batch shape (first XLA compile
         # is excluded like the reference excludes apiserver warmup).
+        t_warm = time.perf_counter()
         alg = daemon.config.algorithm
         if num_pods >= daemon.STREAM_THRESHOLD and not alg.extenders:
             for _ in alg.schedule_batch_stream(
@@ -88,6 +96,7 @@ def density(num_nodes: int, num_pods: int, profile: str = "uniform",
                 pass
         else:
             alg.schedule_batch(pods)
+        warm_s = time.perf_counter() - t_warm
     for pod in pods:
         daemon.enqueue(pod)
     stages_before = _stage_snapshot()
@@ -106,7 +115,28 @@ def density(num_nodes: int, num_pods: int, profile: str = "uniform",
         num_nodes=num_nodes, num_pods=num_pods, elapsed_s=elapsed,
         scheduled=scheduled, pods_per_second=scheduled / elapsed,
         algorithm_ms_per_pod=elapsed / max(scheduled, 1) * 1e3,
-        stages=stages)
+        stages=stages, warm_s=warm_s)
+
+
+def warm_start_compile_s(num_nodes: int, num_pods: int,
+                         profile: str = "uniform") -> float:
+    """Build the density rig and time ONLY the warm trace — the
+    warm-start compile cost.  Run in a fresh process after a prior run
+    populated the persistent compilation cache (engine/compile_cache),
+    this measures what a daemon restart actually pays before its first
+    drain; ``python -m kubernetes_tpu.perf.harness --warm-only`` prints
+    it as JSON for bench.py's cold_vs_warm phase."""
+    daemon = _make_daemon(num_nodes, profile)
+    pods = synth.make_pods(num_pods, profile=profile)
+    alg = daemon.config.algorithm
+    t0 = time.perf_counter()
+    if num_pods >= daemon.STREAM_THRESHOLD and not alg.extenders:
+        for _ in alg.schedule_batch_stream(
+                pods, chunk_size=daemon.stream_chunk_size()):
+            pass
+    else:
+        alg.schedule_batch(pods)
+    return time.perf_counter() - t0
 
 
 @dataclass
@@ -231,9 +261,8 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         # overhead) for every fragment the creators happen to land.
         daemon.accumulate_s = float(_os.environ.get("KT_WIRE_ACCUM", "3.0"))
 
-        # Warm that one shape before the clock (the reference excludes
-        # apiserver warmup the same way); the cold-compile cost is
-        # reported, not hidden.
+        # Warm before the clock (the reference excludes apiserver warmup
+        # the same way); the cold-compile cost is reported, not hidden.
         t_warm = time.perf_counter()
         pods = synth.make_pods(num_pods, profile=profile)
         # Pre-intern the LIVE pod set's vocabulary (ports/volumes/taints/
@@ -241,16 +270,16 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         # mid-run would re-specialize the scan on the clock (measured
         # ~10 s of XLA recompiles on the first live drain otherwise).
         factory.algorithm._compile(pods, device=False)
-        # 2*chunk pods: warms BOTH full-chunk jit specializations (the
-        # first chunk carries no state dict, later chunks do — two
-        # distinct signatures); any shape first seen mid-run would
-        # XLA-compile on the clock (~5 s).
+        # Trace the full bucket ladder (floor -> wire chunk), both jit
+        # signatures per bucket: the arrival race can legally drain any
+        # ladder bucket, and any shape first seen mid-run would
+        # XLA-compile on the clock (~5 s).  With the persistent compile
+        # cache populated this whole pass deserializes in well under a
+        # second; cold, it IS the once-per-machine compile tax.
         warm_pods = synth.make_pods(
             min(num_pods, 2 * daemon.stream_chunk_size()),
             profile=profile, name_prefix="warm")
-        for _ in factory.algorithm.schedule_batch_stream(
-                warm_pods, chunk_size=daemon.stream_chunk_size()):
-            pass
+        daemon.prewarm(sample_pods=warm_pods)
         warm_s = time.perf_counter() - t_warm
 
         pod_jsons = [pod_to_json(pod) for pod in pods]
@@ -317,7 +346,7 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         stalled = False
         timeline: list[tuple[float, int]] = []
         while time.time() < deadline:
-            now_bound = factory.daemon.config.metrics.binding_latency._count
+            now_bound = factory.daemon.config.metrics.binding_latency.count
             timeline.append((time.perf_counter() - start, now_bound))
             if now_bound != bound:
                 bound = now_bound
@@ -332,7 +361,7 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         # On a stall exit the clock stops at the LAST bind, not at stall
         # detection — the tail is idle requeue time of unschedulable pods.
         elapsed = (last_change if stalled else time.perf_counter()) - start
-        bound = factory.daemon.config.metrics.binding_latency._count
+        bound = factory.daemon.config.metrics.binding_latency.count
         if not quiet:
             print(f"density-wire {num_nodes} nodes x {num_pods} pods: "
                   f"{bound} bound in {elapsed:.3f}s = "
@@ -383,8 +412,18 @@ def main() -> None:
     ap.add_argument("--preexisting", type=int, default=0)
     ap.add_argument("--bench-matrix", action="store_true",
                     help="run the BenchmarkScheduling matrix instead")
+    ap.add_argument("--warm-only", action="store_true",
+                    help="build the rig, time ONLY the warm trace, print "
+                         "{'warm_s': ...} — the warm-start compile cost "
+                         "against the persistent compilation cache")
     opts = ap.parse_args()
-    if opts.bench_matrix:
+    if opts.warm_only:
+        from kubernetes_tpu.engine import compile_cache
+        warm = warm_start_compile_s(opts.nodes, opts.pods,
+                                    profile=opts.profile)
+        print(json.dumps({"warm_s": round(warm, 3),
+                          "compile_cache_dir": compile_cache.cache_dir()}))
+    elif opts.bench_matrix:
         results = benchmark_scheduling()
         print(json.dumps([r.__dict__ for r in results]))
     else:

@@ -24,12 +24,12 @@ from typing import Optional, Union
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.api.policy import Policy
-from kubernetes_tpu.apiserver.memstore import ConflictError, MemStore
-from kubernetes_tpu.client.http import APIError
+from kubernetes_tpu.apiserver.memstore import MemStore
 from kubernetes_tpu.cache.scheduler_cache import CLEANUP_PERIOD
 from kubernetes_tpu.client.http import APIClient
 from kubernetes_tpu.client.reflector import Reflector
 from kubernetes_tpu.engine.generic_scheduler import GenericScheduler, Listers
+from kubernetes_tpu.scheduler.binder import APIClientBinder
 from kubernetes_tpu.scheduler.scheduler import Scheduler, SchedulerConfig
 from kubernetes_tpu.utils.events import EventRecorder
 from kubernetes_tpu.utils.logging import get_logger
@@ -45,95 +45,6 @@ class MemStoreBinder:
 
     def bind(self, pod: api.Pod, node_name: str) -> None:
         self.store.bind(pod.namespace, pod.name, node_name)
-
-
-class APIClientBinder:
-    """Binder over the wire (factory.go:576-587 POST bindings).
-
-    The batched path rides the batch-bind subresource: the engine decides
-    in multi-thousand-pod chunks, so each chunk becomes ONE request whose
-    per-pod CAS results map back to (pod, err) failures — measured at
-    density rates, per-pod POSTs through 16 threads were the wire
-    bottleneck (98 % of engine throughput died at the process boundary).
-    A transport failure on the batch request falls back to per-pod binds
-    through a persistent thread pool so partial progress survives a flaky
-    connection."""
-
-    # Bindings per batch request: bounds request size (~150 B/binding)
-    # and keeps per-item results cheap to build server-side.
-    _BATCH = 4096
-    _POOL = 16  # fallback path concurrency (one goroutine per bind)
-
-    def __init__(self, client: APIClient):
-        self.client = client
-        self._pool = None
-        self._bind_pool = None
-
-    def bind(self, pod: api.Pod, node_name: str) -> None:
-        self.client.bind(pod.namespace, pod.name, node_name)
-
-    def _bind_one(self, item):
-        pod, dest = item
-        try:
-            self.bind(pod, dest)
-            return None
-        except Exception as err:  # noqa: BLE001 — caller requeues
-            return (pod, err)
-
-    def bind_many(self, placed: list) -> list:
-        """Bind a batch; returns [(pod, err)] failures (the CAS conflicts
-        the batched drain forgets + requeues)."""
-        from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATE
-        if not DEFAULT_FEATURE_GATE.enabled("BatchBindings"):
-            # Gated off: the reference's per-bind-goroutine wire behavior.
-            return self._bind_many_fallback(placed)
-        if len(placed) <= 2:
-            return [f for f in map(self._bind_one, placed) if f is not None]
-
-        def bind_chunk(chunk):
-            try:
-                errors = self.client.bind_list(
-                    [(pod.namespace, pod.name, dest)
-                     for pod, dest in chunk])
-            except Exception:  # noqa: BLE001 — transport hiccup
-                return self._bind_many_fallback(chunk)
-            if len(errors) != len(chunk):
-                return self._bind_many_fallback(chunk)
-            # Preserve the per-item status: only a 409 is a CAS conflict;
-            # wrapping a 404 (pod deleted mid-bind) as ConflictError
-            # would invert the conflict/failure metric split downstream.
-            return [(pod, ConflictError(err) if code == 409
-                     else APIError(code, err))
-                    for (pod, _), res in zip(chunk, errors)
-                    if res is not None
-                    for code, err in (res,)]
-
-        chunks = [placed[i:i + self._BATCH]
-                  for i in range(0, len(placed), self._BATCH)]
-        if len(chunks) == 1:
-            return bind_chunk(chunks[0])
-        # A couple of concurrent chunk POSTs (each on its own per-thread
-        # keep-alive connection) overlap this side's request serialization
-        # with the server's CAS work; the per-chunk CAS results stay
-        # positionally attributable exactly as in the sequential loop.
-        if self._bind_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-            self._bind_pool = ThreadPoolExecutor(
-                max_workers=2, thread_name_prefix="bind-chunk")
-        failures: list = []
-        for fs in self._bind_pool.map(bind_chunk, chunks):
-            failures.extend(fs)
-        return failures
-
-    def _bind_many_fallback(self, placed: list) -> list:
-        """Per-pod binds through the persistent pool — each worker keeps
-        its thread-local keep-alive connection across batches."""
-        if self._pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-            self._pool = ThreadPoolExecutor(max_workers=self._POOL,
-                                            thread_name_prefix="binder")
-        return [f for f in self._pool.map(self._bind_one, placed)
-                if f is not None]
 
 
 def make_event_sink(source: Union[MemStore, APIClient]):
@@ -389,6 +300,13 @@ class ConfigFactory:
             r.wait_for_sync()
         log.info("reflectors synced (%d nodes cached); starting loop",
                  len(self.algorithm.cache.nodes()))
+        import os
+        if os.environ.get("KT_PREWARM", "0") not in ("", "0"):
+            # Trace the bucket ladder before the queue opens (opt-in:
+            # interactive rigs keep their startup latency; the perf rigs
+            # and production daemons set KT_PREWARM=1 and, with the
+            # persistent compile cache populated, pay near-zero here).
+            self.daemon.prewarm()
         self._threads.append(self.daemon.run(batched=self.batched))
 
         def ttl_sweep():  # cleanupAssumedPods (cache.go:309-330)

@@ -74,6 +74,21 @@ class Scheduler:
         self.config = config
         self.queue = FIFO()
         self.backoff = PodBackoff()
+        # Stream floor, read ONCE at startup: the pre-warm pass and the
+        # small-drain bucket computation must agree on the ladder for the
+        # daemon's whole lifetime (a later env change would mint shapes
+        # the warmup never traced).
+        self.stream_min_bucket = int(os.environ.get(
+            "KT_STREAM_MIN_BUCKET", str(self.STREAM_MIN_BUCKET))
+            or str(self.STREAM_MIN_BUCKET))
+        # Overlapped solve/bind pipeline: while the device scans chunk N,
+        # chunk N-1's readback/assume/bind runs on a dedicated commit
+        # worker; at most this many chunks are in flight uncommitted
+        # (0 = commit synchronously on the drain thread, the pre-pipeline
+        # behavior).
+        self.pipeline_window = int(os.environ.get(
+            "KT_PIPELINE_WINDOW", "2") or "2")
+        self._commit_pool = None
         # Live queue depth at expose time (a set-per-mutation gauge would
         # put two lock acquisitions on every enqueue).
         config.metrics.queue_depth.set_fn(lambda: len(self.queue))
@@ -159,8 +174,11 @@ class Scheduler:
     # produces drains of 1..700 pods, and the 1,2,4,...,128 ladder minted
     # ~8 scan compiles (~4-8 s each on a small host) before the fleet
     # settled; with the floor the ladder is {256, 512, 1024, 2048}.
-    STREAM_MIN_BUCKET = int(os.environ.get("KT_STREAM_MIN_BUCKET",
-                                           "256") or "256")
+    # The effective value is captured ONCE per daemon in __init__
+    # (self.stream_min_bucket): pre-warm traces the bucket ladder this
+    # floor defines, and an env change after warmup would otherwise mint
+    # unwarmed shapes mid-run.
+    STREAM_MIN_BUCKET = 256
 
     # Arrival-coalescing window (seconds): when a drain pops fewer pods
     # than one stream chunk while more are clearly arriving, linger up to
@@ -242,7 +260,7 @@ class Scheduler:
             # per queue length; floored so the tail of the ladder doesn't
             # either.
             bucket = max(1 << (len(pods) - 1).bit_length(),
-                         self.STREAM_MIN_BUCKET)
+                         self.stream_min_bucket)
             return self._schedule_pending_stream(pods, chunk_size=bucket,
                                                  trace_id=trace_id)
         start = time.perf_counter()
@@ -338,21 +356,138 @@ class Scheduler:
         pre-trace the same shape)."""
         return self.stream_chunk or min(self.STREAM_THRESHOLD, 8192)
 
+    def effective_ladder(self) -> list[int]:
+        """The fixed set of chunk sizes this daemon's drains can compile
+        at — pre-warm traces exactly this set; the drain paths can mint
+        no other.  Two sources: the stream chunk, included only when the
+        chunked path is reachable (STREAM_THRESHOLD set — at its unset
+        sentinel every large drain takes the one-shot schedule_batch
+        path instead, whose shape follows the live queue length and
+        cannot be pre-traced); and the small-drain buckets, reachable
+        for drains below min(STREAM_THRESHOLD, _PAD_LIMIT): the
+        startup-captured floor itself (possibly non-pow2 — every drain
+        at or below it pads to it) plus each pow2 ABOVE the floor up to
+        the pow2 ceiling of the largest such drain (4096 included: a
+        2049-4095-pod drain legally mints it even when the stream chunk
+        is smaller)."""
+        ladder = set()
+        if self.STREAM_THRESHOLD < (1 << 62):
+            ladder.add(self.stream_chunk_size())
+        small_top = min(self.STREAM_THRESHOLD, self._PAD_LIMIT)
+        if small_top > 1:
+            floor = max(self.stream_min_bucket, 1)
+            # pow2 ceiling of the largest small drain (small_top - 1).
+            top_bucket = 1 << max(small_top - 2, 0).bit_length()
+            ladder.add(floor)
+            # Mintable buckets are max(pow2ceil(len), floor): the floor,
+            # then pow2 values strictly above it — doubling the floor
+            # itself would trace unreachable shapes when it is not a
+            # power of two (floor=300 mints {300, 512, ...}, never 600).
+            b = 1 << floor.bit_length()  # smallest pow2 > floor
+            while b <= top_bucket:
+                ladder.add(b)
+                b <<= 1
+        return sorted(ladder)
+
+    def prewarm(self, sample_pods: Optional[list] = None) -> dict:
+        """Trace the full bucket ladder before the queue opens, so no
+        live drain ever pays an XLA compile on the clock.  With the
+        persistent compilation cache populated (engine/compile_cache) the
+        traces deserialize in well under a second each; cold, the cost is
+        paid here once per machine instead of on the first N drains.
+
+        ``sample_pods`` shapes the traced programs (vocab capacities +
+        content flags) like the expected workload; without it a minimal
+        synthetic pod is used.  Each bucket warms BOTH full-chunk jit
+        signatures (first chunk carries no state dict, later chunks do).
+        Returns {bucket: seconds}; no-ops when streaming is off, an
+        extender is configured, or the cluster is empty."""
+        from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATE
+        alg = self.config.algorithm
+        if not DEFAULT_FEATURE_GATE.enabled("StreamingDrain") or \
+                alg.extenders or not alg.cache.nodes():
+            return {}
+        ladder = self.effective_ladder()
+        timings: dict[int, float] = {}
+        for bucket in ladder:
+            want = 2 * bucket  # both scan signatures (no-carry + carry)
+            if sample_pods:
+                pods = list(sample_pods[:want])
+            else:
+                pods = []
+            pods += [api.Pod(name=f"__warm-{i}", namespace="__warm__")
+                     for i in range(want - len(pods))]
+            t0 = time.perf_counter()
+            for _ in alg.schedule_batch_stream(pods, chunk_size=bucket):
+                pass
+            timings[bucket] = time.perf_counter() - t0
+        log.info("pre-warmed stream ladder %s (floor %d, chunk %d): %s",
+                 ladder, self.stream_min_bucket, self.stream_chunk_size(),
+                 {b: f"{s:.2f}s" for b, s in timings.items()})
+        return timings
+
     def _schedule_pending_stream(self, pods: list[api.Pod],
                                  chunk_size: Optional[int] = None,
                                  trace_id: str = "") -> int:
-        """The pipelined drain: as each device chunk lands, bulk-assume it
-        and hand it to an async binder thread while the device scans the
-        next chunk.  Same observable state machine as the one-shot path."""
+        """The overlapped drain: while the device scans chunk N, chunk
+        N-1's readback/assume/bind runs on a single commit worker, with at
+        most ``pipeline_window`` chunks in flight uncommitted.  The one
+        worker keeps chunks committing in solve order, and within a chunk
+        assume completes before its bind fan-out dispatches — the per-pod
+        assume-before-bind ordering of the one-shot path.  Commits are
+        joined before returning, so the caller-observable state machine
+        (every popped pod assumed-or-failed by return) is unchanged."""
         start = time.perf_counter()
-        solve_done = start
-        for chunk_pods, placements in \
-                self.config.algorithm.schedule_batch_stream(
-                    pods, chunk_size=chunk_size or self.stream_chunk_size()):
-            solve_done = time.perf_counter()
-            self._record_batch_decisions(chunk_pods, placements, trace_id,
-                                         solve_done - start)
-            self._assume_and_bind_batch(chunk_pods, placements, start)
+        window = max(self.pipeline_window, 0)
+        chunk = chunk_size or self.stream_chunk_size()
+        if window == 0:
+            solve_done = start
+            for chunk_pods, placements in \
+                    self.config.algorithm.schedule_batch_stream(
+                        pods, chunk_size=chunk):
+                solve_done = time.perf_counter()
+                self._record_batch_decisions(chunk_pods, placements,
+                                             trace_id, solve_done - start)
+                self._assume_and_bind_batch(chunk_pods, placements, start)
+        else:
+            if self._commit_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._commit_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="chunk-commit")
+            sem = threading.BoundedSemaphore(window)
+            ctx = trace_mod.current_context()
+            # A mutable cell: the commit worker stamps when each chunk's
+            # readback landed; the last stamp bounds algorithm latency.
+            solve_done_cell = [start]
+            futures = []
+            err = None
+            try:
+                for _, resolve in \
+                        self.config.algorithm.schedule_batch_stream(
+                            pods, chunk_size=chunk, defer_readback=True):
+                    # Bounded in-flight window: block the drain thread
+                    # (and with it further device launches) until an
+                    # outstanding chunk commits.
+                    sem.acquire()
+                    futures.append(self._commit_pool.submit(
+                        self._commit_chunk, resolve, start, trace_id, sem,
+                        ctx, solve_done_cell))
+            finally:
+                # Join EVERY submitted commit before surfacing anything:
+                # schedule_pending's crash handler requeues pods not yet
+                # assumed, and a still-running commit assuming them
+                # concurrently would double-track the pod.
+                for fut in futures:
+                    try:
+                        fut.result()
+                    except Exception as exc:  # noqa: BLE001 — requeue
+                        err = err or exc
+            if err is not None:
+                # Surface the first commit failure to schedule_pending's
+                # crash handler, which requeues every pod the completed
+                # commits didn't assume.
+                raise err
+            solve_done = solve_done_cell[0]
         # Algorithm latency spans until the LAST chunk's results landed
         # (interleaved assume/bind of earlier chunks overlaps the device
         # and is deliberately excluded, matching the one-shot path).
@@ -360,6 +495,21 @@ class Scheduler:
         self.config.metrics.scheduling_algorithm_latency.observe_many(
             algo_us, len(pods))
         return len(pods)
+
+    def _commit_chunk(self, resolve, start: float, trace_id: str, sem,
+                      trace_ctx, solve_done_cell: list) -> None:
+        """One chunk's commit on the pipeline worker: blocking readback,
+        flight-recorder feed, bulk assume, bind dispatch."""
+        try:
+            with trace_mod.use_context(trace_ctx):
+                chunk_pods, placements = resolve()
+                solve_done_cell[0] = time.perf_counter()
+                self._record_batch_decisions(
+                    chunk_pods, placements, trace_id,
+                    solve_done_cell[0] - start)
+                self._assume_and_bind_batch(chunk_pods, placements, start)
+        finally:
+            sem.release()
 
     # -- run loops --------------------------------------------------------
 
@@ -388,6 +538,8 @@ class Scheduler:
     def stop(self) -> None:
         self._stop.set()
         self.queue.close()
+        if self._commit_pool is not None:
+            self._commit_pool.shutdown(wait=True)
         for t in self._bind_threads:
             t.join(timeout=5)
 

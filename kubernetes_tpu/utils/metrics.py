@@ -53,7 +53,10 @@ class _Family:
         self._lock = threading.Lock()
 
     def labels(self, **kw):
-        """The child metric for this label set (created on first use)."""
+        """The child metric for this label set (created on first use).
+        The steady-state lookup is a lock-free dict read (GIL-atomic) —
+        the drain loop resolves a child per stage observation, and a lock
+        here would serialize it against every /metrics expose."""
         if not self._labelnames:
             raise ValueError(f"{self.name} has no labels")
         try:
@@ -66,6 +69,9 @@ class _Family:
             raise ValueError(
                 f"{self.name} expects labels {self._labelnames}, "
                 f"got {tuple(kw)}")
+        child = self._children.get(key)
+        if child is not None:
+            return child
         with self._lock:
             child = self._children.get(key)
             if child is None:
@@ -93,9 +99,21 @@ class _Family:
 
 
 class Histogram(_Family):
-    """prometheus.Histogram with ExponentialBuckets semantics.  Counts are
-    stored per-bucket (non-cumulative) and cumulated at expose time, so
-    ``observe`` costs one bisect, not a pass over every upper bound."""
+    """prometheus.Histogram with ExponentialBuckets semantics.
+
+    The hot path is LOCK-FREE: ``observe`` is one GIL-atomic list append
+    into a pending-events buffer — the drain loop records a stage
+    observation per pipeline stage per batch, and taking the family lock
+    there serialized the drain against every concurrent /metrics expose.
+    The pending buffer folds into the per-bucket counters (non-cumulative;
+    one bisect per event) under the lock only at read time (expose /
+    ``count`` / ``sum``) or when the buffer passes a size threshold, and
+    buckets are cumulated at expose time as before."""
+
+    # Fold threshold: bounds the pending buffer on a daemon nobody
+    # scrapes (len() is a GIL-atomic read; the occasional fold amortizes
+    # to O(1) per observe).
+    _FOLD_AT = 4096
 
     def __init__(self, name: str, help_text: str,
                  buckets: Iterable[float],
@@ -105,6 +123,11 @@ class Histogram(_Family):
         self._counts = [0] * len(self.uppers)
         self._sum = 0.0
         self._count = 0
+        # Pending events: floats (observe) or (value, count) tuples
+        # (observe_many).  Appends are GIL-atomic; the folder drains a
+        # fixed prefix (copy + del of [:n] are each single bytecode ops),
+        # so appends racing the fold land past n and survive it.
+        self._events: list = []
 
     def _make_child(self, key) -> "Histogram":
         child = Histogram(self.name, self.help, self.uppers)
@@ -113,43 +136,65 @@ class Histogram(_Family):
 
     def observe(self, value: float) -> None:
         self._check_unlabeled()
-        i = bisect_left(self.uppers, value)
-        with self._lock:
-            self._sum += value
-            self._count += 1
-            if i < len(self._counts):
-                self._counts[i] += 1
+        self._events.append(value)
+        if len(self._events) >= self._FOLD_AT:
+            with self._lock:
+                self._fold_locked()
 
     def observe_many(self, value: float, count: int) -> None:
-        """``count`` observations of the same value in one bucket update —
+        """``count`` observations of the same value in one event —
         the batched drain amortizes one solve across the whole batch, so
         every pod records the same per-pod latency."""
         if count <= 0:
             return
         self._check_unlabeled()
-        i = bisect_left(self.uppers, value)
-        with self._lock:
-            self._sum += value * count
-            self._count += count
-            if i < len(self._counts):
-                self._counts[i] += count
+        self._events.append((value, count))
+        if len(self._events) >= self._FOLD_AT:
+            with self._lock:
+                self._fold_locked()
+
+    def _fold_locked(self) -> None:
+        """Drain a prefix of the pending buffer into the bucket counters.
+        Caller holds self._lock (single folder at a time)."""
+        buf = self._events
+        n = len(buf)
+        if not n:
+            return
+        items = buf[:n]
+        del buf[:n]
+        uppers = self.uppers
+        counts = self._counts
+        top = len(counts)
+        for item in items:
+            if type(item) is tuple:
+                value, k = item
+            else:
+                value, k = item, 1
+            i = bisect_left(uppers, value)
+            self._sum += value * k
+            self._count += k
+            if i < top:
+                counts[i] += k
 
     @property
     def count(self) -> int:
         if self._labelnames:
-            return sum(c._count for _, c in self._sorted_children())
+            return sum(c.count for _, c in self._sorted_children())
         with self._lock:
+            self._fold_locked()
             return self._count
 
     @property
     def sum(self) -> float:
         if self._labelnames:
-            return sum(c._sum for _, c in self._sorted_children())
+            return sum(c.sum for _, c in self._sorted_children())
         with self._lock:
+            self._fold_locked()
             return self._sum
 
     def _sample_lines(self, labelvalues: tuple = ()) -> list[str]:
         with self._lock:
+            self._fold_locked()
             counts = list(self._counts)
             total, s = self._count, self._sum
         lines = []

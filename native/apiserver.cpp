@@ -25,6 +25,7 @@
 // Build: make -C native   (g++ -O2 -std=c++17, no external deps)
 
 #include <algorithm>
+#include <array>
 #include <arpa/inet.h>
 #include <cctype>
 #include <cerrno>
@@ -723,6 +724,7 @@ struct Conn {
   FieldSelector sel;    // fielded watch (empty = everything)
   double last_stream_write = 0;
   bool closing = false;
+  bool deferred = false;  // queued for a DeferWrites batch flush
 };
 
 static double now_s() {
@@ -742,7 +744,23 @@ static void conn_arm(Conn* c, bool want_write) {
   epoll_ctl(g_epfd, EPOLL_CTL_MOD, c->fd, &ev);
 }
 
+// Bulk-bind fast path: a bind list fans one event frame per watcher per
+// bind, and conn_queue attempts a send() syscall for each — ~3 syscalls
+// per bound pod at density rates.  Inside a DeferWrites scope the frames
+// accumulate in the per-conn out buffers instead, and the scope exit
+// flushes each touched watcher with ONE send.
+static bool g_defer_writes = false;
+static std::vector<Conn*> g_deferred;
+
 static void conn_queue(Conn* c, const char* data, size_t n) {
+  if (g_defer_writes) {
+    c->out.append(data, n);
+    if (!c->deferred) {
+      c->deferred = true;
+      g_deferred.push_back(c);
+    }
+    return;
+  }
   // Try a direct write first (the common case empties in one syscall);
   // spill the remainder to the out buffer and arm EPOLLOUT.
   if (c->out.empty()) {
@@ -762,6 +780,28 @@ static void conn_queue(Conn* c, const char* data, size_t n) {
 static void conn_queue(Conn* c, const std::string& s) {
   conn_queue(c, s.data(), s.size());
 }
+
+struct DeferWrites {
+  DeferWrites() { g_defer_writes = true; }
+  ~DeferWrites() {
+    g_defer_writes = false;
+    for (Conn* c : g_deferred) {
+      c->deferred = false;
+      if (c->closing || c->out.empty()) continue;
+      ssize_t w = ::send(c->fd, c->out.data(), c->out.size(), MSG_NOSIGNAL);
+      if (w < 0) {
+        if (errno != EAGAIN && errno != EWOULDBLOCK) {
+          c->closing = true;
+          continue;
+        }
+        w = 0;
+      }
+      c->out.erase(0, (size_t)w);
+      if (!c->out.empty()) conn_arm(c, true);
+    }
+    g_deferred.clear();
+  }
+};
 
 void Store::emit(const char* etype, const std::string& kind,
                  const JPtr& obj, const JPtr& prev) {
@@ -1202,39 +1242,40 @@ static void do_create_list(Conn* c, const std::string& kind,
   send_json(c, 200, body);
 }
 
-static void do_bind_list(Conn* c, const std::string& default_ns,
-                         const JPtr& items) {
+static void do_bind_triples(
+    Conn* c, const std::string& default_ns,
+    const std::vector<std::array<std::string, 3>>& triples) {
   std::string results;
   int failed = 0;
   size_t idx = 0;  // items processed so far (for lazy 201 backfill)
-  for (auto& it : items->arr) {
-    auto meta = it->type == JValue::Obj ? it->get("metadata") : nullptr;
-    std::string ns = meta ? meta->str_or("namespace", "") : "";
-    if (ns.empty()) ns = default_ns;
-    std::string name = meta ? meta->str_or("name", "") : "";
-    auto target = it->type == JValue::Obj ? it->get("target") : nullptr;
-    std::string node = target ? target->str_or("name", "") : "";
-    int code = 0;
-    std::string err = g_store.bind(ns, name, node, &code);
-    idx++;
-    if (code == 201) {
-      // Results stay empty until the first failure: the all-success
-      // batch (the density common case) never pays the per-item
-      // serialization the count-only response discards anyway.
-      if (failed) results += "{\"code\":201},";
-    } else {
-      if (!failed)
-        for (size_t k = 1; k < idx; k++) results += "{\"code\":201},";
-      failed++;
-      JValue e;
-      e.type = JValue::Obj;
-      auto cv = std::make_shared<JValue>();
-      cv->type = JValue::Num;
-      cv->s = std::to_string(code);
-      e.obj.emplace_back("code", cv);
-      e.set("error", jstr(err));
-      results += jdumps(e);
-      results += ',';
+  {
+    // One flushed write per watcher for the whole list instead of one
+    // send() attempt per bind per watcher.
+    DeferWrites defer;
+    for (auto& t : triples) {
+      const std::string& ns = t[0].empty() ? default_ns : t[0];
+      int code = 0;
+      std::string err = g_store.bind(ns, t[1], t[2], &code);
+      idx++;
+      if (code == 201) {
+        // Results stay empty until the first failure: the all-success
+        // batch (the density common case) never pays the per-item
+        // serialization the count-only response discards anyway.
+        if (failed) results += "{\"code\":201},";
+      } else {
+        if (!failed)
+          for (size_t k = 1; k < idx; k++) results += "{\"code\":201},";
+        failed++;
+        JValue e;
+        e.type = JValue::Obj;
+        auto cv = std::make_shared<JValue>();
+        cv->type = JValue::Num;
+        cv->s = std::to_string(code);
+        e.obj.emplace_back("code", cv);
+        e.set("error", jstr(err));
+        results += jdumps(e);
+        results += ',';
+      }
     }
   }
   std::string body = "{\"kind\":\"BindingListResult\",\"failed\":";
@@ -1243,7 +1284,7 @@ static void do_bind_list(Conn* c, const std::string& default_ns,
     // All bound: the count is the contract; per-item results are
     // detailed only when something failed (matches the Python server).
     body += ",\"bound\":";
-    body += std::to_string(items->arr.size());
+    body += std::to_string(triples.size());
     body += "}";
     send_json(c, 200, body);
     return;
@@ -1253,6 +1294,21 @@ static void do_bind_list(Conn* c, const std::string& default_ns,
   body += results;
   body += "]}";
   send_json(c, 200, body);
+}
+
+static void do_bind_list(Conn* c, const std::string& default_ns,
+                         const JPtr& items) {
+  std::vector<std::array<std::string, 3>> triples;
+  triples.reserve(items->arr.size());
+  for (auto& it : items->arr) {
+    auto meta = it->type == JValue::Obj ? it->get("metadata") : nullptr;
+    std::string ns = meta ? meta->str_or("namespace", "") : "";
+    std::string name = meta ? meta->str_or("name", "") : "";
+    auto target = it->type == JValue::Obj ? it->get("target") : nullptr;
+    std::string node = target ? target->str_or("name", "") : "";
+    triples.push_back({ns, name, node});
+  }
+  do_bind_triples(c, default_ns, triples);
 }
 
 // Returns false when the connection was taken over by a watch stream.
@@ -1350,6 +1406,22 @@ static bool dispatch(Conn* c, const std::string& method,
   if (method == "POST") {
     if (parts.size() == 5 && parts[2] == "namespaces" &&
         parts[4] == "bindings") {
+      auto triples = body->get("triples");
+      if (triples && triples->type == JValue::Arr) {
+        // Compact bulk-bind fast path: [ns, pod, node] rows, no
+        // per-item Binding scaffolding to parse.
+        std::vector<std::array<std::string, 3>> rows;
+        rows.reserve(triples->arr.size());
+        for (auto& t : triples->arr) {
+          if (t->type != JValue::Arr) continue;
+          std::array<std::string, 3> row{"", "", ""};
+          for (size_t k = 0; k < 3 && k < t->arr.size(); k++)
+            if (t->arr[k]->type == JValue::Str) row[k] = t->arr[k]->s;
+          rows.push_back(std::move(row));
+        }
+        do_bind_triples(c, parts[3], rows);
+        return true;
+      }
       auto items = body->get("items");
       if (items && items->type == JValue::Arr) {
         do_bind_list(c, parts[3], items);

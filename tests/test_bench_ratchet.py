@@ -1,0 +1,81 @@
+"""The bench ratchet (tools/check_bench.py) guards the perf wins: the
+newest committed BENCH_r{N}.json must not regress its predecessor's
+density p50 by more than 15 % nor silently drop a stage from the
+per-stage breakdown.  The repo's own artifacts must always pass (green
+at snapshot); the unit cases pin the regression and stage-loss
+detectors against synthetic artifacts."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "check_bench", os.path.join(REPO, "tools", "check_bench.py"))
+cb = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(cb)
+
+
+def _parsed(p50=None, median=None, stages=None, pods=30000):
+    d = {"metric": f"scheduler throughput, {pods} pods onto 5000 nodes"}
+    if p50 is not None:
+        d["elapsed_s_p50"] = p50
+    if median is not None:
+        d["median"] = median
+    if stages is not None:
+        d["stages"] = stages
+    return d
+
+
+def test_repo_artifacts_pass_the_ratchet():
+    problems = cb.check()
+    assert problems == [], problems
+
+
+def test_regression_beyond_tolerance_fails():
+    arts = [("BENCH_r01.json", _parsed(p50=1.0)),
+            ("BENCH_r02.json", _parsed(p50=1.2))]
+    problems = cb.check(arts)
+    assert len(problems) == 1 and "regressed" in problems[0]
+
+
+def test_improvement_and_noise_band_pass():
+    assert cb.check([("BENCH_r01.json", _parsed(p50=1.0)),
+                     ("BENCH_r02.json", _parsed(p50=0.8))]) == []
+    # +10% sits inside the 15% noise tolerance.
+    assert cb.check([("BENCH_r01.json", _parsed(p50=1.0)),
+                     ("BENCH_r02.json", _parsed(p50=1.1))]) == []
+
+
+def test_p50_derived_from_median_for_old_artifacts():
+    # Predecessor predates elapsed_s_p50: 30000 pods / 20000 pods-per-s
+    # median = 1.5 s; a 2.0 s successor is a regression.
+    arts = [("BENCH_r01.json", _parsed(median=20000.0)),
+            ("BENCH_r02.json", _parsed(p50=2.0))]
+    problems = cb.check(arts)
+    assert len(problems) == 1 and "regressed" in problems[0]
+
+
+def test_disappearing_stage_fails():
+    stages_full = {"solve": {"seconds": 0.4}, "bind": {"seconds": 0.2}}
+    stages_lost = {"solve": {"seconds": 0.4}}
+    arts = [("BENCH_r01.json", _parsed(p50=1.0, stages=stages_full)),
+            ("BENCH_r02.json", _parsed(p50=1.0, stages=stages_lost))]
+    problems = cb.check(arts)
+    assert len(problems) == 1 and "bind" in problems[0]
+    # Losing the whole breakdown is also a failure...
+    arts = [("BENCH_r01.json", _parsed(p50=1.0, stages=stages_full)),
+            ("BENCH_r02.json", _parsed(p50=1.0))]
+    assert any("breakdown" in p for p in cb.check(arts))
+    # ...but a predecessor WITHOUT stages ratchets nothing (artifacts
+    # predating the stage histogram).
+    arts = [("BENCH_r01.json", _parsed(p50=1.0)),
+            ("BENCH_r02.json", _parsed(p50=1.0, stages=stages_full))]
+    assert cb.check(arts) == []
+
+
+def test_fewer_than_two_artifacts_is_vacuously_green():
+    assert cb.check([]) == []
+    assert cb.check([("BENCH_r01.json", _parsed(p50=1.0))]) == []

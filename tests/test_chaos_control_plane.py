@@ -160,17 +160,26 @@ def test_connection_resets(rig_factory):
 
 def test_watch_stream_cut_mid_event(rig_factory):
     """Watch streams cut in the middle of an event's bytes: the watcher
-    surfaces ERROR, the reflector relists, nothing is lost."""
+    surfaces ERROR, the reflector relists, nothing is lost.  The cut
+    rule targets the POD watches specifically — those always carry
+    events, so the cut deterministically executes (a cut attached to a
+    quiet stream, e.g. services, waits forever for its Nth event); and
+    the relist counter is polled, not asserted instantly — the reflector
+    increments it asynchronously after the ERROR event drains."""
     before = metrics.REFLECTOR_RELISTS.value
     rig = rig_factory(rules=[
-        {"fault": "cut-stream", "path": r"watch=1", "after_events": 1,
-         "count": 2}])
+        {"fault": "cut-stream", "path": r"pods\?watch=1",
+         "after_events": 1, "count": 2}])
     names = rig.create_pods(8)
     rig.wait_bound(names)
     # Create MORE pods after the cuts: the relisted watch still delivers.
     more = rig.create_pods(4, prefix="late")
     rig.wait_bound(more)
     rig.assert_daemon_alive()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and \
+            metrics.REFLECTOR_RELISTS.value <= before:
+        time.sleep(0.05)
     assert metrics.REFLECTOR_RELISTS.value > before
 
 
@@ -219,6 +228,117 @@ def test_rules_driven_over_admin_endpoint(rig_factory):
         pass
     assert rig.proxy.rules() == []
     rig.assert_daemon_alive()
+
+
+def test_409_every_nth_bind_requeues_only_victims(rig_factory):
+    """ISSUE 5 satellite: a deterministic 409 on every Nth bind must
+    forget+requeue only the victim pods — the rest of the batch (and
+    later drains) land untouched, and every victim eventually binds
+    through backoff once the rule's budget is spent.  BatchBindings is
+    gated off so the proxy sees one POST per bind and the every_nth
+    cadence maps 1:1 onto binds."""
+    from kubernetes_tpu.utils import featuregate
+    before = metrics.BIND_CONFLICTS.value
+    old_gate = featuregate.DEFAULT_FEATURE_GATE
+    featuregate.set_default(
+        featuregate.FeatureGate({"BatchBindings": False}))
+    try:
+        rig = rig_factory(rules=[
+            {"fault": "error", "method": "POST", "path": "/bindings",
+             "status": 409, "every_nth": 3, "count": 3}])
+        names = rig.create_pods(9)
+        bound = rig.wait_bound(names)
+        assert set(bound) == set(names)
+        rig.assert_daemon_alive()
+        injected = [r for r in rig.proxy.rules() if r.status == 409]
+        assert injected and injected[0].fired >= 1
+        assert metrics.BIND_CONFLICTS.value >= before + injected[0].fired
+    finally:
+        featuregate.set_default(old_gate)
+
+
+def test_bind_list_partial_conflict_is_isolated_per_item():
+    """One 409 inside a pipelined bulk-bind chunk must surface as THAT
+    item's failure only: the other items in the same chunk and in the
+    other in-flight chunks bind normally (the in-flight window is not
+    poisoned), and the binder maps the failure to a ConflictError for
+    exactly the victim pod."""
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.apiserver.memstore import ConflictError
+    from kubernetes_tpu.scheduler.binder import APIClientBinder
+    store = MemStore()
+    api_srv = serve(store)
+    client = APIClient(
+        f"http://127.0.0.1:{api_srv.server_address[1]}", qps=0)
+    try:
+        client.create("nodes", _node_json("bln-0"))
+        for i in range(12):
+            client.create("pods", _pod_json(f"bl-{i}"))
+        victims = (3, 7)
+        for i in victims:
+            client.bind("default", f"bl-{i}", "bln-0")  # pre-claim: CAS
+        # chunk_size=4 -> three chunks pipelined over persistent conns.
+        results = client.bind_list(
+            [("default", f"bl-{i}", "bln-0") for i in range(12)],
+            chunk_size=4)
+        assert [i for i, r in enumerate(results) if r is not None] == \
+            list(victims)
+        for i in victims:
+            code, err = results[i]
+            assert code == 409 and f"bl-{i}" in err
+        for i in range(12):
+            obj = store.get("pods", f"default/bl-{i}")
+            assert (obj.get("spec") or {}).get("nodeName") == "bln-0"
+
+        # The binder contract on top: only the victim comes back, as a
+        # ConflictError (the daemon then forgets + requeues just it).
+        for i in range(12):
+            client.create("pods", _pod_json(f"bl2-{i}"))
+        store.bind("default", "bl2-5", "bln-0")
+        binder = APIClientBinder(client)
+        client.BIND_CHUNK = 4
+        placed = [(api.Pod(name=f"bl2-{i}", namespace="default"), "bln-0")
+                  for i in range(12)]
+        failures = binder.bind_many(placed)
+        assert [p.key for p, _ in failures] == ["default/bl2-5"]
+        assert isinstance(failures[0][1], ConflictError)
+    finally:
+        api_srv.shutdown()
+
+
+def test_bind_list_chunk_transport_fault_is_isolated_per_chunk():
+    """A 503 swallowing ONE pipelined bulk-bind chunk must not disturb
+    the other in-flight chunks: bind_list reports (0, reason) for exactly
+    that chunk's items, and the binder re-binds only those pods per-pod —
+    every pod still lands, no false conflicts for the chunks that
+    succeeded."""
+    from kubernetes_tpu.api import types as api
+    from kubernetes_tpu.scheduler.binder import APIClientBinder
+    store = MemStore()
+    api_srv = serve(store)
+    api_url = f"http://127.0.0.1:{api_srv.server_address[1]}"
+    proxy = ChaosProxy(api_url).start()
+    # Exactly one bulk-bind POST (the 2nd to arrive) eats a 503.
+    proxy.add_rule(fault="error", method="POST", path="/bindings",
+                   status=503, every_nth=2, count=1)
+    client = APIClient(proxy.base_url, qps=0)
+    try:
+        client.create("nodes", _node_json("cfn-0"))
+        for i in range(12):
+            client.create("pods", _pod_json(f"cf-{i}"))
+        binder = APIClientBinder(client)
+        client.BIND_CHUNK = 4  # three pipelined chunks
+        placed = [(api.Pod(name=f"cf-{i}", namespace="default"), "cfn-0")
+                  for i in range(12)]
+        failures = binder.bind_many(placed)
+        assert failures == [], [(p.key, str(e)) for p, e in failures]
+        for i in range(12):
+            obj = store.get("pods", f"default/cf-{i}")
+            assert (obj.get("spec") or {}).get("nodeName") == "cfn-0", i
+        assert proxy.stats()["injected"] == 1
+    finally:
+        proxy.stop()
+        api_srv.shutdown()
 
 
 # -- extender breaker + graceful degradation --------------------------------

@@ -146,9 +146,9 @@ class TestFeatureGates:
                 calls["batch"].append(joint)
                 return orig_batch(pods, joint=joint)
 
-            def spy_stream(pods, chunk_size=2048):
+            def spy_stream(pods, chunk_size=2048, **kw):
                 calls["stream"] += 1
-                return orig_stream(pods, chunk_size=chunk_size)
+                return orig_stream(pods, chunk_size=chunk_size, **kw)
 
             algo.schedule_batch = spy_batch
             algo.schedule_batch_stream = spy_stream

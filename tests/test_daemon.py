@@ -84,7 +84,7 @@ class TestScheduleOne:
         assert binder.bound_node("default/p1") is not None
         evs = s.config.recorder.events("default/p1")
         assert evs and evs[-1].reason == "Scheduled"
-        assert s.config.metrics.e2e_scheduling_latency._count == 1
+        assert s.config.metrics.e2e_scheduling_latency.count == 1
 
     def test_assumed_pod_visible_to_next_decision(self):
         # The assumed pod occupies capacity before the watch confirms

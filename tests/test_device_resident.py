@@ -1,0 +1,348 @@
+"""Device-resident cluster state, the persistent compile cache contract,
+the pre-warm bucket ladder, and the overlapped solve/bind pipeline
+(ISSUE 5 tentpole).
+
+The invariants pinned here are the "device-residency protocol" from
+ARCHITECTURE.md: the resident mirror equals a fresh full snapshot after
+every sync; per-drain updates are row scatters, not full transfers; full
+re-uploads happen exactly on relist / node-set change / column-capacity
+growth; and a daemon's bucket ladder is fixed at startup."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.engine import solver as sv
+from kubernetes_tpu.engine.generic_scheduler import GenericScheduler
+from kubernetes_tpu.scheduler.binder import InMemoryBinder
+from kubernetes_tpu.scheduler.scheduler import Scheduler, SchedulerConfig
+
+from tests.helpers import make_node, make_pod
+
+
+def _rig(n_nodes: int = 40, **daemon_kw):
+    algo = GenericScheduler()
+    for i in range(n_nodes):
+        algo.cache.add_node(make_node(f"rn{i}", milli_cpu=4000))
+    daemon = Scheduler(SchedulerConfig(algorithm=algo,
+                                       binder=InMemoryBinder(),
+                                       async_bind=False))
+    for k, v in daemon_kw.items():
+        setattr(daemon, k, v)
+    return daemon
+
+
+def _assert_resident_matches_fresh(algo: GenericScheduler) -> None:
+    """After a sync, the mirror must be bit-identical to a freshly
+    assembled full snapshot of the current host arrays."""
+    with algo.cache.lock:
+        nt, agg, ep, nodes = algo.cache.snapshot()
+        res = algo.resident.sync(nt, agg, algo.cache.space,
+                                 algo.cache.take_dirty_rows(),
+                                 algo.cache.tensor_epoch)
+        fresh = sv.device_cluster(nt, agg, algo.cache.space)
+    for field, a, b in zip(sv.DeviceCluster._fields, fresh, res):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            f"resident.{field} diverged from the full snapshot"
+
+
+class TestResidentCluster:
+    def test_second_drain_scatters_rows_instead_of_full_transfer(self):
+        daemon = _rig()
+        algo = daemon.config.algorithm
+        for i in range(8):
+            daemon.enqueue(make_pod(f"ra{i}", cpu="100m"))
+        daemon.schedule_pending(wait_first=False)
+        daemon.wait_for_binds()
+        assert algo.resident.stats == {"full_syncs": 1, "row_syncs": 0,
+                                       "rows_scattered": 0}
+        for i in range(8):
+            daemon.enqueue(make_pod(f"rb{i}", cpu="100m"))
+        daemon.schedule_pending(wait_first=False)
+        daemon.wait_for_binds()
+        # The 8 assumed pods dirtied at most 8 of 40 rows: a scatter, not
+        # a re-snapshot.
+        assert algo.resident.stats["full_syncs"] == 1
+        assert algo.resident.stats["row_syncs"] == 1
+        assert 1 <= algo.resident.stats["rows_scattered"] <= 8
+        _assert_resident_matches_fresh(algo)
+
+    def test_heartbeat_flip_is_visible_through_the_mirror(self):
+        """A node Ready->NotReady update must reach the device through
+        the row scatter: the next drain places nothing there."""
+        daemon = _rig(n_nodes=30)
+        algo = daemon.config.algorithm
+        daemon.enqueue(make_pod("warmup", cpu="100m"))
+        daemon.schedule_pending(wait_first=False)
+        algo.cache.update_node(make_node("rn0", milli_cpu=4000,
+                                         conditions=[("Ready", "False")]))
+        placements = algo.schedule_batch(
+            [make_pod(f"hb{i}", cpu="100m") for i in range(6)])
+        assert all(p is not None and p != "rn0" for p in placements)
+        assert algo.resident.stats["full_syncs"] == 1
+        _assert_resident_matches_fresh(algo)
+
+    def test_assume_and_forget_keep_mirror_consistent(self):
+        daemon = _rig(n_nodes=24)
+        algo = daemon.config.algorithm
+        pods = [make_pod(f"af{i}", cpu="500m") for i in range(6)]
+        for p in pods:
+            daemon.enqueue(p)
+        daemon.schedule_pending(wait_first=False)
+        daemon.wait_for_binds()
+        algo.cache.forget_pod(pods[0]) if algo.cache.is_assumed(
+            pods[0].key) else None
+        _assert_resident_matches_fresh(algo)
+
+    def test_node_append_forces_full_resnapshot(self):
+        daemon = _rig(n_nodes=10)
+        algo = daemon.config.algorithm
+        algo.schedule_batch([make_pod("pre", cpu="100m")])
+        before = algo.resident.stats["full_syncs"]
+        algo.cache.add_node(make_node("joiner", milli_cpu=4000))
+        algo.schedule_batch([make_pod("post", cpu="100m")])
+        assert algo.resident.stats["full_syncs"] == before + 1
+        _assert_resident_matches_fresh(algo)
+
+    def test_relist_rebuild_forces_full_resnapshot(self):
+        daemon = _rig(n_nodes=10)
+        algo = daemon.config.algorithm
+        algo.schedule_batch([make_pod("pre2", cpu="100m")])
+        before = algo.resident.stats["full_syncs"]
+        algo.cache.remove_node("rn3")
+        algo.schedule_batch([make_pod("post2", cpu="100m")])
+        assert algo.resident.stats["full_syncs"] == before + 1
+        _assert_resident_matches_fresh(algo)
+
+    def test_column_capacity_growth_forces_full_resnapshot(self):
+        """Interning enough new port tokens to cross a vocab capacity
+        bucket widens the cluster's ports columns — the resident arrays
+        cannot hold the rows and must re-upload."""
+        daemon = _rig(n_nodes=16)
+        algo = daemon.config.algorithm
+        algo.schedule_batch([make_pod("cap0", cpu="100m")])
+        before = algo.resident.stats["full_syncs"]
+        cap0 = algo.cache.space.ports.capacity
+        i = 0
+        while algo.cache.space.ports.capacity == cap0:
+            algo.cache.space.ports.id(str(20000 + i))
+            i += 1
+        algo.schedule_batch([make_pod("cap1", cpu="100m")])
+        assert algo.resident.stats["full_syncs"] == before + 1
+        _assert_resident_matches_fresh(algo)
+
+    def test_majority_dirty_falls_back_to_full_upload(self):
+        """Dirtying most of a small cluster re-uploads instead of
+        scattering (the gather would move most of the bytes anyway)."""
+        daemon = _rig(n_nodes=4)
+        algo = daemon.config.algorithm
+        algo.schedule_batch([make_pod("sd0", cpu="100m")])
+        before = algo.resident.stats["full_syncs"]
+        for name in ("rn0", "rn1", "rn2"):
+            algo.cache.update_node(make_node(name, milli_cpu=8000))
+        algo.schedule_batch([make_pod("sd1", cpu="100m")])
+        assert algo.resident.stats["full_syncs"] == before + 1
+
+
+class TestPrewarmLadder:
+    def test_stream_floor_read_once_at_startup(self, monkeypatch):
+        """The ISSUE 5 bugfix: KT_STREAM_MIN_BUCKET changing after the
+        daemon started must not move the ladder (it would mint shapes
+        the pre-warm never traced)."""
+        monkeypatch.setenv("KT_STREAM_MIN_BUCKET", "128")
+        daemon = _rig(n_nodes=4, stream_chunk=1024)
+        daemon.STREAM_THRESHOLD = 1024
+        assert daemon.stream_min_bucket == 128
+        assert daemon.effective_ladder() == [128, 256, 512, 1024]
+        monkeypatch.setenv("KT_STREAM_MIN_BUCKET", "32")
+        # Captured at startup: the running daemon's ladder is unchanged.
+        assert daemon.stream_min_bucket == 128
+        assert daemon.effective_ladder() == [128, 256, 512, 1024]
+        # With the small-drain path open past the chunk (huge threshold),
+        # the ladder covers every mintable pow2 bucket up to 4096 — a
+        # 2049..4095-pod drain legally mints 4096 (the review catch).
+        daemon.STREAM_THRESHOLD = 1 << 62
+        assert daemon.effective_ladder() == \
+            [128, 256, 512, 1024, 2048, 4096]
+        # Threshold 1 routes EVERY drain through the stream chunk: the
+        # small-drain buckets are unreachable and the ladder is minimal.
+        daemon.STREAM_THRESHOLD = 1
+        assert daemon.effective_ladder() == [1024]
+
+    def test_ladder_covers_exactly_the_mintable_buckets(self):
+        """A non-pow2 floor mints {floor} then pow2 values above it —
+        never floor doublings; and the stream chunk only joins the
+        ladder when the chunked path is reachable (STREAM_THRESHOLD
+        set)."""
+        daemon = _rig(n_nodes=4, stream_chunk=8192)
+        daemon.stream_min_bucket = 300
+        daemon.STREAM_THRESHOLD = 1 << 62  # unset sentinel: one-shot big
+        assert daemon.effective_ladder() == [300, 512, 1024, 2048, 4096]
+        daemon.STREAM_THRESHOLD = 8192
+        assert daemon.effective_ladder() == \
+            [300, 512, 1024, 2048, 4096, 8192]
+
+    def test_prewarm_traces_every_ladder_bucket_and_drains_reuse_it(self):
+        daemon = _rig(n_nodes=6, stream_chunk=64)
+        daemon.stream_min_bucket = 16
+        daemon.STREAM_THRESHOLD = 64
+        assert daemon.effective_ladder() == [16, 32, 64]
+        timings = daemon.prewarm()
+        assert sorted(timings) == [16, 32, 64]
+        assert all(s > 0 for s in timings.values())
+        # A post-warm drain through the small-drain stream path still
+        # schedules correctly (prewarm left no cache state behind).
+        assert daemon.config.algorithm.cache.pod_count() == 0
+        daemon.STREAM_THRESHOLD = 1
+        for i in range(10):
+            daemon.enqueue(make_pod(f"pw{i}", cpu="100m"))
+        assert daemon.schedule_pending(wait_first=False) == 10
+        daemon.wait_for_binds()
+        assert daemon.config.binder.count() == 10
+
+    def test_prewarm_noops_without_nodes(self):
+        algo = GenericScheduler()
+        daemon = Scheduler(SchedulerConfig(algorithm=algo,
+                                           async_bind=False))
+        assert daemon.prewarm() == {}
+
+
+class TestOverlappedPipeline:
+    def test_pipelined_stream_drain_binds_everything(self):
+        daemon = _rig(n_nodes=12, stream_chunk=8)
+        daemon.STREAM_THRESHOLD = 1
+        daemon.stream_min_bucket = 8
+        daemon.pipeline_window = 2
+        pods = [make_pod(f"pl{i}", cpu="50m") for i in range(30)]
+        for p in pods:
+            daemon.enqueue(p)
+        assert daemon.schedule_pending(wait_first=False) == 30
+        daemon.wait_for_binds()
+        assert daemon.config.binder.count() == 30
+        # The commit pool carried the readback/assume/bind stages.
+        assert daemon._commit_pool is not None
+        daemon.stop()
+
+    def test_window_zero_is_the_synchronous_path(self):
+        daemon = _rig(n_nodes=12, stream_chunk=8)
+        daemon.STREAM_THRESHOLD = 1
+        daemon.stream_min_bucket = 8
+        daemon.pipeline_window = 0
+        for i in range(20):
+            daemon.enqueue(make_pod(f"sy{i}", cpu="50m"))
+        assert daemon.schedule_pending(wait_first=False) == 20
+        daemon.wait_for_binds()
+        assert daemon.config.binder.count() == 20
+        assert daemon._commit_pool is None
+
+    def test_commit_order_and_assume_before_bind(self):
+        """Chunks commit in solve order on the single worker, and within
+        a chunk every pod is assumed before its bind runs."""
+        events: list[tuple[str, str]] = []
+        lock = threading.Lock()
+        daemon = _rig(n_nodes=12, stream_chunk=4)
+        daemon.STREAM_THRESHOLD = 1
+        daemon.stream_min_bucket = 4
+        daemon.pipeline_window = 2
+        algo = daemon.config.algorithm
+        real_assume = algo.cache.assume_pods
+
+        def spy_assume(assignments, **kw):
+            with lock:
+                events.extend(("assume", pod.key)
+                              for pod, _ in assignments)
+            return real_assume(assignments, **kw)
+
+        algo.cache.assume_pods = spy_assume
+        real_bind = daemon.config.binder.bind_many
+
+        def spy_bind(placed):
+            with lock:
+                events.extend(("bind", pod.key) for pod, _ in placed)
+            return real_bind(placed)
+
+        daemon.config.binder.bind_many = spy_bind
+        for i in range(12):
+            daemon.enqueue(make_pod(f"ord{i:02d}", cpu="50m"))
+        assert daemon.schedule_pending(wait_first=False) == 12
+        daemon.wait_for_binds()
+        assumed_at = {k: i for i, (kind, k) in enumerate(events)
+                      if kind == "assume"}
+        for i, (kind, key) in enumerate(events):
+            if kind == "bind":
+                assert assumed_at[key] < i, \
+                    f"{key} bound before it was assumed"
+        # Assume order across chunks follows solve (queue) order.
+        assumed_keys = [k for kind, k in events if kind == "assume"]
+        assert assumed_keys == sorted(assumed_keys)
+        daemon.stop()
+
+    def test_commit_crash_requeues_unassumed_pods(self):
+        """A crashing commit surfaces to schedule_pending's handler:
+        pods the crashed chunk never assumed are requeued, pods from
+        completed chunks are not double-tracked."""
+        daemon = _rig(n_nodes=12, stream_chunk=4)
+        daemon.STREAM_THRESHOLD = 1
+        daemon.stream_min_bucket = 4
+        daemon.pipeline_window = 1
+        from kubernetes_tpu.scheduler.backoff import PodBackoff
+        daemon.backoff = PodBackoff(default_duration=0.01,
+                                    max_duration=0.1)
+        algo = daemon.config.algorithm
+        real_assume = algo.cache.assume_pods
+        calls = [0]
+
+        def failing_assume(assignments, **kw):
+            calls[0] += 1
+            if calls[0] == 2:
+                raise RuntimeError("injected commit crash")
+            return real_assume(assignments, **kw)
+
+        algo.cache.assume_pods = failing_assume
+        for i in range(12):
+            daemon.enqueue(make_pod(f"cr{i}", cpu="50m"))
+        assert daemon.schedule_pending(wait_first=False) == 12
+        daemon.wait_for_binds()
+        algo.cache.assume_pods = real_assume
+        # Chunk 2's four pods were requeued through backoff; wait for
+        # the requeue worker, then drain again.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                daemon.config.binder.count() < 12:
+            daemon.schedule_pending(wait_first=False, timeout=0.05)
+            daemon.wait_for_binds()
+            time.sleep(0.05)
+        assert daemon.config.binder.count() == 12
+        daemon.stop()
+
+
+class TestCompileCache:
+    def test_configure_is_idempotent_and_env_gated(self, monkeypatch,
+                                                   tmp_path):
+        from kubernetes_tpu.engine import compile_cache as cc
+        monkeypatch.setenv("KT_COMPILE_CACHE", str(tmp_path / "xla"))
+        cc._reset_for_tests()
+        try:
+            d = cc.configure()
+            assert d == str(tmp_path / "xla")
+            import os
+            assert os.path.isdir(d)
+            # Idempotent: a later env change does not re-point the cache.
+            monkeypatch.setenv("KT_COMPILE_CACHE", "/elsewhere")
+            assert cc.configure() == d
+            assert cc.cache_dir() == d
+            # Disabled forms.
+            for off in ("0", "off", "none"):
+                cc._reset_for_tests()
+                monkeypatch.setenv("KT_COMPILE_CACHE", off)
+                assert cc.configure() is None
+        finally:
+            # Leave the process configured with the real default so later
+            # tests in the suite see a consistent state.
+            cc._reset_for_tests()
+            monkeypatch.delenv("KT_COMPILE_CACHE", raising=False)
+            cc.configure()

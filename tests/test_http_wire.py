@@ -106,6 +106,65 @@ def wire(tmp_path_factory):
         print(f"\n--- daemon stderr tail ---\n{err_tail}", file=sys.stderr)
 
 
+def test_watcher_decode_throughput_10k_events_under_1s():
+    """The HTTPWatcher pump's decode fast path (bulk read1 into one
+    bytearray, json.loads on line slices): 10k NDJSON watch events must
+    decode in under a second on CPU (ISSUE 5 satellite).  A tiny raw
+    socket serves a canned chunked response so the measurement is the
+    CLIENT's decode, not a store's event fan-out."""
+    import threading
+    from kubernetes_tpu.client.http import HTTPWatcher
+
+    n_events = 10_000
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    payload = b"".join(
+        json.dumps({"type": "ADDED", "object": {
+            "metadata": {"namespace": "default", "name": f"wp{i}",
+                         "resourceVersion": str(i + 1)},
+            "spec": {"nodeName": ""}}}).encode() + b"\n"
+        for i in range(n_events))
+
+    def serve_once():
+        conn, _ = srv.accept()
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            buf += conn.recv(4096)
+        conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: application/json\r\n"
+                     b"Transfer-Encoding: chunked\r\n\r\n")
+        for i in range(0, len(payload), 65536):
+            part = payload[i:i + 65536]
+            conn.sendall(f"{len(part):x}\r\n".encode() + part + b"\r\n")
+        conn.sendall(b"0\r\n\r\n")
+        conn.close()
+
+    t = threading.Thread(target=serve_once, daemon=True)
+    t.start()
+    w = HTTPWatcher(f"http://127.0.0.1:{port}/api/v1/pods?watch=1",
+                    "pods")
+    try:
+        t0 = time.perf_counter()
+        got = 0
+        last = None
+        while got < n_events:
+            ev = w.next(timeout=10.0)
+            assert ev is not None and ev.type == "ADDED"
+            last = ev
+            got += 1
+        elapsed = time.perf_counter() - t0
+        # Ordering and field decode survive the fast path.
+        assert last.key == f"default/wp{n_events - 1}"
+        assert last.rv == n_events
+        assert elapsed < 1.0, \
+            f"decoding {n_events} events took {elapsed:.3f}s"
+    finally:
+        w.stop()
+        srv.close()
+
+
 def test_thousand_pods_over_http_only(wire):
     """1k pods scheduled through HTTP list/watch/bind alone."""
     store, api_url, _ = wire

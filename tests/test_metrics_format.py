@@ -156,7 +156,9 @@ class TestExpositionSpec:
         h.observe(2)       # exactly on an upper bound: le="2" bucket
         h.observe(3)
         h.observe(100)     # beyond the last bound: only +Inf
-        # Internal storage is per-bucket (non-cumulative)...
+        # observe() is a lock-free pending append; the per-bucket
+        # (non-cumulative) storage materializes at read time...
+        assert h.count == 3
         assert h._counts == [0, 1, 1, 0]
         # ...but the exposition is cumulative and monotone.
         fams = parse_prometheus(h.expose())
@@ -171,8 +173,8 @@ class TestExpositionSpec:
         h1.observe_many(5.0, 7)
         for _ in range(7):
             h2.observe(5.0)
-        assert h1._counts == h2._counts
         assert h1.sum == h2.sum and h1.count == h2.count
+        assert h1._counts == h2._counts
 
     def test_labeled_family_aggregates_and_rejects_bare_ops(self):
         c = m.Counter("agg_total", "h", labelnames=("x",))

@@ -234,6 +234,19 @@ def test_batch_create_and_bind(rig):
                            "target": {"name": "nB"}}]})
     assert code == 200 and body["failed"] == 1
     assert [r["code"] for r in body["results"]] == [201, 404]
+    # The compact triples fast path (what APIClient.bind_list sends):
+    # same CAS, same per-item results — m0 is now claimed (409), m1
+    # binds, the empty-ns row defaults to the path namespace.
+    code, body = _req(rig, "POST", "/api/v1/namespaces/default/bindings",
+                      {"kind": "BindingList", "triples": [
+                          ["default", "m0", "nC"], ["", "m1", "nC"]]})
+    assert code == 200 and body["failed"] == 1
+    assert [r["code"] for r in body["results"]] == [409, 201]
+    code, body = _req(rig, "POST", "/api/v1/namespaces/default/bindings",
+                      {"kind": "BindingList",
+                       "triples": [["default", "m3", "nC"]]})
+    assert code == 200 and body == {"kind": "BindingListResult",
+                                    "failed": 0, "bound": 1}
 
 
 def test_validation_reasons(rig):

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Bench ratchet: the newest committed BENCH_r{N}.json must not regress
+its predecessor.
+
+The perf PRs each bought a measured win; without a ratchet a later PR can
+quietly give it back (the observability rounds caught exactly this shape
+of drift in the docs — tools/sync_bench_docs.py — and this is the same
+process applied to the NUMBERS).  ``check()`` compares the two
+highest-numbered committed artifacts and fails when:
+
+* density p50 (seconds for the headline shape) regressed more than
+  ``TOLERANCE`` (15 % — the tunneled chip's run-to-run noise band sits
+  inside that, a real regression does not), or
+* a pipeline stage present in the predecessor's per-stage breakdown
+  disappeared from the newest one (a silently-dropped stage means the
+  telemetry, or the stage itself, was lost).
+
+Artifacts predating a field (no ``elapsed_s_p50``: derive from the median
+throughput; no ``stages``: skip the stage check) are handled so the
+ratchet can only tighten going forward.  Wired into tier-1 by
+``tests/test_bench_ratchet.py``; runnable standalone:
+
+    python tools/check_bench.py   # exit 1 on regression
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TOLERANCE = 0.15  # p50 may grow at most 15% artifact-over-artifact
+
+
+def _committed_bench_names() -> set[str] | None:
+    """The docs ratchet's "green at snapshot" rule, shared — ONE
+    implementation of which BENCH artifacts count as committed, so the
+    two tier-1 ratchets cannot drift (sync_bench_docs._committed_bench_
+    names: git-HEAD tracked names; None when git is unavailable, and the
+    caller then falls back to every artifact present)."""
+    spec = importlib.util.spec_from_file_location(
+        "sync_bench_docs", os.path.join(REPO, "tools",
+                                        "sync_bench_docs.py"))
+    sync = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sync)
+    return sync._committed_bench_names()
+
+
+def committed_artifacts() -> list[tuple[str, dict]]:
+    """[(name, parsed)] for committed BENCH artifacts with a parsed
+    payload, ascending by round number."""
+    committed = _committed_bench_names()
+    found: list[tuple[int, str, dict]] = []
+    for name in os.listdir(REPO):
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
+        if not m:
+            continue
+        if committed is not None and name not in committed:
+            continue
+        try:
+            with open(os.path.join(REPO, name)) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = data.get("parsed")
+        if parsed:
+            found.append((int(m.group(1)), name, parsed))
+    found.sort()
+    return [(name, parsed) for _, name, parsed in found]
+
+
+def _shape_pods(parsed: dict) -> int:
+    m = re.search(r"([\d,]+) pods onto", parsed.get("metric", ""))
+    return int(m.group(1).replace(",", "")) if m else 30000
+
+
+def density_p50_s(parsed: dict) -> float | None:
+    """The artifact's density p50 in seconds: the recorded
+    ``elapsed_s_p50``, or (older artifacts) derived from the median
+    throughput and the headline pod count."""
+    p50 = parsed.get("elapsed_s_p50")
+    if p50:
+        return float(p50)
+    median = parsed.get("median") or parsed.get("value")
+    if not median:
+        return None
+    return _shape_pods(parsed) / float(median)
+
+
+def check(artifacts: list[tuple[str, dict]] | None = None,
+          tolerance: float = TOLERANCE) -> list[str]:
+    """Problems with the newest artifact vs its predecessor (empty =
+    ratchet holds).  Fewer than two comparable artifacts: nothing to
+    ratchet against, vacuously green."""
+    if artifacts is None:
+        artifacts = committed_artifacts()
+    if len(artifacts) < 2:
+        return []
+    (prev_name, prev), (new_name, new) = artifacts[-2], artifacts[-1]
+    problems: list[str] = []
+    prev_p50, new_p50 = density_p50_s(prev), density_p50_s(new)
+    if prev_p50 and new_p50 and new_p50 > prev_p50 * (1.0 + tolerance):
+        problems.append(
+            f"density p50 regressed: {new_name} {new_p50:.3f}s vs "
+            f"{prev_name} {prev_p50:.3f}s "
+            f"(+{(new_p50 / prev_p50 - 1) * 100:.0f}%, tolerance "
+            f"{tolerance * 100:.0f}%)")
+    prev_stages = set((prev.get("stages") or {}))
+    new_stages = set((new.get("stages") or {}))
+    if prev_stages and new_stages:
+        lost = prev_stages - new_stages
+        if lost:
+            problems.append(
+                f"stages disappeared from {new_name}'s per-stage "
+                f"breakdown: {sorted(lost)} (present in {prev_name})")
+    elif prev_stages and not new_stages:
+        problems.append(
+            f"{new_name} lost the per-stage breakdown entirely "
+            f"({prev_name} had {sorted(prev_stages)})")
+    return problems
+
+
+def main() -> int:
+    artifacts = committed_artifacts()
+    if len(artifacts) < 2:
+        print("bench ratchet: fewer than two committed BENCH artifacts; "
+              "nothing to compare")
+        return 0
+    problems = check(artifacts)
+    if problems:
+        for p in problems:
+            print(f"bench ratchet FAIL: {p}", file=sys.stderr)
+        return 1
+    (prev_name, prev), (new_name, new) = artifacts[-2], artifacts[-1]
+    print(f"bench ratchet OK: {new_name} p50 {density_p50_s(new):.3f}s vs "
+          f"{prev_name} {density_p50_s(prev):.3f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,16 @@ def _shape(parsed: dict) -> tuple[int, int]:
             int(m.group(2).replace(",", "")))
 
 
+def _cold_warm(parsed: dict) -> tuple[float | None, float | None]:
+    """(cold_s, warm_s) for the cold/warm-start columns, read ONLY from
+    the dedicated ``cold_vs_warm`` phase — artifacts predating it
+    measured their warm trace without the persistent compilation cache,
+    and rendering those numbers under a 'persistent XLA cache' caption
+    would attribute a result the artifact never measured."""
+    cw = parsed.get("cold_vs_warm") or {}
+    return cw.get("cold_compile_s"), cw.get("warm_start_compile_s")
+
+
 def render_readme(tag: str, parsed: dict) -> str:
     pods, nodes = _shape(parsed)
     pps = parsed["value"]
@@ -104,6 +114,12 @@ def render_readme(tag: str, parsed: dict) -> str:
             f"{(joint['joint_vs_greedy'] - 1) * 100:+.0f}% vs greedy on an "
             f"overcommitted fleet")
     lines[-1] += "."
+    cold, warm = _cold_warm(parsed)
+    if cold is not None and warm is not None:
+        lines.append(
+            f"Start-up compile: {cold:.1f} s cold (once per machine), "
+            f"{warm:.1f} s warm-start against the persistent XLA "
+            f"compilation cache.")
     fleet = parsed.get("fleet")
     if fleet:
         lines.append(
@@ -150,6 +166,11 @@ def render_arch(tag: str, parsed: dict) -> str:
     if wire and wire.get("stages"):
         rows.append(f"| ↳ wire stage breakdown (daemon side) | "
                     f"{_stage_cell(wire['stages'])} | — |")
+    cold, warm = _cold_warm(parsed)
+    if cold is not None and warm is not None:
+        rows.append(
+            f"| start-up compile (cold / warm via persistent XLA cache) "
+            f"| {cold:.1f} s cold → {warm:.1f} s warm | — |")
     lines = [f"Numbers from `{tagc}.json` (best of "
              f"{len(parsed.get('runs', [1]))}; median "
              f"{parsed.get('median', parsed['value']):,.0f} pods/s):", ""]
