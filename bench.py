@@ -131,6 +131,29 @@ def main() -> None:
         except Exception as err:  # noqa: BLE001 — quality phase is additive
             print(f"joint phase failed: {err}", file=sys.stderr)
 
+    # Workloads subsystem (ISSUE 6): gang admission, preemption oracle
+    # parity, joint-vs-greedy quality with warm wall-clock — written as
+    # its own committed artifact (WORKLOADS_r{N}.json) that
+    # tools/check_bench.py ratchets alongside density p50.
+    # BENCH_WORKLOADS=0 skips.
+    workloads = None
+    if os.environ.get("BENCH_WORKLOADS", "1") != "0":
+        from kubernetes_tpu.perf import workloads as wl
+        try:
+            workloads = wl.collect()
+            wl_path = os.environ.get("BENCH_WORKLOADS_OUT",
+                                     "WORKLOADS_r06.json")
+            with open(wl_path, "w") as f:
+                json.dump(workloads, f, indent=1)
+                f.write("\n")
+            quality = workloads["joint_quality"]["joint_vs_greedy"]
+            print(f"workloads: quality x{quality}, preemption parity "
+                  f"{workloads['preemption_parity']['parity_pct']}%, "
+                  f"gang warm {workloads['gang']['warm_solve_s']}s "
+                  f"-> {wl_path}", file=sys.stderr)
+        except Exception as err:  # noqa: BLE001 — phase is additive
+            print(f"workloads phase failed: {err}", file=sys.stderr)
+
     # Cold vs warm start (the compile tax): this process's first warm
     # trace is the cold cost (fresh XLA cache entries for this shape);
     # a FRESH subprocess then re-times the same warm trace against the
@@ -225,6 +248,17 @@ def main() -> None:
         out["cold_vs_warm"] = cold_vs_warm
     if joint is not None:
         out["joint"] = joint
+    if workloads is not None:
+        out["workloads"] = {
+            "joint_vs_greedy":
+                workloads["joint_quality"]["joint_vs_greedy"],
+            "joint_warm_s": workloads["joint_quality"]["joint_warm_s"],
+            "preemption_parity_pct":
+                workloads["preemption_parity"]["parity_pct"],
+            "gang_warm_solve_s": workloads["gang"]["warm_solve_s"],
+            "partial_gangs_bound":
+                workloads["gang"]["partial_gangs_bound"],
+        }
     if fleet is not None:
         out["fleet"] = fleet
     if wire is not None:

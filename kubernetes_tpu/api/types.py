@@ -41,6 +41,16 @@ SCHEDULER_NAME_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/name"
 PREFER_AVOID_PODS_ANNOTATION_KEY = "scheduler.alpha.kubernetes.io/preferAvoidPods"
 CREATED_BY_ANNOTATION_KEY = "kubernetes.io/created-by"
 
+# Workload-constraint annotations (the workloads subsystem,
+# engine/workloads/): gang membership, all-or-nothing gang size, priority
+# for preemption, and topology-spread constraints.  All live in
+# annotations like the v1.4.0-alpha affinity/toleration surface above.
+GANG_ANNOTATION_KEY = "scheduling.kt.io/gang"
+GANG_SIZE_ANNOTATION_KEY = "scheduling.kt.io/gang-size"
+PRIORITY_ANNOTATION_KEY = "scheduling.kt.io/priority"
+TOPOLOGY_SPREAD_ANNOTATION_KEY = \
+    "scheduling.kt.io/topologySpreadConstraints"
+
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
 
 # Taint effects (pkg/api/types.go TaintEffect consts).
@@ -231,6 +241,24 @@ class Affinity:
 
 
 @dataclass(frozen=True)
+class TopologySpreadConstraint:
+    """Spread pods matching ``label_selector`` evenly across values of the
+    node label ``topology_key``: placing on a domain must not push its
+    matching-pod count more than ``max_skew`` above the least-loaded
+    domain.  ``when_unsatisfiable``: "DoNotSchedule" is a hard mask plane;
+    "ScheduleAnyway" a soft score plane (engine/workloads/topology.py)."""
+
+    max_skew: int = 1
+    topology_key: str = ""
+    when_unsatisfiable: str = "DoNotSchedule"
+    label_selector: Optional[LabelSelector] = None
+
+    @property
+    def hard(self) -> bool:
+        return self.when_unsatisfiable != "ScheduleAnyway"
+
+
+@dataclass(frozen=True)
 class Volume:
     """Only the conflict-relevant volume sources (predicates.go:63-144)."""
 
@@ -260,6 +288,14 @@ class Pod:
     containers: list[Container] = field(default_factory=list)
     volumes: list[Volume] = field(default_factory=list)
     deletion_timestamp: Optional[float] = None
+    # spec.priority analogue: higher schedules first and may preempt
+    # strictly-lower-priority pods (engine/workloads/preemption.py).  The
+    # annotation (PRIORITY_ANNOTATION_KEY) overrides when present.
+    priority: int = 0
+    # Scheduler-set nominated node after a preemption decision (the
+    # reference's status.nominatedNodeName): victims were evicted from
+    # this node on the pod's behalf.
+    nominated_node: str = ""
     # Parsed-from-annotation caches (set lazily).
     _affinity: Optional[Affinity] = field(default=None, repr=False)
     _affinity_parsed: bool = field(default=False, repr=False)
@@ -284,6 +320,53 @@ class Pod:
             self._affinity = _parse_affinity_json(json.loads(raw)) if raw else None
             self._affinity_parsed = True
         return self._affinity
+
+    @property
+    def effective_priority(self) -> int:
+        """The pod's scheduling priority: the annotation when present
+        (and parseable), else the ``priority`` field, else 0."""
+        raw = self.annotations.get(PRIORITY_ANNOTATION_KEY, "")
+        if raw:
+            try:
+                return int(raw)
+            except ValueError:
+                pass
+        return self.priority
+
+    @property
+    def gang(self) -> str:
+        """Gang group name ("" = not a gang member).  Gang members are
+        drained as a unit and admitted all-or-nothing
+        (engine/workloads/gang.py)."""
+        return self.annotations.get(GANG_ANNOTATION_KEY, "")
+
+    @property
+    def gang_size(self) -> int:
+        """Declared gang member count (0 = undeclared).  The queue holds
+        gang members until this many are present; the solver's
+        all-or-nothing reduction requires at least this many placed."""
+        raw = self.annotations.get(GANG_SIZE_ANNOTATION_KEY, "")
+        try:
+            return int(raw) if raw else 0
+        except ValueError:
+            return 0
+
+    def topology_spread_constraints(self) -> list[TopologySpreadConstraint]:
+        """Parsed topologySpreadConstraints annotation (JSON list of
+        {maxSkew, topologyKey, whenUnsatisfiable, labelSelector})."""
+        raw = self.annotations.get(TOPOLOGY_SPREAD_ANNOTATION_KEY, "")
+        if not raw:
+            return []
+        out = []
+        for d in json.loads(raw):
+            out.append(TopologySpreadConstraint(
+                max_skew=max(int(d.get("maxSkew", 1)), 1),
+                topology_key=d.get("topologyKey", ""),
+                when_unsatisfiable=d.get("whenUnsatisfiable",
+                                         "DoNotSchedule"),
+                label_selector=_parse_label_selector(
+                    d.get("labelSelector"))))
+        return out
 
     def tolerations(self) -> list[Toleration]:
         """GetTolerationsFromPodAnnotations (pkg/api/helpers.go:471-482)."""
@@ -582,6 +665,8 @@ def pod_to_json(pod: Pod) -> dict:
         spec["nodeSelector"] = dict(pod.node_selector)
     if volumes:
         spec["volumes"] = volumes
+    if pod.priority:
+        spec["priority"] = pod.priority
     return {
         "metadata": {"name": pod.name, "namespace": pod.namespace,
                      "uid": pod.uid, "labels": dict(pod.labels),
@@ -636,7 +721,8 @@ def pod_from_json(d: dict) -> Pod:
         node_selector=dict(spec.get("nodeSelector") or {}),
         containers=containers,
         volumes=[_parse_volume(v) for v in spec.get("volumes") or ()],
-        deletion_timestamp=1.0 if meta.get("deletionTimestamp") else None)
+        deletion_timestamp=1.0 if meta.get("deletionTimestamp") else None,
+        priority=int(spec.get("priority") or 0))
 
 
 def node_from_json(d: dict) -> Node:

@@ -435,6 +435,99 @@ class SchedulerCache:
         self.stats["rebuilds"] += 1
         self.stats["rebuild_s"] += time.perf_counter() - t0
 
+    # ---- workload-constraint bookkeeping (engine/workloads/) ----------
+
+    @_locked
+    def get_pod(self, key: str) -> Optional[api.Pod]:
+        """The tracked pod object (assumed or confirmed), or None."""
+        st = self._pod_states.get(key)
+        return st.pod if st is not None else None
+
+    @_locked
+    def ensure_topo_key(self, key: str) -> None:
+        """Intern a topology label key (topologySpreadConstraints name
+        arbitrary node labels, not just the default failure domains).  A
+        NEW key means the node tensors lack its topo_val column contents:
+        full rebuild on next snapshot (rare — once per workload type)."""
+        if self.space.topo_keys.get(key) < 0:
+            self.space.topo_keys.id(key)
+            self._mark_nodes_dirty()
+
+    @_locked
+    def topo_domain_counts_bulk(self, specs: list) -> list[dict[int, int]]:
+        """Matching tracked-pod count per topology domain id, for EVERY
+        term of a batch in ONE pod walk — the domain bookkeeping behind
+        the spread planes (workloads/topology.compile_terms).  ``specs``
+        is [(namespace, api.LabelSelector, key_col)]; assumed pods count
+        (the reference's assumed-pod visibility).  One walk for all
+        terms matters because this runs under the cache lock inside the
+        drain's compile stage — per-term walks would be O(terms x pods)
+        of interpreter time blocking every reflector handler."""
+        self._ensure_tensors()
+        out: list[dict[int, int]] = [{} for _ in specs]
+        if not specs:
+            return out
+        for st in self._pod_states.values():
+            pod = st.pod
+            if not pod.node_name:
+                continue
+            idx = self._nt.name_to_idx.get(pod.node_name)
+            if idx is None:
+                continue
+            for i, (ns, selector, key_col) in enumerate(specs):
+                if pod.namespace != ns or \
+                        not selector.matches(pod.labels):
+                    continue
+                dom = int(self._nt.topo_val[idx, key_col])
+                if dom >= 0:
+                    out[i][dom] = out[i].get(dom, 0) + 1
+        return out
+
+    def topo_domain_counts(self, namespace: str, selector,
+                           key_col: int) -> dict[int, int]:
+        """Single-term convenience over the bulk walk."""
+        return self.topo_domain_counts_bulk(
+            [(namespace, selector, key_col)])[0]
+
+    @_locked
+    def victim_table(self, max_victims: int, exclude: frozenset = frozenset()):
+        """Per-node victim candidates for the preemption solve: every
+        tracked pod (assumed or confirmed — both hold capacity), sorted
+        ascending by (priority, key) so the kernel's prefix-k IS the k
+        cheapest victims, padded to a pow2 victim axis.  At most
+        ``max_victims`` candidates per node are FILLED (the configured
+        blast-radius cap; the pow2 padding is rows, not extra victims).
+        ``exclude``: pod keys never eligible (the daemon protects the
+        current drain's own placements — a pod placed seconds ago must
+        not be evicted by the same drain's preemption pass).  Returns a
+        workloads.preemption.VictimTable."""
+        import numpy as np
+
+        from kubernetes_tpu.engine.workloads.preemption import VictimTable
+        self._ensure_tensors()
+        n = len(self._node_order)
+        v = 1 << max(max_victims - 1, 0).bit_length()
+        req = np.zeros((n, v, 4), np.int32)
+        prio = np.zeros((n, v), np.int32)
+        valid = np.zeros((n, v), bool)
+        keys: list[list[str]] = [[] for _ in range(n)]
+        for name, podmap in self._node_pods.items():
+            idx = self._nt.name_to_idx.get(name)
+            if idx is None or not podmap:
+                continue
+            cands = sorted(
+                (p for p in podmap.values() if p.key not in exclude),
+                key=lambda p: (p.effective_priority, p.key))
+            for j, pod in enumerate(cands[:max_victims]):
+                # The canonical (cpu, mem_mib ceil, gpu, 1) row, memoized
+                # on the pod — the same encoding the tensor solve uses,
+                # so the two can never disagree on units.
+                req[idx, j] = fc.pod_resource_row(pod)
+                prio[idx, j] = pod.effective_priority
+                valid[idx, j] = True
+                keys[idx].append(pod.key)
+        return VictimTable(req=req, prio=prio, valid=valid, keys=keys)
+
     @_locked
     def take_dirty_rows(self) -> set[int]:
         """Row indices mutated in place since the last take, cleared on

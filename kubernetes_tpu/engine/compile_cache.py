@@ -69,8 +69,39 @@ def configure() -> Optional[str]:
                 jax.config.update(knob, value)
             except Exception:  # noqa: BLE001 — older jaxlib: best effort
                 pass
+        _register_hit_miss_listener()
         _dir = path
         return _dir
+
+
+_listener_registered = False
+
+
+def _register_hit_miss_listener() -> None:
+    """Feed ``compile_cache_{hits,misses}_total`` from JAX's monitoring
+    events: a hit is a jit executable deserialized from the persistent
+    cache, a miss one that re-paid the full XLA compile.  Without them
+    the multi-second \"warm\" start is undiagnosable — the counters say
+    exactly which restarts still compile (ROADMAP item 3)."""
+    global _listener_registered
+    if _listener_registered:
+        return
+    try:
+        from jax import monitoring
+
+        from kubernetes_tpu.utils.metrics import (COMPILE_CACHE_HITS,
+                                                  COMPILE_CACHE_MISSES)
+
+        def _on_event(event: str, **kw) -> None:
+            if event == "/jax/compilation_cache/cache_hits":
+                COMPILE_CACHE_HITS.inc()
+            elif event == "/jax/compilation_cache/cache_misses":
+                COMPILE_CACHE_MISSES.inc()
+
+        monitoring.register_event_listener(_on_event)
+        _listener_registered = True
+    except Exception:  # noqa: BLE001 — observability only, never fatal
+        pass
 
 
 def cache_dir() -> Optional[str]:

@@ -32,6 +32,7 @@ from kubernetes_tpu.engine.extender_client import (ExtenderError,
                                                    HTTPExtender)
 from kubernetes_tpu.utils import metrics
 from kubernetes_tpu.features import batch as fb
+from kubernetes_tpu.features import compiler as fc
 from kubernetes_tpu.features import padcap
 from kubernetes_tpu.features.volumes import compile_volsvc
 from kubernetes_tpu.utils.logging import get_logger
@@ -149,6 +150,9 @@ class GenericScheduler:
         # instead of re-specializing whenever batch content wobbles.
         self._axis_caps: dict[str, int] = {}
         self._flags_seen: sv.BatchFlags | None = None
+        # Spread-constraint term tables for the batch _compile last saw
+        # (None = no pod carried topologySpreadConstraints).
+        self._topo_terms = None
 
     def _pinned_flags(self, batch) -> sv.BatchFlags:
         """Content flags OR-ed monotonically (padcap's discipline for the
@@ -167,6 +171,14 @@ class GenericScheduler:
     def _compile(self, pods: list[api.Pod], device: bool = True
                  ) -> tuple[fb.PodBatch, sv.DeviceBatch,
                             sv.DeviceCluster, list[str]]:
+        from kubernetes_tpu.engine.workloads import topology
+        # Topology keys named by spread constraints must be interned
+        # BEFORE the snapshot so topo_dom columns exist for them (a NEW
+        # key marks the node tensors dirty — once per workload type).
+        has_spread = topology.batch_has_spread(pods)
+        if has_spread:
+            for key in topology.spread_topology_keys(pods):
+                self.cache.ensure_topo_key(key)
         # The whole compile runs under the cache lock: cache mutators
         # (reflector handlers, async-bind forget_pod) update the aggregate
         # and existing-pod arrays IN PLACE, so every read — snapshot,
@@ -201,6 +213,12 @@ class GenericScheduler:
                         self.policy.hard_pod_affinity_symmetric_weight),
                     volsvc=volsvc)
                 batch = padcap.apply_caps(batch, self._axis_caps)
+                # Spread-constraint term tables (counts snapshotted under
+                # the same lock as everything else this solve reads).
+                self._topo_terms = topology.compile_terms(
+                    pods, nt, self.cache.space,
+                    self.cache.topo_domain_counts_bulk) \
+                    if has_spread else None
             with stage("transfer", device=device):
                 # device=False keeps the batch pytree on host (the chunked
                 # drain slices it in numpy and transfers fixed-shape
@@ -227,11 +245,23 @@ class GenericScheduler:
         trace.step("Computing predicates & priorities")
         feasible, scores = self.solver.evaluate(db, dc,
                                                 self._pinned_flags(batch))
+        topo_mask_np = None
+        if self._topo_terms is not None:
+            from kubernetes_tpu.engine.workloads import topology
+            tmask, tscore = topology.spread_planes(self._topo_terms,
+                                                   dc.topo_dom)
+            if tmask is not None:
+                feasible = feasible & tmask
+                topo_mask_np = np.asarray(tmask[0])
+            if tscore is not None:
+                scores = scores + tscore
         trace.step("Selecting host")
         feasible_np = np.asarray(feasible[0])
         if not feasible_np.any():
             masks = {k: np.asarray(v[0]) for k, v in
                      self.solver.masks(db, dc).items()}
+            if topo_mask_np is not None:
+                masks["TopologySpread"] = topo_mask_np
             failed: dict[str, list[str]] = {}
             for i, name in enumerate(nt.names):
                 if nt.schedulable[i]:
@@ -300,7 +330,8 @@ class GenericScheduler:
     # -- batched path ----------------------------------------------------
 
     def schedule_batch(self, pods: list[api.Pod],
-                       joint: bool = False) -> list[str | None]:
+                       joint: bool = False,
+                       pad_to: int = 0) -> list[str | None]:
         """Place a pending queue in one device solve.  Returns node names,
         None where unschedulable.
 
@@ -308,7 +339,13 @@ class GenericScheduler:
         visibility (decision parity with the reference's one-at-a-time
         loop).  ``joint=True`` runs the LP-relaxed global assignment
         (price iteration + regret-ordered repair) — better aggregate
-        placement quality, no per-pod order parity."""
+        placement quality, no per-pod order parity.
+
+        ``pad_to``: pad the batch to this length with live-masked inert
+        rows so the solve hits a fixed compiled shape (the workload-
+        constrained drain's bucket-ladder discipline — gang and joint
+        drains can't stream-chunk, so this is how their shapes stay
+        pre-warmable)."""
         if not pods:
             return []
         if not self.cache.nodes():
@@ -320,8 +357,23 @@ class GenericScheduler:
             # path with temporary assumes for in-batch visibility, then
             # restore (callers re-assume through the daemon).
             return self._schedule_batch_via_extenders(pods)
+        real_p = len(pods)
+        live = None
+        if pad_to > real_p:
+            pods = list(pods) + [
+                api.Pod(name=f"__pad-{i}", namespace="__pad__")
+                for i in range(pad_to - real_p)]
         batch, db, dc, nt = self._compile(pods)
         flags = self._pinned_flags(batch)
+        if pad_to > real_p:
+            live_np = np.zeros(len(pods), bool)
+            live_np[:real_p] = True
+            live = jnp.asarray(live_np)
+        extra_mask = score_bias = None
+        if self._topo_terms is not None:
+            from kubernetes_tpu.engine.workloads import topology
+            extra_mask, score_bias = topology.spread_planes(
+                self._topo_terms, dc.topo_dom)
         if log.isEnabledFor(10):
             log.debug("schedule_batch: %d pods (%d templates) x %d nodes, "
                       "joint=%s flags=%s", len(pods),
@@ -333,10 +385,12 @@ class GenericScheduler:
             with device_trace("solve_joint"), \
                     stage("solve", pods=len(pods), mode="joint"):
                 choices, new_last, _ = self.solver.solve_joint(
-                    db, dc, jnp.uint32(self.last_node_index), flags=flags)
+                    db, dc, jnp.uint32(self.last_node_index), flags=flags,
+                    extra_mask=extra_mask, score_bias=score_bias,
+                    live=live)
                 choices.block_until_ready()
             with stage("readback", pods=len(pods)):
-                rows = np.asarray(choices).tolist()
+                rows = np.asarray(choices)[:real_p].tolist()
             self.last_node_index = np.uint32(new_last)
         else:
             # One packed device->host fetch for the whole drain (each fetch
@@ -346,13 +400,15 @@ class GenericScheduler:
             with device_trace("solve_sequential"), \
                     stage("solve", pods=p, mode="sequential"):
                 host_dev = self.solver.solve_sequential_packed(
-                    db, dc, jnp.uint32(self.last_node_index), flags)
+                    db, dc, jnp.uint32(self.last_node_index), flags,
+                    extra_mask=extra_mask, score_bias=score_bias,
+                    live=live)
                 # Block here so the solve stage measures device compute
                 # and readback measures only the D2H copy.
                 host_dev.block_until_ready()
             with stage("readback", pods=p):
                 host = np.asarray(host_dev)
-            rows = host[:p].tolist()
+            rows = host[:real_p].tolist()
             self.last_node_index = np.uint32(host[p])
             # Device-aggregate handoff: the scan's final requested/nonzero
             # equal the snapshot plus every in-batch placement, so
@@ -365,7 +421,8 @@ class GenericScheduler:
             if not (flags.any_ports or flags.any_volumes or flags.any_ebs
                     or flags.any_gce):
                 placed_sig = hash(frozenset(
-                    (pod.key, rows[i]) for i, pod in enumerate(pods)
+                    (pod.key, rows[i])
+                    for i, pod in enumerate(pods[:real_p])
                     if rows[i] >= 0))
                 self._agg_handoff = (
                     self._snapshot_generation, placed_sig, nt,
@@ -439,6 +496,99 @@ class GenericScheduler:
                                for j in top_idx]}
         return out
 
+    # Preemption decisions computed per drain: the masks pass pads to
+    # this many pods (one compiled shape, the EXPLAIN_CAP discipline) and
+    # the per-decision eviction blast radius is bounded separately
+    # (workloads.preemption.MAX_VICTIMS).
+    PREEMPT_CAP = 16
+
+    def find_preemptions(self, pods: list[api.Pod],
+                         protected: frozenset = frozenset()) -> list:
+        """Minimal-cost victim sets for unschedulable priority pods — the
+        second batched solve (engine/workloads/preemption.py).
+
+        Per pod, in priority order: one vmapped ``victim_solve`` over the
+        (nodes x victims) table picks the cheapest feasible eviction
+        prefix per node; the host takes the (victim count, victim
+        priority sum, node index) argmin.  Decisions within one call see
+        each other through host-side overlays (victims already claimed
+        are consumed, the preemptor's own request charged), so two pods
+        never nominate the same victim.  ``protected`` keys are never
+        victims (the daemon shields the current drain's own placements).
+        The caller executes the decisions (evict -> assume -> bind,
+        scheduler/scheduler.py); it must have ASSUMED the batch's
+        placements first so the aggregates this solve reads include
+        them."""
+        from kubernetes_tpu.engine.workloads import preemption as pre
+        pods = [p for p in pods if p.effective_priority > 0]
+        pods.sort(key=lambda p: (-p.effective_priority, p.key))
+        pods = pods[:self.PREEMPT_CAP]
+        if not pods or not self.cache.nodes():
+            return []
+        padded = list(pods) + [
+            api.Pod(name=f"__preempt-pad-{i}", namespace="__pad__")
+            for i in range(self.PREEMPT_CAP - len(pods))]
+        batch, db, dc, nt = self._compile(padded)
+        # Non-resource predicate rows: victims free resources, nothing
+        # else — a node that only becomes selector/taint-feasible after
+        # eviction is never nominated (conservative).
+        masks = {name: np.asarray(m) for name, m in
+                 self.solver.masks(db, dc).items()}
+        base = np.broadcast_to(np.asarray(nt.schedulable, bool),
+                               (len(padded), nt.alloc.shape[0])).copy()
+        for name, m in masks.items():
+            if name not in ("PodFitsResources",):
+                base &= m
+        if self._topo_terms is not None:
+            from kubernetes_tpu.engine.workloads import topology
+            tmask, _ = topology.spread_planes(self._topo_terms,
+                                              dc.topo_dom)
+            if tmask is not None:
+                base &= np.asarray(tmask)
+        with self.cache.lock:
+            _, agg, _, _ = self.cache.snapshot()
+            vt = self.cache.victim_table(pre.MAX_VICTIMS,
+                                         exclude=protected)
+            requested = agg.requested.copy()
+        alloc = nt.alloc
+        vic_req, vic_prio, vic_valid = (vt.req.copy(), vt.prio.copy(),
+                                        vt.valid.copy())
+        vic_keys = [list(k) for k in vt.keys]
+        decisions = []
+        for i, pod in enumerate(pods):
+            pod_req = fc.pod_resource_row(pod)
+            k_min, cost, feas = pre.victim_solve(
+                jnp.asarray(alloc), jnp.asarray(requested),
+                jnp.asarray(base[i]), jnp.asarray(vic_req),
+                jnp.asarray(vic_prio), jnp.asarray(vic_valid),
+                jnp.asarray(pod_req),
+                jnp.asarray(bool(pod_req[0] == pod_req[1]
+                                 == pod_req[2] == 0)),
+                jnp.asarray(pod.effective_priority, jnp.int32))
+            n_idx = pre.pick_node(np.asarray(k_min), np.asarray(cost),
+                                  np.asarray(feas))
+            if n_idx is None:
+                continue
+            k = int(np.asarray(k_min)[n_idx])
+            victims = vic_keys[n_idx][:k]
+            decisions.append(pre.PreemptionDecision(
+                pod_key=pod.key, node=nt.names[n_idx], node_idx=n_idx,
+                victims=victims,
+                prio_cost=int(np.asarray(cost)[n_idx])))
+            # Overlay for later pods in this call: free the claimed
+            # victims' rows, charge the preemptor, shift the table.
+            freed = vic_req[n_idx, :k].sum(axis=0)
+            requested[n_idx] = requested[n_idx] - freed + pod_req
+            if k:
+                vic_req[n_idx] = np.concatenate(
+                    [vic_req[n_idx, k:], np.zeros((k, 4), np.int32)])
+                vic_prio[n_idx] = np.concatenate(
+                    [vic_prio[n_idx, k:], np.zeros(k, np.int32)])
+                vic_valid[n_idx] = np.concatenate(
+                    [vic_valid[n_idx, k:], np.zeros(k, bool)])
+                vic_keys[n_idx] = vic_keys[n_idx][k:]
+        return decisions
+
     def schedule_batch_stream(self, pods: list[api.Pod],
                               chunk_size: int = 2048,
                               defer_readback: bool = False):
@@ -482,6 +632,16 @@ class GenericScheduler:
         t_c0 = time.perf_counter()
         batch, hb, dc, nt = self._compile(all_pods, device=False)
         flags = self._pinned_flags(batch)
+        # Spread-constraint planes, host-resident like the batch: each
+        # chunk device_puts its fixed-shape row slice (pad rows carry no
+        # constraints, so their mask rows are all-pass).
+        topo_mask_np = topo_score_np = None
+        if self._topo_terms is not None:
+            from kubernetes_tpu.engine.workloads import topology
+            tmask, tscore = topology.spread_planes(self._topo_terms,
+                                                   dc.topo_dom)
+            topo_mask_np = None if tmask is None else np.asarray(tmask)
+            topo_score_np = None if tscore is None else np.asarray(tscore)
         if os.environ.get("KT_STREAM_DEBUG") == "1":
             shapes = {f: tuple(getattr(hb, f).shape)
                       for f in ("sel_required", "spread_node_counts",
@@ -523,13 +683,17 @@ class GenericScheduler:
                 db_k = jax.device_put(
                     sv.slice_pod_axis(hb, start, start + chunk_size))
                 live = jnp.asarray(live_np[start:start + chunk_size])
+                em_k = None if topo_mask_np is None else jax.device_put(
+                    topo_mask_np[start:start + chunk_size])
+                sb_k = None if topo_score_np is None else jax.device_put(
+                    topo_score_np[start:start + chunk_size])
             # The launch is async: device time surfaces in the next
             # chunk's readback, which is what keeps the pipeline
             # overlapped — this stage measures dispatch only.
             with device_trace("solve_stream_chunk"), \
                     stage("solve", chunk_at=start, mode="stream"):
                 choices_k, counter, carry = self.solver._solve_scan(
-                    db_k, dc, counter, None, flags, carry, live)
+                    db_k, dc, counter, sb_k, flags, carry, live, em_k)
             if debug_t:
                 t1 = time.perf_counter()
             pending.append((start, choices_k))

@@ -220,12 +220,18 @@ class DeviceCluster(NamedTuple):
     mem_pressure: jnp.ndarray   # [N] bool
     disk_pressure: jnp.ndarray  # [N] bool
     image_kib: jnp.ndarray      # [N,I] int32
+    # Topology tensor (engine/workloads/topology.py): per node, the
+    # compact domain id of each interned topology label key (-1 = node
+    # lacks the label).  The (nodes x topology_domains) one-hot planes the
+    # spread kernels consume expand from these ids on device; the ids ride
+    # the same dirty-row scatter protocol as every other cluster column.
+    topo_dom: jnp.ndarray       # [N,K] int32
 
 
-def _pad_cols(a: np.ndarray, width: int) -> np.ndarray:
+def _pad_cols(a: np.ndarray, width: int, fill=0) -> np.ndarray:
     if a.shape[1] == width:
         return a
-    out = np.zeros((a.shape[0], width), a.dtype)
+    out = np.full((a.shape[0], width), fill, a.dtype)
     out[:, : a.shape[1]] = a
     return out
 
@@ -269,7 +275,8 @@ def _host_cluster(nt: NodeTensors, agg: NodeAggregates,
         has_taints=nt.taints_nosched.any(1) | nt.taints_prefer.any(1),
         mem_pressure=nt.mem_pressure,
         disk_pressure=nt.disk_pressure,
-        image_kib=_pad_cols(nt.image_kib, space.images.capacity))
+        image_kib=_pad_cols(nt.image_kib, space.images.capacity),
+        topo_dom=_pad_cols(nt.topo_val, space.topo_keys.capacity, fill=-1))
 
 
 def device_cluster(nt: NodeTensors, agg: NodeAggregates,
@@ -348,7 +355,8 @@ class ResidentCluster:
         resident copy cannot be patched (see class docstring)."""
         n = nt.alloc.shape[0]
         sig = (n, space.ports.capacity, space.volumes.capacity,
-               nt.taints_nosched.shape[1], space.images.capacity)
+               nt.taints_nosched.shape[1], space.images.capacity,
+               space.topo_keys.capacity)
         if self.dc is None or self._sig != sig or self._epoch != epoch \
                 or len(dirty) * self.FULL_FRACTION >= max(n, 1):
             self.dc = device_cluster(nt, agg, space)
@@ -380,7 +388,9 @@ class ResidentCluster:
             has_taints=tn.any(1) | tp.any(1),
             mem_pressure=nt.mem_pressure[idx],
             disk_pressure=nt.disk_pressure[idx],
-            image_kib=_pad_cols(nt.image_kib[idx], space.images.capacity))
+            image_kib=_pad_cols(nt.image_kib[idx], space.images.capacity),
+            topo_dom=_pad_cols(nt.topo_val[idx],
+                               space.topo_keys.capacity, fill=-1))
         pad = 1 << (len(dirty) - 1).bit_length()
         if pad > len(dirty):
             extra = pad - len(dirty)
@@ -616,28 +626,40 @@ class Solver:
 
     def solve_sequential(self, b: DeviceBatch, c: DeviceCluster,
                          last_node_index: jnp.ndarray,
-                         flags: BatchFlags | None = None
+                         flags: BatchFlags | None = None,
+                         extra_mask: jnp.ndarray | None = None,
+                         score_bias: jnp.ndarray | None = None
                          ) -> tuple[jnp.ndarray, jnp.ndarray, DeviceCluster]:
         """Greedy in-order placement with on-device state updates.
+
+        ``extra_mask``/``score_bias``: optional [P,N] workload-constraint
+        planes (topology spread, engine/workloads/topology.py) ANDed into
+        feasibility / added to the static score.
 
         Returns (choices [P] int32 node index or -1, new last_node_index,
         updated cluster aggregates)."""
         if flags is None:
             flags = batch_flags(b)
         choices, counter, final = self._solve_scan(
-            b, c, last_node_index, None, flags)
+            b, c, last_node_index, score_bias, flags, None, None,
+            extra_mask)
         return choices, counter, self._carry_cluster(c, final)
 
     def solve_sequential_packed(self, b: DeviceBatch, c: DeviceCluster,
                                 last_node_index: jnp.ndarray,
-                                flags: BatchFlags) -> jnp.ndarray:
+                                flags: BatchFlags,
+                                extra_mask: jnp.ndarray | None = None,
+                                score_bias: jnp.ndarray | None = None,
+                                live: jnp.ndarray | None = None
+                                ) -> jnp.ndarray:
         """solve_sequential, with every host-bound result packed into ONE
         int32 vector: [choices (P), counter (1), requested (4N), nonzero
         (2N)].  On a tunneled device each device->host fetch pays a full
         RTT (~250 ms measured), so the daemon fetches exactly one array per
         drain and unpacks host-side."""
         choices, counter, final = self._solve_scan(
-            b, c, last_node_index, None, flags)
+            b, c, last_node_index, score_bias, flags, None, live,
+            extra_mask)
         return jnp.concatenate([
             choices, counter.astype(jnp.int32)[None],
             final["requested"].ravel(), final["nonzero"].ravel()])
@@ -656,16 +678,19 @@ class Solver:
                     last_node_index: jnp.ndarray, score_bias: jnp.ndarray,
                     flags: BatchFlags = ALL_ON_FLAGS,
                     carry: dict | None = None,
-                    live: jnp.ndarray | None = None
+                    live: jnp.ndarray | None = None,
+                    extra_mask: jnp.ndarray | None = None
                     ) -> tuple[jnp.ndarray, jnp.ndarray, dict]:
         """The sequential scan, with an additive per-(pod,node) score bias
         (zero for parity greedy; price-shaped for the joint solver).
 
         ``flags`` compiles away dynamic-state families the batch cannot
-        touch; ``carry`` continues a previous scan's final state (chunked
+        touch; ``carry`` continues a previous chunk's final state (chunked
         drain) — flags MUST come from the full batch, not the chunk, so
-        every chunk carries the same state shape.  Returns (choices [P],
-        counter, final state dict)."""
+        every chunk carries the same state shape.  ``extra_mask`` [P,N] is
+        an additional hard feasibility plane (workload constraints —
+        topology spread's DoNotSchedule terms); None compiles it away.
+        Returns (choices [P], counter, final state dict)."""
         n = c.alloc.shape[0]
         p = b.request.shape[0]
         a = b.aff
@@ -705,6 +730,10 @@ class Solver:
             # Chunk padding: dead rows are infeasible everywhere, place
             # nothing, and bump no counter (hoisted — zero per-step cost).
             static_mask &= live[:, None]
+        if extra_mask is not None:
+            # Workload-constraint hard plane (batch-start topology spread):
+            # hoisted like every other static predicate.
+            static_mask &= extra_mask
         # None bias (the greedy path) becomes a zeros plane inside the jit,
         # which XLA elides — callers avoid materializing a [P,N] zeros arg.
         static_score = score_bias if score_bias is not None \
@@ -962,7 +991,9 @@ class Solver:
 
     @functools.partial(jax.jit, static_argnums=(0, 3))
     def _price_iterate(self, b: DeviceBatch, c: DeviceCluster,
-                       n_iters: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+                       n_iters: int,
+                       extra_mask: jnp.ndarray | None = None
+                       ) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Dual-price iteration for the joint assignment objective.
 
         The batched placement is a generalized assignment problem: maximize
@@ -977,6 +1008,8 @@ class Solver:
         Returns (score_bias [P, N] = -price cost, repair-order key [P]).
         """
         feasible, scores = self.evaluate(b, c)
+        if extra_mask is not None:
+            feasible &= extra_mask
         f32 = jnp.float32
         free = jnp.maximum((c.alloc[:, :3] - c.requested[:, :3]).astype(f32),
                            1.0)                          # [N, 3]
@@ -1018,27 +1051,58 @@ class Solver:
             (20.0 * score_span) + jnp.where(jnp.isfinite(regret), regret, 0.0)
         return -cost, key
 
+    @functools.partial(jax.jit, static_argnums=(0, 7, 8))
+    def _solve_joint_jit(self, b: DeviceBatch, c: DeviceCluster,
+                         last_node_index: jnp.ndarray,
+                         extra_mask: jnp.ndarray | None,
+                         score_bias: jnp.ndarray | None,
+                         live: jnp.ndarray | None,
+                         n_iters: int, flags: BatchFlags
+                         ) -> tuple[jnp.ndarray, jnp.ndarray, dict]:
+        """The WHOLE joint pipeline (price iteration -> regret ordering ->
+        pod-axis permutation -> repair scan -> inverse permutation) as ONE
+        jitted executable.  The pre-r6 host-side glue dispatched ~75
+        individual device ops per solve (argsort + one jnp.take per
+        DeviceBatch field), each minting its own shape-keyed executable
+        OUTSIDE the jit cache — none of which the persistent compilation
+        cache could amortize as a unit.  One trace means one XLA program,
+        persisted once, deserialized on every later start
+        (tests/test_joint_solver.py pins the cold-vs-warm gap)."""
+        bias, key = self._price_iterate(b, c, n_iters, extra_mask)
+        if score_bias is not None:
+            bias = bias + score_bias
+        order = jnp.argsort(-key)   # biggest, then highest-regret, first
+        pb = permute_pod_axis(b, order)
+        pbias = jnp.take(bias, order, axis=0)
+        pmask = None if extra_mask is None else \
+            jnp.take(extra_mask, order, axis=0)
+        plive = None if live is None else jnp.take(live, order)
+        choices_p, counter, final = self._solve_scan(
+            pb, c, last_node_index, pbias, flags, None, plive, pmask)
+        inv = jnp.argsort(order)
+        return jnp.take(choices_p, inv), counter, final
+
     def solve_joint(self, b: DeviceBatch, c: DeviceCluster,
                     last_node_index: jnp.ndarray, n_iters: int = 24,
-                    flags: BatchFlags | None = None
+                    flags: BatchFlags | None = None,
+                    extra_mask: jnp.ndarray | None = None,
+                    score_bias: jnp.ndarray | None = None,
+                    live: jnp.ndarray | None = None
                     ) -> tuple[jnp.ndarray, jnp.ndarray, DeviceCluster]:
         """Joint batched assignment: price iteration + regret-ordered greedy
         repair.  Same return contract as solve_sequential; placements honor
         EVERY predicate (the repair pass is the exact sequential scan, just
-        price-shaped and reordered).  Quality (summed score, placement
-        count) is benchmarked against the greedy baseline — BASELINE.json's
-        last config."""
+        price-shaped and reordered) plus the workload-constraint
+        ``extra_mask``/``score_bias`` planes.  ``live`` marks real rows
+        when the caller padded the batch to a warm bucket.  Quality
+        (summed score, placement count) is benchmarked against the greedy
+        baseline — BASELINE.json's last config."""
         if flags is None:
             flags = batch_flags(b)
-        bias, key = self._price_iterate(b, c, n_iters)
-        order = jnp.argsort(-key)   # biggest, then highest-regret, first
-        pb = permute_pod_axis(b, order)
-        pbias = jnp.take(bias, order, axis=0)
-        choices_p, counter, final = self._solve_scan(
-            pb, c, last_node_index, pbias, flags)
-        inv = jnp.argsort(order)
-        return jnp.take(choices_p, inv), counter, \
-            self._carry_cluster(c, final)
+        choices, counter, final = self._solve_joint_jit(
+            b, c, last_node_index, extra_mask, score_bias, live,
+            n_iters, flags)
+        return choices, counter, self._carry_cluster(c, final)
 
 
 # Pod-axis fields of DeviceBatch (dim 0 = P) for permutation/sharding.

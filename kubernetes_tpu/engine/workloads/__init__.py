@@ -1,0 +1,36 @@
+"""Workload-constraints subsystem: gang scheduling, priority preemption,
+and topology-aware spread as batched tensor operations.
+
+The dense mask/score pipeline (engine/solver.py) covers per-pod
+fit-and-score; this package lowers three *workload-level* constraint
+classes into that same pipeline — the scenarios Borg makes first-class
+(job-level admission, priority preemption; Verma et al., EuroSys 2015)
+and Firmament gains quality from by solving whole problems at once
+(Gog et al., OSDI 2016):
+
+``gang``
+    All-or-nothing admission for pods sharing a
+    ``scheduling.kt.io/gang`` annotation (TPU multi-slice jobs): a
+    post-solve feasibility reduction over the assignment vector rejects
+    incomplete gangs atomically; members requeue with backoff and drain
+    again as a unit.
+
+``preemption``
+    When a priority-carrying pod fits nowhere, a second batched solve
+    over the victim set (every tracked pod of strictly lower priority,
+    reconstructed per node from the resident cluster) picks the
+    minimal-cost victim set via a vmapped cluster-minus-victims prefix
+    reduction, and the daemon executes evict -> assume -> bind with
+    nominated-node plumbing through the flight recorder.
+
+``topology``
+    ``topologySpreadConstraints`` (and the affinity planes already in
+    the solver) as mask/score planes contracted against the
+    ``DeviceCluster.topo_dom`` (nodes x topology-keys) domain-id tensor —
+    the compressed encoding of the (nodes x topology_domains) one-hot,
+    expanded on device per constraint term by gather.
+"""
+
+from kubernetes_tpu.engine.workloads import gang, preemption, topology
+
+__all__ = ["gang", "preemption", "topology"]

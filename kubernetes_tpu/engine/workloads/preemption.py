@@ -1,0 +1,130 @@
+"""Priority preemption: the second batched solve over the victim set.
+
+When a priority-carrying pod's feasibility row is all-false, the
+scheduler asks a different question: which node COULD host it after
+evicting some strictly-lower-priority pods, and what is the cheapest such
+eviction?  The reference answers per node with pod-by-pod simulation;
+here it is one vmapped reduction over the whole cluster:
+
+* the cache reconstructs the VICTIM TABLE from its tracked (assumed +
+  confirmed) pods: per node, victims sorted ascending by (priority, key)
+  and padded to a power-of-two V (SchedulerCache.victim_table);
+* ``victim_solve`` computes, for EVERY node at once, the minimal victim
+  count k whose eviction lets the pod fit — the "cluster minus victims"
+  row update is the prefix-sum ``requested - cumsum(victim_requests)``,
+  so prefix k is exactly the k cheapest (lowest-priority) victims;
+* the host picks the node minimizing (victim count, summed victim
+  priority, node index) — fewest evictions first, then least important
+  victims, deterministic tie-break (the parity oracle replays the same
+  order, kubernetes_tpu/oracle.py).
+
+Victims are strictly lower priority by construction (the eligibility
+mask), and non-resource predicates (selectors, taints, pressure) are
+required to pass WITH the victims still present — conservative: a node
+that only becomes selector-feasible after eviction is never nominated.
+
+The daemon executes a decision as evict -> assume -> bind
+(scheduler/scheduler.py._execute_preemption) with the nominated node
+recorded in the flight recorder and surfaced by ``kubectl explain``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from dataclasses import dataclass, field
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.features.compiler import RES_PODS
+
+# Victim-table width: victims per node considered, padded pow2.  Bounds
+# both the kernel shape and the blast radius of one decision.
+MAX_VICTIMS = int(os.environ.get("KT_PREEMPT_MAX_VICTIMS", "16") or "16")
+
+
+class VictimTable(NamedTuple):
+    """Per-node victim candidates (host side; see SchedulerCache
+    .victim_table).  Rows sorted ascending by (priority, pod key)."""
+
+    req: np.ndarray       # [N, V, 4] int32 (cpu, mem_mib, gpu, 1)
+    prio: np.ndarray      # [N, V] int32
+    valid: np.ndarray     # [N, V] bool
+    keys: list            # [N] lists of pod keys, aligned with rows
+
+
+@dataclass
+class PreemptionDecision:
+    pod_key: str
+    node: str
+    node_idx: int
+    victims: list[str] = field(default_factory=list)
+    prio_cost: int = 0
+
+
+@functools.partial(jax.jit)
+def victim_solve(alloc: jnp.ndarray, requested: jnp.ndarray,
+                 base_ok: jnp.ndarray, vic_req: jnp.ndarray,
+                 vic_prio: jnp.ndarray, vic_valid: jnp.ndarray,
+                 pod_req: jnp.ndarray, pod_zero: jnp.ndarray,
+                 pod_prio: jnp.ndarray
+                 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Minimal victim prefix per node: (k_min [N], prio_cost [N],
+    feasible [N]).  ``base_ok`` is the pod's non-resource predicate row
+    (victims present); ``pod_zero`` the zero-request escape hatch
+    (predicates.go:463 — a zero-request pod only needs a pod slot)."""
+    eligible = vic_valid & (vic_prio < pod_prio)             # [N, V]
+    k_elig = jnp.sum(eligible, axis=1)                       # [N]
+    vreq = vic_req * eligible[..., None].astype(vic_req.dtype)
+    cum = jnp.cumsum(vreq, axis=1)                           # [N, V, 4]
+    cumz = jnp.concatenate([jnp.zeros_like(cum[:, :1]), cum], axis=1)
+    free = alloc[:, None, :] - requested[:, None, :] + cumz  # [N, V+1, 4]
+    fits_res = jnp.all(pod_req[None, None, :3] <= free[..., :3],
+                       axis=-1) | pod_zero
+    fits_pods = free[..., RES_PODS] >= 1
+    ks = jnp.arange(cumz.shape[1], dtype=jnp.int32)          # [V+1]
+    feasible_k = fits_res & fits_pods & \
+        (ks[None, :] <= k_elig[:, None]) & base_ok[:, None]
+    k_min = jnp.argmax(feasible_k, axis=1).astype(jnp.int32)
+    any_k = jnp.any(feasible_k, axis=1)
+    prio_cum = jnp.concatenate(
+        [jnp.zeros_like(vic_prio[:, :1]),
+         jnp.cumsum(vic_prio * eligible, axis=1)], axis=1)
+    prio_cost = jnp.take_along_axis(prio_cum, k_min[:, None],
+                                    axis=1)[:, 0].astype(jnp.int32)
+    return k_min, prio_cost, any_k
+
+
+def pick_node(k_min: np.ndarray, prio_cost: np.ndarray,
+              feasible: np.ndarray) -> Optional[int]:
+    """argmin over (victim count, summed victim priority, node index) —
+    the deterministic cost order both the engine and the parity oracle
+    use.  None when no node is feasible even after evictions."""
+    idx = np.flatnonzero(np.asarray(feasible, bool))
+    if idx.size == 0:
+        return None
+    k = np.asarray(k_min)[idx]
+    c = np.asarray(prio_cost)[idx]
+    order = np.lexsort((idx, c, k))
+    return int(idx[order[0]])
+
+
+def prewarm_shapes(n_nodes: int, v: int = 0) -> None:
+    """Trace ``victim_solve`` at the cluster's (N, V) shape so the first
+    live preemption never pays its XLA compile (Scheduler.prewarm's
+    bucket-ladder discipline extended to the workloads subsystem).  V is
+    pow2-padded exactly like SchedulerCache.victim_table pads its rows —
+    a non-pow2 KT_PREEMPT_MAX_VICTIMS must warm the shape the live
+    solve actually runs at."""
+    v = v or MAX_VICTIMS
+    v = 1 << max(v - 1, 0).bit_length()
+    n = max(n_nodes, 1)
+    victim_solve(
+        jnp.zeros((n, 4), jnp.int32), jnp.zeros((n, 4), jnp.int32),
+        jnp.zeros(n, bool), jnp.zeros((n, v, 4), jnp.int32),
+        jnp.zeros((n, v), jnp.int32), jnp.zeros((n, v), bool),
+        jnp.zeros(4, jnp.int32), jnp.asarray(False),
+        jnp.asarray(0, jnp.int32))[0].block_until_ready()

@@ -1,0 +1,185 @@
+"""Topology-aware spread: ``topologySpreadConstraints`` as vmapped
+mask/score planes over the cluster topology tensor.
+
+Every node row of ``DeviceCluster.topo_dom`` holds the compact domain id
+of each interned topology label key (-1 = the node lacks the label) —
+the compressed encoding of the (nodes x topology_domains) one-hot, which
+the kernel expands per constraint term by gather (materializing the full
+one-hot would be O(N x D) with hostname-keyed domains making D ~ N).
+
+A batch's constraints compile to per-TERM tables (one term per distinct
+(namespace, selector, topologyKey, maxSkew, whenUnsatisfiable)
+signature, shared by every pod carrying it — the template-dedup
+discipline of features/batch.py):
+
+    key_col [T]    column of topo_dom the term reads
+    max_skew [T]   admissible count spread above the least-loaded domain
+    hard [T]       DoNotSchedule (mask plane) vs ScheduleAnyway (score)
+    counts [T, D]  matching-pod count per domain at batch start
+    valid [T, D]   domain exists among schedulable nodes (min runs here)
+    src [P, T]     pod p carries term t
+
+``spread_planes`` contracts these against ``topo_dom`` into a [P, N]
+hard mask (placing must not push the domain more than max_skew above the
+global minimum; nodes lacking the key fail hard terms, the reference's
+DoNotSchedule semantics) and a [P, N] soft score (negative skew delta).
+
+Counts are snapshotted at batch START (the ServiceAntiAffinity pre-r4
+discipline): in-batch placements of the same spread group do not move
+them mid-scan.  The parity/property tests drive multi-drain sequences
+where this matters; ARCHITECTURE.md documents the drift bound.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+
+
+class SpreadTerms(NamedTuple):
+    """Host-side term tables (device_put by ``spread_planes``)."""
+
+    key_col: np.ndarray    # [T] int32
+    max_skew: np.ndarray   # [T] float32
+    hard: np.ndarray       # [T] bool
+    counts: np.ndarray     # [T, D] float32
+    valid: np.ndarray      # [T, D] bool
+    src: np.ndarray        # [P, T] bool
+    any_hard: bool
+    any_soft: bool
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    return max(1 << max(n - 1, 0).bit_length(), floor)
+
+
+def batch_has_spread(pods: Sequence) -> bool:
+    return any(api.TOPOLOGY_SPREAD_ANNOTATION_KEY in pod.annotations
+               for pod in pods)
+
+
+def spread_topology_keys(pods: Sequence) -> set[str]:
+    """Topology keys named by any constraint in the batch — the engine
+    interns these (cache.ensure_topo_key) BEFORE the snapshot so the
+    topo_dom columns exist."""
+    keys: set[str] = set()
+    for pod in pods:
+        if api.TOPOLOGY_SPREAD_ANNOTATION_KEY in pod.annotations:
+            for tsc in pod.topology_spread_constraints():
+                if tsc.topology_key:
+                    keys.add(tsc.topology_key)
+    return keys
+
+
+def compile_terms(pods: Sequence, nt, space,
+                  domain_counts_bulk: Callable[[list],
+                                               list[dict[int, int]]]
+                  ) -> Optional[SpreadTerms]:
+    """Build the per-term tables for a batch (None when no pod carries a
+    constraint).  ``domain_counts_bulk([(namespace, selector,
+    key_col)])`` is the cache's domain bookkeeping
+    (SchedulerCache.topo_domain_counts_bulk): matching tracked-pod count
+    per domain id for every term in ONE pod walk, assumed pods included.
+
+    T and D are padded to powers of two (padcap's discipline) so the
+    plane kernel compiles O(log) shapes as workloads churn."""
+    p = len(pods)
+    term_of: dict[tuple, int] = {}
+    rows: list[tuple] = []   # (key_col, max_skew, hard, ns, selector)
+    src_pairs: list[tuple[int, int]] = []
+    for i, pod in enumerate(pods):
+        if api.TOPOLOGY_SPREAD_ANNOTATION_KEY not in pod.annotations:
+            continue
+        for tsc in pod.topology_spread_constraints():
+            col = space.topo_keys.get(tsc.topology_key)
+            if col < 0:
+                continue  # key never interned: no node can carry it yet
+            sel = tsc.label_selector
+            sig = (pod.namespace, col, tsc.max_skew, tsc.hard,
+                   sel if sel is not None else ("__self__",
+                                                tuple(sorted(
+                                                    pod.labels.items()))))
+            ti = term_of.get(sig)
+            if ti is None:
+                ti = len(rows)
+                term_of[sig] = ti
+                # A nil selector spreads the pod's own label set (the
+                # common "spread my replicas" shorthand).
+                eff_sel = sel if sel is not None else api.LabelSelector(
+                    match_labels=tuple(sorted(pod.labels.items())))
+                rows.append((col, tsc.max_skew, tsc.hard, pod.namespace,
+                             eff_sel))
+            src_pairs.append((i, ti))
+    if not rows:
+        return None
+    t_cap = _pow2(len(rows))
+    d_cap = _pow2(max(len(space.topo_vals), 1), floor=8)
+    key_col = np.zeros(t_cap, np.int32)
+    max_skew = np.full(t_cap, np.float32(1e9))  # pad terms constrain nothing
+    hard = np.zeros(t_cap, bool)
+    counts = np.zeros((t_cap, d_cap), np.float32)
+    valid = np.zeros((t_cap, d_cap), bool)
+    src = np.zeros((p, t_cap), bool)
+    sched = np.asarray(nt.schedulable, bool)
+    all_counts = domain_counts_bulk(
+        [(ns, sel, col) for col, _, _, ns, sel in rows])
+    for ti, (col, skew, is_hard, ns, sel) in enumerate(rows):
+        key_col[ti] = col
+        max_skew[ti] = skew
+        hard[ti] = is_hard
+        doms = nt.topo_val[sched, col]
+        for d in np.unique(doms[doms >= 0]):
+            valid[ti, int(d)] = True
+        for dom, cnt in all_counts[ti].items():
+            if 0 <= dom < d_cap:
+                counts[ti, dom] = cnt
+    for i, ti in enumerate(src_pairs):
+        src[ti[0], ti[1]] = True
+    return SpreadTerms(key_col, max_skew, hard, counts, valid, src,
+                       any_hard=bool(hard.any()),
+                       any_soft=bool((~hard[: len(rows)]).any()))
+
+
+@functools.partial(jax.jit)
+def _planes_kernel(key_col: jnp.ndarray, max_skew: jnp.ndarray,
+                   hard: jnp.ndarray, counts: jnp.ndarray,
+                   valid: jnp.ndarray, src: jnp.ndarray,
+                   topo_dom: jnp.ndarray
+                   ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[P,N] (mask, score) from the term tables and the cluster topology
+    tensor.  The per-term one-hot expansion is the take_along_axis gather
+    (counts[t, dom[n, key_col[t]]]) — sparse, never O(N x D)."""
+    f32 = jnp.float32
+    dom_tn = topo_dom[:, key_col].T                        # [T, N]
+    cnt_tn = jnp.take_along_axis(counts, jnp.clip(dom_tn, 0), axis=1)
+    big = f32(1e9)
+    min_t = jnp.min(jnp.where(valid, counts, big), axis=1)
+    min_t = jnp.where(min_t >= big, 0.0, min_t)            # no valid domain
+    has = dom_tn >= 0
+    ok = (cnt_tn + 1.0 - min_t[:, None]) <= max_skew[:, None]
+    viol_tn = ((~has) | ~ok).astype(f32)                   # [T, N]
+    hard_viol = viol_tn * hard.astype(f32)[:, None]
+    srcf = src.astype(f32)                                 # [P, T]
+    mask = (srcf @ hard_viol) < 0.5
+    soft_tn = jnp.where((~hard)[:, None] & has,
+                        -(cnt_tn - min_t[:, None]), 0.0)
+    score = srcf @ soft_tn
+    return mask, score
+
+
+def spread_planes(terms: SpreadTerms, topo_dom: jnp.ndarray
+                  ) -> tuple[Optional[jnp.ndarray], Optional[jnp.ndarray]]:
+    """(extra_mask, score_bias) planes for the solver — None for a plane
+    no term populates (the scan then compiles it away entirely)."""
+    mask, score = _planes_kernel(
+        jnp.asarray(terms.key_col), jnp.asarray(terms.max_skew),
+        jnp.asarray(terms.hard), jnp.asarray(terms.counts),
+        jnp.asarray(terms.valid), jnp.asarray(terms.src), topo_dom)
+    return (mask if terms.any_hard else None,
+            score if terms.any_soft else None)

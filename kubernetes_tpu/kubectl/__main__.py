@@ -754,6 +754,12 @@ def cmd_explain(opts, out) -> int:
     print(f"Result:\t{decision.get('result')}", file=out)
     if decision.get("node"):
         print(f"Node:\t{decision['node']}", file=out)
+    if decision.get("nominated_node"):
+        print(f"Nominated node:\t{decision['nominated_node']} "
+              f"(placed by preemption)", file=out)
+    victims = decision.get("preempted_victims") or []
+    if victims:
+        print(f"Preempted victims:\t{', '.join(victims)}", file=out)
     if decision.get("message"):
         print(f"Message:\t{decision['message']}", file=out)
     preds = decision.get("failed_predicates") or {}

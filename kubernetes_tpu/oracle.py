@@ -742,6 +742,67 @@ def prioritize(pod: api.Pod, cluster: ClusterState) -> dict[str, int]:
     return out
 
 
+def preempt_candidates(pod: api.Pod, cluster: ClusterState,
+                       max_victims: int = 16) -> dict[str, tuple[int, int]]:
+    """Per-node minimal-cost victim prefix for an unschedulable priority
+    pod — the pure-Python mirror of the tensor victim solve
+    (engine/workloads/preemption.py), for differential parity testing.
+
+    For each ready node whose NON-resource predicates pass with the
+    victims still present, victims (pods of strictly lower priority) are
+    sorted ascending by (priority, key) and the smallest prefix k whose
+    eviction lets the pod fit is found.  Returns node name ->
+    (k, summed victim priority) for feasible nodes."""
+    out: dict[str, tuple[int, int]] = {}
+    prio = pod.effective_priority
+    meta = matching_anti_affinity_terms(pod, cluster)
+    for node in cluster.ready_nodes():
+        node_pods = cluster.node_pods(node.name)
+        checks = [
+            volume_zone(pod, node, cluster),
+            max_pd_volume_count(pod, node_pods, "ebs", DEFAULT_MAX_EBS,
+                                cluster),
+            max_pd_volume_count(pod, node_pods, "gce", DEFAULT_MAX_GCE,
+                                cluster),
+            inter_pod_affinity(pod, node, cluster, meta),
+            no_disk_conflict(pod, node_pods),
+            pod_fits_host(pod, node),
+            pod_fits_host_ports(pod, node_pods),
+            pod_matches_node_labels(pod, node),
+            pod_tolerates_node_taints(pod, node),
+            check_node_memory_pressure(pod, node),
+            check_node_disk_pressure(pod, node),
+        ]
+        if not all(checks):
+            continue
+        victims = sorted(node_pods,
+                         key=lambda p: (p.effective_priority, p.key))
+        victims = victims[:max_victims]
+        eligible = [v for v in victims if v.effective_priority < prio]
+        for k in range(len(eligible) + 1):
+            remaining = [p for p in node_pods
+                         if p.key not in {v.key for v in eligible[:k]}]
+            if pod_fits_resources(pod, node, remaining):
+                out[node.name] = (
+                    k, sum(v.effective_priority for v in eligible[:k]))
+                break
+    return out
+
+
+def preempt(pod: api.Pod, cluster: ClusterState,
+            max_victims: int = 16) -> Optional[tuple[str, int, int]]:
+    """The argmin preemption decision: (node, victim count, priority
+    cost), minimizing (victim count, summed victim priority, node index
+    in cluster order) — the engine's deterministic cost order.  None when
+    no node works even after evictions."""
+    cands = preempt_candidates(pod, cluster, max_victims)
+    if not cands:
+        return None
+    node_order = {n.name: i for i, n in enumerate(cluster.nodes)}
+    name = min(cands, key=lambda nm: (*cands[nm], node_order[nm]))
+    return (name, *cands[name])
+
+
 def schedule(pod: api.Pod, cluster: ClusterState) -> set[str]:
     """The reference Schedule's argmax set: all hosts selectHost could pick
     (its tie order is nondeterministic Go map iteration, so parity is

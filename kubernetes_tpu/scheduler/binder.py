@@ -73,6 +73,12 @@ class InMemoryBinder:
         with self._lock:
             self._bound.pop(pod_key, None)
 
+    def evict(self, pod: api.Pod) -> None:
+        """Preemption eviction (the daemon's evict->assume->bind path,
+        workloads/preemption.py): the victim's binding is released so the
+        preemptor's CAS bind can land."""
+        self.unbind(pod.key)
+
     def count(self) -> int:
         with self._lock:
             return len(self._bound)
@@ -122,6 +128,12 @@ class APIClientBinder:
 
     def bind(self, pod: api.Pod, node_name: str) -> None:
         self.client.bind(pod.namespace, pod.name, node_name)
+
+    def evict(self, pod: api.Pod) -> None:
+        """Preemption eviction over the wire: DELETE the victim pod (the
+        reference's preemption deletes victims through the apiserver; the
+        watch then confirms the removal cluster-wide)."""
+        self.client.delete("pods", pod.key)
 
     def _bind_one(self, item):
         pod, dest = item

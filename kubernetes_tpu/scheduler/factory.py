@@ -46,6 +46,13 @@ class MemStoreBinder:
     def bind(self, pod: api.Pod, node_name: str) -> None:
         self.store.bind(pod.namespace, pod.name, node_name)
 
+    def evict(self, pod: api.Pod) -> None:
+        """Preemption eviction: delete the victim pod from the store."""
+        try:
+            self.store.delete("pods", pod.key)
+        except KeyError:
+            pass  # already gone (watch raced the eviction)
+
 
 def make_event_sink(source: Union[MemStore, APIClient]):
     """An EventRecorder sink that posts Events as API objects

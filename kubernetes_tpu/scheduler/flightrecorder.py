@@ -139,6 +139,28 @@ class FlightRecorder:
                               {pod_key: None}, {pod_key: entry})
             self._ring.append(rec)
 
+    def record_preemption(self, pod_key: str, node: str,
+                          victims: list[str]) -> None:
+        """A preemption decision promoted this pod from unschedulable to
+        placed-with-evictions: amend its newest record with the nominated
+        node and victim set (the reference's status.nominatedNodeName,
+        surfaced by ``kubectl explain``)."""
+        detail = {"nominated_node": node,
+                  "preempted_victims": list(victims)}
+        with self._lock:
+            for rec in reversed(self._ring):
+                if pod_key not in rec.placements:
+                    continue
+                if rec.placements.get(pod_key) is None:
+                    rec.placements[pod_key] = node
+                    rec.placed += 1
+                old = rec.failures.get(pod_key)
+                rec.failures[pod_key] = {**old, **detail} if old else detail
+                return
+            rec = BatchRecord(next(self._seq), "", time.time(), 0.0,
+                              {pod_key: node}, {pod_key: detail})
+            self._ring.append(rec)
+
     # -- querying ---------------------------------------------------------
 
     def explain(self, pod_key: str) -> dict | None:

@@ -89,6 +89,9 @@ class Scheduler:
         self.pipeline_window = int(os.environ.get(
             "KT_PIPELINE_WINDOW", "2") or "2")
         self._commit_pool = None
+        # Workload-subsystem prewarm timings (string-keyed; see
+        # _prewarm_workloads) — {} until prewarm() runs.
+        self.workloads_prewarm_s: dict = {}
         # Live queue depth at expose time (a set-per-mutation gauge would
         # put two lock acquisitions on every enqueue).
         config.metrics.queue_depth.set_fn(lambda: len(self.queue))
@@ -128,6 +131,15 @@ class Scheduler:
             try:
                 dest = self.config.algorithm.schedule(pod)
             except (FitError, ExtenderError) as err:
+                if isinstance(err, FitError):
+                    # The one-pod preemption path (scheduleOne's
+                    # post-priority behavior): an executed victim solve
+                    # turns the FitError into a nominated placement.
+                    filled = self._preempt_failures([pod], [None], {})
+                    if filled[0] is not None:
+                        pod.nominated_node = filled[0]
+                        self._assume_and_bind(pod, filled[0], start)
+                        return True
                 # Per-predicate failure counts straight off the FitError
                 # (failed_predicates: node -> [names]) for the recorder.
                 counts: dict[str, int] = {}
@@ -244,12 +256,19 @@ class Scheduler:
 
     def _solve_drain(self, pods: list, tr: Optional[Trace] = None,
                      trace_id: str = "") -> int:
+        from kubernetes_tpu.engine.workloads import gang as gang_mod
         from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATE
         joint = DEFAULT_FEATURE_GATE.enabled("JointSolver")
+        # Gangs must be admitted all-or-nothing over ONE assignment
+        # vector — a chunked stream could split a gang across chunk
+        # boundaries, so gang batches take the one-shot solve (padded to
+        # a warm bucket below).
+        gangs = DEFAULT_FEATURE_GATE.enabled("GangScheduling") and \
+            gang_mod.batch_has_gangs(pods)
         # The joint solve needs the whole queue at once (prices couple
         # every pod); it supersedes the streaming split.
         streaming = DEFAULT_FEATURE_GATE.enabled("StreamingDrain") \
-            and not joint
+            and not joint and not gangs
         if streaming and len(pods) >= self.STREAM_THRESHOLD and \
                 not self.config.algorithm.extenders:
             return self._schedule_pending_stream(pods, trace_id=trace_id)
@@ -264,7 +283,32 @@ class Scheduler:
             return self._schedule_pending_stream(pods, chunk_size=bucket,
                                                  trace_id=trace_id)
         start = time.perf_counter()
-        placements = self.config.algorithm.schedule_batch(pods, joint=joint)
+        # Workload-constrained one-shot drains pad to the same bucket
+        # ladder the stream path compiles at, so gang/joint solves hit
+        # pre-warmed shapes instead of minting one per queue length.
+        pad_to = 0
+        if (gangs or joint) and len(pods) < self._PAD_LIMIT and \
+                not self.config.algorithm.extenders:
+            pad_to = max(1 << (len(pods) - 1).bit_length(),
+                         self.stream_min_bucket)
+        placements = self.config.algorithm.schedule_batch(
+            pods, joint=joint, pad_to=pad_to)
+        failure_info: dict[str, tuple[str, str]] = {}
+        if gangs:
+            placements, rejected = gang_mod.reduce_all_or_nothing(
+                pods, placements)
+            for name, info in rejected.items():
+                metrics_mod.GANG_ADMISSIONS.labels(
+                    result="rejected").inc()
+                msg = gang_mod.gang_failure_message(name, info)
+                log.debug("gang rejection: %s", msg)
+                for i in info["members"]:
+                    failure_info[pods[i].key] = (msg, "gang_rejected")
+            admitted = [name for name in gang_mod.gang_groups(pods)
+                        if name not in rejected]
+            for _ in admitted:
+                metrics_mod.GANG_ADMISSIONS.labels(
+                    result="admitted").inc()
         if tr is not None:
             tr.step("Computed placements")
         algo_us = (time.perf_counter() - start) * 1e6 / len(pods)
@@ -276,7 +320,8 @@ class Scheduler:
                       len(pods), placed_n, algo_us)
         self._record_batch_decisions(pods, placements, trace_id,
                                      time.perf_counter() - start)
-        self._assume_and_bind_batch(pods, placements, start)
+        self._assume_and_bind_batch(pods, placements, start,
+                                    failure_info=failure_info)
         if tr is not None:
             tr.step("Assumed and dispatched binds")
         return len(pods)
@@ -315,10 +360,24 @@ class Scheduler:
                               failure_detail=detail)
 
     def _assume_and_bind_batch(self, pods: list[api.Pod],
-                               placements: list, start: float) -> None:
+                               placements: list, start: float,
+                               failure_info: Optional[dict] = None
+                               ) -> None:
         """Bulk assume (vectorized), then bind; failures forget + requeue.
         Already-cached pods are skipped, matching the single-pod loop's
-        log-and-proceed on assume errors (scheduler.go:116-120)."""
+        log-and-proceed on assume errors (scheduler.go:116-120).
+
+        ``failure_info`` maps pod key -> (message, result label) for
+        failures with a workload-specific story (gang rejections).
+        Unschedulable priority pods go through the preemption pass AFTER
+        the batch's placements are assumed — the victim solve must see
+        this drain's own commitments (else a pod that failed on in-batch
+        contention would "preempt" with zero victims onto a node the
+        drain just filled, overcommitting it) — and the drain's own
+        placements are protected from eviction; an executed decision
+        (victims evicted) promotes the pod to placed and it is assumed
+        alongside."""
+        failure_info = failure_info or {}
         placed = [(pod, dest) for pod, dest in zip(pods, placements)
                   if dest is not None]
         with stage("assume", pods=len(placed)):
@@ -328,11 +387,27 @@ class Scheduler:
         if skipped:
             placed = [(pod, dest) for pod, dest in placed
                       if pod.key not in skipped]
+        filled = self._preempt_failures(
+            pods, placements, failure_info,
+            protected=frozenset(pod.key for pod, _ in placed))
+        newly = [(pod, nd) for pod, nd, od in
+                 zip(pods, filled, placements)
+                 if od is None and nd is not None]
+        if newly:
+            with stage("assume", pods=len(newly)):
+                skipped2 = set(self.config.algorithm.cache.assume_pods(
+                    newly, strict=False))
+            placed += [(pod, dest) for pod, dest in newly
+                       if pod.key not in skipped2]
+            placements = filled
         for pod, dest in zip(pods, placements):
             if dest is None:
-                self._handle_failure(
-                    pod, "FailedScheduling",
-                    f"pod ({pod.name}) failed to fit in any node")
+                msg, result = failure_info.get(
+                    pod.key,
+                    (f"pod ({pod.name}) failed to fit in any node",
+                     "unschedulable"))
+                self._handle_failure(pod, "FailedScheduling", msg,
+                                     result=result)
         if self.config.async_bind:
             t = threading.Thread(target=self._bind_assumed_batch,
                                  args=(placed, start,
@@ -347,6 +422,88 @@ class Scheduler:
             self._bind_threads.append(t)
         else:
             self._bind_assumed_batch(placed, start)
+
+    def _preempt_failures(self, pods: list, placements: list,
+                          failure_info: dict,
+                          protected: frozenset = frozenset()) -> list:
+        """The preemption pass: unschedulable priority pods get a victim
+        solve (engine.find_preemptions); executed decisions (victims
+        evicted, nominated node recorded) rewrite the placement vector so
+        the normal assume/bind path commits them.  ``protected`` keys
+        (the caller's just-assumed placements) are never victims.  Gang
+        members never preempt individually (a partial gang must not
+        evict for a placement the reduction would reject)."""
+        from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATE
+        if not DEFAULT_FEATURE_GATE.enabled("Preemption"):
+            return placements
+        cands = [pod for pod, dest in zip(pods, placements)
+                 if dest is None and pod.effective_priority > 0
+                 and not pod.gang and pod.key not in failure_info]
+        if not cands:
+            return placements
+        try:
+            decisions = self.config.algorithm.find_preemptions(
+                cands, protected=protected)
+        except Exception:  # noqa: BLE001 — preemption is best-effort
+            log.exception("preemption pass crashed; pods requeue with "
+                          "backoff instead")
+            decisions = []
+        executed = {}
+        for dec in decisions:
+            if self._execute_preemption(dec):
+                executed[dec.pod_key] = dec
+        decided = {d.pod_key for d in decisions}
+        for pod in cands:
+            if pod.key not in decided:
+                metrics_mod.PREEMPTIONS.labels(
+                    result="no_candidate").inc()
+        if not executed:
+            return placements
+        out = []
+        for pod, dest in zip(pods, placements):
+            dec = executed.get(pod.key) if dest is None else None
+            if dec is not None:
+                pod.nominated_node = dec.node
+                out.append(dec.node)
+            else:
+                out.append(dest)
+        return out
+
+    def _execute_preemption(self, dec) -> bool:
+        """Evict a decision's victims (cache + binder) so the preemptor
+        can assume and bind — the evict->assume->bind path.  Returns
+        False (pod stays unschedulable, requeues with backoff) if any
+        eviction fails."""
+        cache = self.config.algorithm.cache
+        evict = getattr(self.config.binder, "evict", None)
+        try:
+            for vkey in dec.victims:
+                vpod = cache.get_pod(vkey)
+                if vpod is not None:
+                    cache.remove_pod(vpod)
+                else:
+                    ns, _, name = vkey.partition("/")
+                    vpod = api.Pod(name=name or ns,
+                                   namespace=ns if name else "default")
+                if evict is not None:
+                    evict(vpod)
+                self.config.recorder.eventf(
+                    vkey, "Normal", "Preempted",
+                    f"Preempted by {dec.pod_key} (priority) "
+                    f"on node {dec.node}")
+        except Exception:  # noqa: BLE001 — a failed eviction aborts
+            log.exception("preemption eviction failed for %s on %s",
+                          dec.pod_key, dec.node)
+            metrics_mod.PREEMPTIONS.labels(result="error").inc()
+            return False
+        metrics_mod.PREEMPTIONS.labels(result="executed").inc()
+        metrics_mod.PREEMPTION_VICTIMS.inc(len(dec.victims))
+        if self.config.flight_recorder is not None:
+            self.config.flight_recorder.record_preemption(
+                dec.pod_key, dec.node, dec.victims)
+        log.info("preempted %d pod(s) on %s for %s",
+                 len(dec.victims), dec.node, dec.pod_key)
+        return True
 
     # Fixed stream chunk override (else derived from STREAM_THRESHOLD).
     stream_chunk: int = 0
@@ -421,9 +578,60 @@ class Scheduler:
             for _ in alg.schedule_batch_stream(pods, chunk_size=bucket):
                 pass
             timings[bucket] = time.perf_counter() - t0
-        log.info("pre-warmed stream ladder %s (floor %d, chunk %d): %s",
+        # Workload-subsystem signatures warm separately (string-keyed on
+        # the daemon, not in the int-keyed bucket dict callers inspect).
+        self.workloads_prewarm_s = self._prewarm_workloads(ladder)
+        log.info("pre-warmed stream ladder %s (floor %d, chunk %d): %s "
+                 "workloads=%s",
                  ladder, self.stream_min_bucket, self.stream_chunk_size(),
-                 {b: f"{s:.2f}s" for b, s in timings.items()})
+                 {b: f"{s:.2f}s" for b, s in timings.items()},
+                 {k: f"{s:.2f}s"
+                  for k, s in self.workloads_prewarm_s.items()})
+        return timings
+
+    def _prewarm_workloads(self, ladder: list[int]) -> dict:
+        """Trace the workloads-subsystem solve signatures (ISSUE 6
+        satellite): the preemption victim kernel at the cluster's (N, V)
+        shape, the topology plane kernel + masked scan at the floor
+        bucket, and (when the gate is on) the one-shot joint executable —
+        all of which a live drain would otherwise compile on the clock.
+        Gang one-shot solves reuse the stream ladder's scan signatures
+        (same live-masked _solve_scan), so they need no extra trace."""
+        import json as _json
+
+        from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATE
+        alg = self.config.algorithm
+        timings: dict = {}
+        floor = min(ladder) if ladder else 0
+        try:
+            if DEFAULT_FEATURE_GATE.enabled("Preemption"):
+                from kubernetes_tpu.engine.workloads import preemption
+                t0 = time.perf_counter()
+                preemption.prewarm_shapes(len(alg.cache.nodes()))
+                timings["preempt"] = time.perf_counter() - t0
+            if floor:
+                tsc = _json.dumps([{
+                    "maxSkew": 1, "topologyKey": api.ZONE_LABEL,
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"kt/warm": "1"}}}])
+                spods = [api.Pod(
+                    name=f"__warm-topo-{i}", namespace="__warm__",
+                    labels={"kt/warm": "1"},
+                    annotations={api.TOPOLOGY_SPREAD_ANNOTATION_KEY: tsc})
+                    for i in range(min(floor, 4))]
+                t0 = time.perf_counter()
+                alg.schedule_batch(spods, pad_to=floor)
+                timings["topology"] = time.perf_counter() - t0
+                if DEFAULT_FEATURE_GATE.enabled("JointSolver"):
+                    jpods = [api.Pod(name=f"__warm-joint-{i}",
+                                     namespace="__warm__")
+                             for i in range(min(floor, 4))]
+                    t0 = time.perf_counter()
+                    alg.schedule_batch(jpods, joint=True, pad_to=floor)
+                    timings["joint"] = time.perf_counter() - t0
+        except Exception:  # noqa: BLE001 — warmup must never kill startup
+            log.exception("workloads prewarm failed; first constrained "
+                          "drain will compile on the clock")
         return timings
 
     def _schedule_pending_stream(self, pods: list[api.Pod],

@@ -16,6 +16,13 @@ alternate code paths in this framework — they are not decorative:
 * ``JointSolver`` — replace the decision-parity sequential scan with the
   LP-priced global assignment on full-queue drains.  Default off
   (alpha: better aggregate placement, no per-pod order parity).
+* ``Preemption`` — unschedulable priority-carrying pods trigger the
+  batched victim solve and the evict->assume->bind path
+  (engine/workloads/preemption.py).  Default on; off reproduces the
+  pre-priority behavior (priority still orders the queue).
+* ``GangScheduling`` — the all-or-nothing gang admission reduction for
+  ``scheduling.kt.io/gang`` batches (engine/workloads/gang.py).  Default
+  on; off treats gang members as independent pods.
 """
 
 from __future__ import annotations
@@ -26,6 +33,8 @@ KNOWN_GATES: dict[str, bool] = {
     "BatchBindings": True,
     "StreamingDrain": True,
     "JointSolver": False,
+    "Preemption": True,
+    "GangScheduling": True,
 }
 
 

@@ -381,6 +381,32 @@ EXTENDER_DEGRADED_DECISIONS = register(Counter(
     "Scheduling decisions made with built-in predicates only because the "
     "extender breaker was open",
     labelnames=("extender",)))
+# Workload-constraints subsystem (engine/workloads/).
+GANG_ADMISSIONS = register(Counter(
+    "scheduler_gang_admissions_total",
+    "Gang all-or-nothing admission outcomes: admitted (every member "
+    "placed) vs rejected (incomplete gang nulled atomically and "
+    "requeued)",
+    labelnames=("result",)))
+PREEMPTIONS = register(Counter(
+    "scheduler_preemptions_total",
+    "Preemption attempts for unschedulable priority pods, by result "
+    "(executed/no_candidate)",
+    labelnames=("result",)))
+PREEMPTION_VICTIMS = register(Counter(
+    "scheduler_preemption_victims_total",
+    "Pods evicted by executed preemption decisions"))
+# Persistent XLA compilation cache (engine/compile_cache.py): without
+# these the 3-4 s \"warm\" start is undiagnosable — a miss here is a
+# program that re-paid the full XLA compile despite the cache.
+COMPILE_CACHE_HITS = register(Counter(
+    "compile_cache_hits_total",
+    "Jit compilations served from the persistent XLA compilation cache "
+    "(deserialized, not recompiled)"))
+COMPILE_CACHE_MISSES = register(Counter(
+    "compile_cache_misses_total",
+    "Jit compilations that missed the persistent XLA compilation cache "
+    "and paid the full compile"))
 # Bind path (scheduler/scheduler.py).
 BIND_CONFLICTS = register(Counter(
     "scheduler_bind_conflicts_total",

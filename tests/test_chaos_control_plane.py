@@ -392,6 +392,64 @@ def test_dead_extender_breaker_opens_and_pods_fall_back():
         factory.algorithm.extenders[0].breaker.record_success()
 
 
+# -- gang all-or-nothing under chaos -----------------------------------------
+
+def _gang_pod_json(name: str, gname: str, size: int,
+                   cpu: str = "100m") -> dict:
+    obj = _pod_json(name, cpu=cpu)
+    obj["metadata"]["annotations"] = {
+        "scheduling.kt.io/gang": gname,
+        "scheduling.kt.io/gang-size": str(size)}
+    return obj
+
+
+def test_gang_converges_fully_under_bind_conflicts(rig_factory):
+    """Gangs vs the 409-every-Nth bind rule: individual member binds get
+    injected conflicts (forget + requeue), yet at settle every gang is
+    FULLY bound — all-or-nothing admission plus per-member repair
+    converges, never stranding a partial gang."""
+    rig = rig_factory(rules=[
+        {"fault": "error", "method": "POST", "path": "/bindings",
+         "status": 409, "every_nth": 3, "count": 4}])
+    rig.factory.daemon.queue.gang_linger_s = 0.3
+    names = []
+    for g in range(2):
+        for m in range(4):
+            name = f"gang{g}-m{m}"
+            rig.direct.create("pods", _gang_pod_json(name, f"gang-{g}", 4))
+            names.append(name)
+    bound = rig.wait_bound(names)
+    assert all(bound.values())
+    rig.assert_daemon_alive()
+
+
+def test_infeasible_gang_never_partially_binds_under_chaos(rig_factory):
+    """An oversized gang (more CPU than the fleet holds) must bind ZERO
+    members — across repeated redrains, with resets injected — while
+    unconstrained pods keep scheduling around it.  This is the atomicity
+    invariant the solver's reduction guarantees; chaos must not shake a
+    partial placement loose."""
+    rig = rig_factory(rules=[
+        {"fault": "reset", "probability": 0.2, "count": 6}], nodes=2)
+    rig.factory.daemon.queue.gang_linger_s = 0.2
+    # 3 members x 20 CPU onto 2 nodes x 32 CPU: any two fit, three never.
+    gang_names = [f"big-m{m}" for m in range(3)]
+    for name in gang_names:
+        rig.direct.create("pods", _gang_pod_json(name, "big", 3,
+                                                 cpu="20"))
+    singles = rig.create_pods(6)
+    rig.wait_bound(singles)
+    # Let several drain/backoff cycles pass, then probe the invariant.
+    time.sleep(1.5)
+    for name in gang_names:
+        obj = rig.store.get("pods", f"default/{name}")
+        assert not (obj.get("spec") or {}).get("nodeName"), \
+            f"partial gang member {name} bound"
+    rig.assert_daemon_alive()
+    exposed = rig.factory.daemon.config.metrics.expose()
+    assert "scheduler_gang_admissions_total" in exposed
+
+
 # -- leader election under latency ------------------------------------------
 
 def test_leader_failover_under_injected_latency():

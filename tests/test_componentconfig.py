@@ -142,9 +142,9 @@ class TestFeatureGates:
             orig_batch = algo.schedule_batch
             orig_stream = algo.schedule_batch_stream
 
-            def spy_batch(pods, joint=False):
+            def spy_batch(pods, joint=False, **kw):
                 calls["batch"].append(joint)
-                return orig_batch(pods, joint=joint)
+                return orig_batch(pods, joint=joint, **kw)
 
             def spy_stream(pods, chunk_size=2048, **kw):
                 calls["stream"] += 1

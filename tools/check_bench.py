@@ -73,6 +73,84 @@ def committed_artifacts() -> list[tuple[str, dict]]:
     return [(name, parsed) for _, name, parsed in found]
 
 
+def _committed_workloads_names() -> set[str] | None:
+    """WORKLOADS artifacts tracked at git HEAD (None when git is
+    unavailable).  The BENCH helper (sync_bench_docs) pattern-filters to
+    BENCH_r*.json, so the workloads ratchet needs its own ls-tree pass —
+    reusing it would silently exclude every WORKLOADS artifact and turn
+    check_workloads into dead code."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "-C", REPO, "ls-tree", "-r", "--name-only", "HEAD"],
+            capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if out.returncode != 0:
+        return None
+    return {n for n in out.stdout.splitlines()
+            if re.fullmatch(r"WORKLOADS_r\d+\.json", n)}
+
+
+def committed_workloads_artifacts() -> list[tuple[str, dict]]:
+    """[(name, payload)] for committed WORKLOADS_r{N}.json artifacts
+    (the workloads subsystem's quality/parity/gang rows, emitted by
+    bench.py), ascending by round number.  Same committed-at-HEAD rule
+    as the BENCH artifacts."""
+    committed = _committed_workloads_names()
+    found: list[tuple[int, str, dict]] = []
+    for name in os.listdir(REPO):
+        m = re.fullmatch(r"WORKLOADS_r(\d+)\.json", name)
+        if not m:
+            continue
+        if committed is not None and name not in committed:
+            continue
+        try:
+            with open(os.path.join(REPO, name)) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if data.get("joint_quality"):
+            found.append((int(m.group(1)), name, data))
+    found.sort()
+    return [(name, data) for _, name, data in found]
+
+
+def quality_row(payload: dict) -> float | None:
+    """The joint-vs-greedy placement ratio — the quality number the
+    workloads ratchet pins alongside density p50."""
+    q = (payload.get("joint_quality") or {}).get("joint_vs_greedy")
+    return float(q) if q else None
+
+
+def check_workloads(artifacts: list[tuple[str, dict]] | None = None,
+                    tolerance: float = TOLERANCE) -> list[str]:
+    """Problems with the newest WORKLOADS artifact vs its predecessor:
+    the joint-vs-greedy quality ratio must not give back more than
+    ``tolerance`` of its win, and no partial gang may ever have bound."""
+    if artifacts is None:
+        artifacts = committed_workloads_artifacts()
+    problems: list[str] = []
+    if artifacts:
+        new_name, new = artifacts[-1]
+        partial = (new.get("gang") or {}).get("partial_gangs_bound")
+        if partial:
+            problems.append(
+                f"{new_name}: {partial} partial gang(s) bound — the "
+                f"all-or-nothing invariant broke")
+    if len(artifacts) < 2:
+        return problems
+    (prev_name, prev), (new_name, new) = artifacts[-2], artifacts[-1]
+    prev_q, new_q = quality_row(prev), quality_row(new)
+    if prev_q and new_q and new_q < prev_q * (1.0 - tolerance):
+        problems.append(
+            f"joint quality regressed: {new_name} x{new_q:.4f} vs "
+            f"{prev_name} x{prev_q:.4f} "
+            f"(-{(1 - new_q / prev_q) * 100:.0f}%, tolerance "
+            f"{tolerance * 100:.0f}%)")
+    return problems
+
+
 def _shape_pods(parsed: dict) -> int:
     m = re.search(r"([\d,]+) pods onto", parsed.get("metric", ""))
     return int(m.group(1).replace(",", "")) if m else 30000
@@ -121,23 +199,40 @@ def check(artifacts: list[tuple[str, dict]] | None = None,
         problems.append(
             f"{new_name} lost the per-stage breakdown entirely "
             f"({prev_name} had {sorted(prev_stages)})")
+    # Workloads quality row embedded in the BENCH artifact (bench.py's
+    # workloads summary), ratcheted like the standalone artifact.
+    prev_q = (prev.get("workloads") or {}).get("joint_vs_greedy")
+    new_q = (new.get("workloads") or {}).get("joint_vs_greedy")
+    if prev_q and new_q and float(new_q) < float(prev_q) * \
+            (1.0 - tolerance):
+        problems.append(
+            f"joint quality regressed: {new_name} x{float(new_q):.4f} "
+            f"vs {prev_name} x{float(prev_q):.4f} (tolerance "
+            f"{tolerance * 100:.0f}%)")
     return problems
 
 
 def main() -> int:
+    problems = check_workloads()
     artifacts = committed_artifacts()
     if len(artifacts) < 2:
         print("bench ratchet: fewer than two committed BENCH artifacts; "
               "nothing to compare")
-        return 0
-    problems = check(artifacts)
+    else:
+        problems += check(artifacts)
     if problems:
         for p in problems:
             print(f"bench ratchet FAIL: {p}", file=sys.stderr)
         return 1
-    (prev_name, prev), (new_name, new) = artifacts[-2], artifacts[-1]
-    print(f"bench ratchet OK: {new_name} p50 {density_p50_s(new):.3f}s vs "
-          f"{prev_name} {density_p50_s(prev):.3f}s")
+    if len(artifacts) >= 2:
+        (prev_name, prev), (new_name, new) = artifacts[-2], artifacts[-1]
+        print(f"bench ratchet OK: {new_name} p50 "
+              f"{density_p50_s(new):.3f}s vs "
+              f"{prev_name} {density_p50_s(prev):.3f}s")
+    wl = committed_workloads_artifacts()
+    if wl:
+        print(f"workloads ratchet OK: {wl[-1][0]} quality "
+              f"x{quality_row(wl[-1][1])}")
     return 0
 
 
