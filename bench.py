@@ -208,6 +208,29 @@ def main() -> None:
               f"cache at {cold_vs_warm['compile_cache_dir']})",
               file=sys.stderr)
 
+    # Churn soak with chaos on (ISSUE 7): rolling updates, node
+    # drain/fail/re-add, a scale-up storm past the queue watermark, and
+    # a SIGKILL-style scheduler restart mid-drain — written as its own
+    # committed artifact (SOAK_r{N}.json) that tools/check_bench.py
+    # ratchets (any invariant violation or unbounded queue growth fails
+    # tier-1).  BENCH_SOAK=0 skips (~90 s).
+    soak = None
+    if os.environ.get("BENCH_SOAK", "1") != "0":
+        from kubernetes_tpu.perf import soak as soak_mod
+        try:
+            soak = soak_mod.collect(quiet=True)
+            soak_path = os.environ.get("BENCH_SOAK_OUT", "SOAK_r07.json")
+            with open(soak_path, "w") as f:
+                json.dump(soak, f, indent=1)
+                f.write("\n")
+            print(f"soak: {soak['scale']['pods_scheduled_total']} binds "
+                  f"over {soak['duration_s']}s, settle "
+                  f"{soak['settle_s']}s, "
+                  f"{soak['invariant_violations']} violations "
+                  f"-> {soak_path}", file=sys.stderr)
+        except Exception as err:  # noqa: BLE001 — phase is additive
+            print(f"soak phase failed: {err}", file=sys.stderr)
+
     # Kubemark-scale control plane (VERDICT r3 #9): 500 hollow kubelets +
     # 2,000 replicas through the real scheduler, controller sync cost and
     # heartbeat write load measured.  BENCH_FLEET=0 skips (~90 s).
@@ -280,6 +303,17 @@ def main() -> None:
             # The wire shape's own stage breakdown: diffed against the
             # in-process one above, it says where the 5x wire gap lives.
             "stages": wire.stages,
+        }
+    if soak is not None:
+        out["soak"] = {
+            "settle_s": soak.get("settle_s"),
+            "steady_state_pods_per_s":
+                soak.get("steady_state_pods_per_s"),
+            "invariant_violations": soak.get("invariant_violations"),
+            "double_binds": (soak.get("reconciliation") or {})
+            .get("double_binds"),
+            "restart_parity_pct": (soak.get("restart_parity") or {})
+            .get("decision_parity_pct"),
         }
     print(json.dumps(out))
 

@@ -696,6 +696,24 @@ def node_to_json(node: Node) -> dict:
     }
 
 
+def key_from_json(d: dict) -> str:
+    """The "namespace/name" (or bare name) store key of an object dict —
+    ONE implementation shared by the restart reconciler, the invariant
+    checker, and the soak driver, so they can never disagree on
+    identity."""
+    meta = d.get("metadata") or {}
+    ns = meta.get("namespace")
+    return f"{ns}/{meta.get('name')}" if ns else meta.get("name", "")
+
+
+def is_terminated_json(d: dict) -> bool:
+    """Terminal-phase test on a pod dict (Succeeded/Failed) — shared for
+    the same reason as :func:`key_from_json`: the reconciler and the
+    verifier must agree on which pods still count."""
+    return (d.get("status") or {}).get("phase", "") in ("Succeeded",
+                                                        "Failed")
+
+
 def pod_from_json(d: dict) -> Pod:
     """Decode a v1 api.Pod JSON object (as sent in ExtenderArgs.Pod)."""
     meta = d.get("metadata") or {}

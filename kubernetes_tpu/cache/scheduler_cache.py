@@ -528,6 +528,48 @@ class SchedulerCache:
                 keys[idx].append(pod.key)
         return VictimTable(req=req, prio=prio, valid=valid, keys=keys)
 
+    # ---- churn & recovery hooks (recovery.py, verifier.py) -------------
+
+    @_locked
+    def force_resnapshot(self) -> None:
+        """Self-heal / restart re-seed: invalidate the incremental state
+        so the next snapshot rebuilds every tensor from the tracked
+        objects and bumps ``tensor_epoch`` (the device mirror re-uploads
+        everything).  The verifier calls this on any invariant mismatch —
+        one full rebuild instead of a wrong placement."""
+        self._mark_nodes_dirty()
+
+    @_locked
+    def tracked_pods(self) -> list[tuple[str, str, bool]]:
+        """(key, node_name, assumed) for every tracked pod — the restart
+        reconciler's and invariant checker's consistent view of what the
+        cache believes, taken under one lock acquisition."""
+        return [(key, st.pod.node_name or "", st.assumed)
+                for key, st in self._pod_states.items()]
+
+    @_locked
+    def recompute_aggregates(self):
+        """Rebuild (requested, nonzero) from scratch out of the tracked
+        pod set — the ground truth the incremental assume/forget deltas
+        must equal.  Returns (requested, nonzero) numpy arrays aligned
+        with the current row order, WITHOUT touching cache state; the
+        verifier diffs them against the live ``_agg`` rows."""
+        self._ensure_tensors()
+        agg = fc.empty_aggregates(len(self._node_order), self.space)
+        idxs: list[int] = []
+        pods: list[api.Pod] = []
+        for name, podmap in self._node_pods.items():
+            idx = self._nt.name_to_idx.get(name)
+            if idx is None:
+                continue
+            for pod in podmap.values():
+                idxs.append(idx)
+                pods.append(pod)
+        if pods:
+            agg = fc.add_pods_to_aggregates_bulk(agg, idxs, pods,
+                                                 self.space)
+        return agg.requested, agg.nonzero
+
     @_locked
     def take_dirty_rows(self) -> set[int]:
         """Row indices mutated in place since the last take, cleared on

@@ -9,7 +9,11 @@ and forced 410 Gone.  Rules are configurable programmatically and over a
 
 from kubernetes_tpu.chaos.proxy import (FAULT_CUT_STREAM, FAULT_ERROR,
                                         FAULT_LATENCY, FAULT_RESET,
-                                        ChaosProxy, Rule)
+                                        ChaosProxy, Rule,
+                                        bind_conflict_storm,
+                                        heartbeat_drop, node_flap,
+                                        watch_cut_on_relist)
 
 __all__ = ["ChaosProxy", "Rule", "FAULT_ERROR", "FAULT_RESET",
-           "FAULT_LATENCY", "FAULT_CUT_STREAM"]
+           "FAULT_LATENCY", "FAULT_CUT_STREAM", "heartbeat_drop",
+           "node_flap", "watch_cut_on_relist", "bind_conflict_storm"]

@@ -110,6 +110,73 @@ class Rule:
         return cls(**known)
 
 
+# -- composable node-lifecycle rule helpers ----------------------------------
+#
+# Soak scenarios are DECLARED out of these, not hand-rolled per test:
+# each helper returns a list of Rules (compose by concatenation, install
+# with ChaosProxy.add_rules) built on the deterministic every_nth cadence
+# so a scenario replays identically run over run.
+
+
+def heartbeat_drop(every_nth: int = 3, count: int = -1,
+                   name: str = "", status: int = 503) -> list[Rule]:
+    """Drop every Nth node-status heartbeat PUT (one node's when ``name``
+    is given, the fleet's otherwise) — the flapping-kubelet shape: the
+    apiserver's view of Ready goes stale in deterministic waves while
+    lists and pod traffic flow normally."""
+    path = rf"^/api/v1/nodes/{re.escape(name)}" if name \
+        else r"^/api/v1/nodes/"
+    return [Rule(fault=FAULT_ERROR, method="PUT", path=path,
+                 status=status, every_nth=every_nth, count=count)]
+
+
+def node_flap(kind: str = "reset", period: int = 2, name: str = "",
+              count: int = -1, delay_s: float = 0.2) -> list[Rule]:
+    """A node's control-plane path flaps on a deterministic cadence:
+    every ``period``-th request touching the node's object fails by
+    ``kind`` — ``reset`` (connection torn down, the half-dead-node
+    shape), ``drop`` (5xx answered, the sick-apiserver-shard shape), or
+    ``latency`` (the congested-link shape).  All three leave the
+    intervening requests untouched, so the node looks alive-then-dead-
+    then-alive to whoever heartbeats or updates it."""
+    path = rf"/api/v1/nodes/{re.escape(name)}" if name \
+        else r"/api/v1/nodes/"
+    if kind == "reset":
+        return [Rule(fault=FAULT_RESET, path=path, every_nth=period,
+                     count=count)]
+    if kind == "drop":
+        return [Rule(fault=FAULT_ERROR, path=path, status=503,
+                     every_nth=period, count=count)]
+    if kind == "latency":
+        return [Rule(fault=FAULT_LATENCY, path=path, delay_s=delay_s,
+                     every_nth=period, count=count)]
+    raise ValueError(f"unknown node_flap kind {kind!r} "
+                     f"(reset/drop/latency)")
+
+
+def watch_cut_on_relist(kind: str = "pods", every_nth: int = 2,
+                        after_events: int = 0, count: int = -1
+                        ) -> list[Rule]:
+    """Cut every Nth watch stream of ``kind`` mid-event, right after the
+    relist's replay window (``after_events`` events pass first) — the
+    storm shape that makes a reflector relist repeatedly and exercises
+    the resume-after-410/fresh-resourceVersion path without ever letting
+    a stale event replay look healthy."""
+    return [Rule(fault=FAULT_CUT_STREAM, method="GET",
+                 path=rf"/{re.escape(kind)}\?watch=1",
+                 after_events=after_events, every_nth=every_nth,
+                 count=count)]
+
+
+def bind_conflict_storm(every_nth: int = 3, count: int = -1) -> list[Rule]:
+    """409 every Nth binding POST — the competing-writer shape: the
+    daemon must forget+requeue exactly the victims while the rest of the
+    batch lands (pinned by the PR 4 chaos e2e; the soak keeps it on for
+    the whole run)."""
+    return [Rule(fault=FAULT_ERROR, method="POST", path=r"/bindings",
+                 status=409, every_nth=every_nth, count=count)]
+
+
 class _ProxyServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
@@ -169,6 +236,11 @@ class ChaosProxy:
             self._next_id += 1
             self._rules.append(rule)
             return rule.id
+
+    def add_rules(self, rules: list[Rule]) -> list[int]:
+        """Install a composed rule set (the node-lifecycle helpers below
+        return lists so scenarios compose by concatenation)."""
+        return [self.add_rule(rule) for rule in rules]
 
     def remove_rule(self, rule_id: int) -> bool:
         with self._lock:

@@ -328,6 +328,34 @@ class ResidentCluster:
     def invalidate(self) -> None:
         self.dc = None
 
+    @staticmethod
+    def signature(nt: "NodeTensors", space: "FeatureSpace") -> tuple:
+        """The shape signature a resident copy was uploaded at; any
+        component moving means the arrays cannot be patched in place."""
+        return (nt.alloc.shape[0], space.ports.capacity,
+                space.volumes.capacity, nt.taints_nosched.shape[1],
+                space.images.capacity, space.topo_keys.capacity)
+
+    def in_sync(self, nt: "NodeTensors", space: "FeatureSpace",
+                epoch: int) -> bool:
+        """True when the resident copy mirrors THIS host state's row
+        identity (same epoch, same shape signature) — the precondition
+        for the invariant checker's row readback to be meaningful (a
+        mirror awaiting a full re-upload legitimately differs)."""
+        return self.dc is not None and self._epoch == epoch and \
+            self._sig == self.signature(nt, space)
+
+    def readback_rows(self, idx) -> dict:
+        """Device→host readback of the verifier's sampled rows: the four
+        resource-truth fields the dirty-row protocol must keep equal to
+        the host arrays.  One gather per field, k rows each — cheap at
+        verifier cadence."""
+        i = jnp.asarray(np.asarray(idx, np.int32))
+        return {"schedulable": np.asarray(self.dc.schedulable[i]),
+                "alloc": np.asarray(self.dc.alloc[i]),
+                "requested": np.asarray(self.dc.requested[i]),
+                "nonzero": np.asarray(self.dc.nonzero[i])}
+
     def _scatter_fn(self):
         if self._scatter is None:
             # NO buffer donation, deliberately: the previous sync's
@@ -354,9 +382,7 @@ class ResidentCluster:
         into the resident arrays, or re-upload everything when the
         resident copy cannot be patched (see class docstring)."""
         n = nt.alloc.shape[0]
-        sig = (n, space.ports.capacity, space.volumes.capacity,
-               nt.taints_nosched.shape[1], space.images.capacity,
-               space.topo_keys.capacity)
+        sig = self.signature(nt, space)
         if self.dc is None or self._sig != sig or self._epoch != epoch \
                 or len(dirty) * self.FULL_FRACTION >= max(n, 1):
             self.dc = device_cluster(nt, agg, space)

@@ -1,0 +1,606 @@
+"""Churn soak at kubemark scale, with chaos on.
+
+Every bench before this one schedules a single avalanche; a production
+fleet sees cluster LIFECYCLE — rolling updates, node drains/failures and
+re-adds, scale-up storms, and scheduler restarts mid-drain — and that
+sustained-churn regime is exactly where the device-residency
+optimizations (dirty-row scatter, ``tensor_epoch``, the overlapped
+solve/commit pipeline) can silently drift from apiserver truth.  This
+module is the deterministic scenario driver that composes those
+lifecycle events against a real rig:
+
+    MemStore -> HTTP apiserver (own thread) -> ChaosProxy -> the full
+    scheduler daemon (ConfigFactory over the proxy)
+
+with the composable chaos rules active (bind-409 cadence, watch cuts on
+relist, heartbeat drops — chaos/proxy.py helpers), the resident-state
+invariant checker running throughout (cache/verifier.py), the bounded
+queue's high watermark set low enough that the scale-up storm exercises
+degraded draining, and a SIGKILL-style scheduler restart
+(``ConfigFactory.abandon``) injected mid-drain and recovered by the
+startup reconciler (scheduler/recovery.py).
+
+The artifact (``SOAK_r{N}.json``) reports settle time, steady-state
+pods/s, queue-depth/stage histograms, the invariant-violation count, a
+post-soak apiserver-vs-oracle reconciliation (double-binds, stranded
+pods, orphaned assumes — all must be 0), and the restarted scheduler's
+sampled decision parity vs the pure-Python oracle.
+``tools/check_bench.py`` ratchets it: any invariant violation, any
+reconciliation failure, monotonically growing steady-state queue depth,
+or a settle-time regression >15 % vs the previous committed artifact
+fails tier-1.
+
+Run: ``python -m kubernetes_tpu.perf.soak --out SOAK_r07.json``
+(committed-artifact scale: >= 60 s, >= 10x the fleet bench's 2,000
+replicas).  The tier-1 suite runs a seconds-long smoke at toy scale.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.chaos import (ChaosProxy, bind_conflict_storm,
+                                  heartbeat_drop, watch_cut_on_relist)
+from kubernetes_tpu.client.http import APIClient
+from kubernetes_tpu.scheduler.backoff import PodBackoff
+from kubernetes_tpu.utils import metrics
+
+# The fleet bench this soak is scaled against (perf/harness.fleet_metrics:
+# 500 hollow nodes drive 2,000 replicas to Running once).
+FLEET_BENCH_REPLICAS = 2000
+
+
+def _node_json(name: str, milli_cpu: int = 16000,
+               memory: int = 64 * 1024 ** 3, pods: int = 110,
+               unschedulable: bool = False) -> dict:
+    obj = {"metadata": {"name": name,
+                        "labels": {api.HOSTNAME_LABEL: name}},
+           "status": {"allocatable": {"cpu": f"{milli_cpu}m",
+                                      "memory": str(memory),
+                                      "pods": str(pods)},
+                      "conditions": [{"type": "Ready", "status": "True"}]}}
+    if unschedulable:
+        obj["spec"] = {"unschedulable": True}
+    return obj
+
+
+def _pod_json(name: str, cpu: str = "50m") -> dict:
+    return {"metadata": {"name": name, "namespace": "default"},
+            "spec": {"containers": [{
+                "name": "c", "resources": {"requests": {
+                    "cpu": cpu, "memory": "64Mi"}}}]}}
+
+
+class _BindMonitor:
+    """Watches the store's pod stream in-process and classifies nodeName
+    transitions — the post-soak reconciliation's double-bind detector.
+    A bind is "" -> node; a DOUBLE bind (the invariant a kill between
+    solve and bind must never break) is node -> different node on the
+    same pod object.  Delivery is synchronous under the store lock into
+    an unbounded queue, so no event is ever missed."""
+
+    def __init__(self, store: MemStore):
+        self.binds = 0
+        self.double_binds = 0
+        self._nodes: dict[str, str] = {}
+        self._stopped = threading.Event()
+        # Watch from the CURRENT rv: the fleet registration that ran
+        # before this monitor can exceed the server's replay window, and
+        # no pod events predate it anyway.
+        self._watcher = store.watch(["pods"],
+                                    from_rv=store.list("pods")[1])
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name="soak-bind-monitor")
+        self._thread.start()
+
+    def _pump(self) -> None:
+        while not self._stopped.is_set():
+            ev = self._watcher.next(timeout=0.5)
+            if ev is None:
+                continue  # timeout (or the stop sentinel; flag decides)
+            if ev.type == "DELETED":
+                self._nodes.pop(ev.key, None)
+                continue
+            node = (ev.object.get("spec") or {}).get("nodeName") or ""
+            prev = self._nodes.get(ev.key, "")
+            if node and not prev:
+                self.binds += 1
+            elif node and prev and node != prev:
+                self.double_binds += 1
+            self._nodes[ev.key] = node
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._watcher.stop()
+
+
+class _QueueSampler:
+    """Samples the daemon's queue depth + degraded flag on a fixed
+    cadence; the soak's bounded-queue evidence."""
+
+    def __init__(self, period: float = 0.1):
+        self.period = period
+        self.samples: list[tuple[float, int, bool]] = []
+        self._daemon = None
+        self._t0 = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="soak-queue-sampler")
+        self._thread.start()
+
+    def attach(self, daemon) -> None:
+        self._daemon = daemon
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period):
+            d = self._daemon
+            if d is None:
+                continue
+            self.samples.append((time.monotonic() - self._t0,
+                                 len(d.queue), d.queue.degraded()))
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def summary(self, steady_window_s: float = 10.0) -> dict:
+        if not self.samples:
+            return {"samples": 0, "max_depth": 0, "final_depth": 0,
+                    "monotonic_growth": False, "degraded_s": 0.0}
+        t_end = self.samples[-1][0]
+        depths = [d for _, d, _ in self.samples]
+        window = [(t, d) for t, d, _ in self.samples
+                  if t >= t_end - steady_window_s]
+        slope = 0.0
+        if len(window) >= 4:
+            ts = np.array([t for t, _ in window])
+            ds = np.array([d for _, d in window], dtype=float)
+            slope = float(np.polyfit(ts, ds, 1)[0])
+        # Monotonic growth = the steady window trends up AND never
+        # touches empty — a queue that drains to zero each cycle is
+        # bounded no matter how spiky the storms were.
+        monotonic = slope > 1.0 and min(d for _, d in window) > 0
+        return {"samples": len(self.samples),
+                "max_depth": max(depths),
+                "final_depth": depths[-1],
+                "steady_window_s": steady_window_s,
+                "steady_window_slope_pods_per_s": round(slope, 3),
+                "monotonic_growth": bool(monotonic),
+                "degraded_s": round(sum(
+                    1 for _, _, dg in self.samples if dg) *
+                    self.period, 2)}
+
+
+def _make_factory(proxy_url: str, stream_chunk: int, hwm: int):
+    """A soak daemon over the proxy: compressed backoff (convergence
+    under fault in scenario time), every drain through the pre-warmed
+    stream ladder (a soak's arrival races must never mint a compile on
+    the clock), and the degradation watermark at the scenario's
+    threshold."""
+    from kubernetes_tpu.scheduler.factory import ConfigFactory
+    factory = ConfigFactory(proxy_url, qps=5000, burst=5000)
+    daemon = factory.daemon
+    daemon.backoff = PodBackoff(default_duration=0.1, max_duration=2.0)
+    daemon.STREAM_THRESHOLD = stream_chunk
+    daemon.stream_chunk = stream_chunk
+    daemon.queue.high_watermark = hwm
+    return factory
+
+
+def run_soak(n_nodes: int = 2000, duration_s: float = 60.0,
+             seed_pods: int = 4000, storm_pods: int = 8000,
+             rolling_waves: int = 4, wave_size: int = 1000,
+             drain_nodes: int = 40, kill_burst: int = 3000,
+             restart: bool = True, chaos: bool = True,
+             high_watermark: int = 3000, stream_chunk: int = 4096,
+             heartbeat_period: float = 1.0, verify_period: float = 2.0,
+             settle_timeout: float = 300.0, parity_samples: int = 50,
+             quiet: bool = False) -> dict:
+    """Run the composed churn scenario; returns the artifact payload."""
+    t_start = time.monotonic()
+    store = MemStore()
+    from kubernetes_tpu.apiserver.server import serve
+    api_srv = serve(store)
+    api_url = f"http://127.0.0.1:{api_srv.server_address[1]}"
+    proxy = ChaosProxy(api_url).start()
+    direct = APIClient(api_url, qps=0)  # driver ops bypass the chaos
+
+    def log(msg: str) -> None:
+        if not quiet:
+            print(f"soak[{time.monotonic() - t_start:6.1f}s] {msg}",
+                  file=sys.stderr)
+
+    violations_before = metrics.CACHE_INVARIANT_VIOLATIONS.value
+    degraded_before = metrics.DEGRADED_DRAINS.value
+    from kubernetes_tpu.perf.harness import _stage_snapshot, \
+        stage_breakdown
+    stages_before = _stage_snapshot()
+
+    # -- fleet registration ------------------------------------------------
+    node_objs: dict[str, dict] = {}
+    for i in range(n_nodes):
+        node_objs[f"sn-{i:05d}"] = _node_json(f"sn-{i:05d}")
+    for i in range(0, n_nodes, 1000):
+        batch = list(node_objs.values())[i:i + 1000]
+        direct.create_list("nodes", batch)
+    log(f"registered {n_nodes} nodes")
+
+    monitor = _BindMonitor(store)
+    sampler = _QueueSampler()
+    saved_env = {k: os.environ.get(k)
+                 for k in ("KT_PREWARM", "KT_VERIFY_PERIOD",
+                           "KT_RECOVERY")}
+    os.environ["KT_PREWARM"] = "1"
+    os.environ["KT_VERIFY_PERIOD"] = str(verify_period)
+    os.environ["KT_RECOVERY"] = "1"
+    factory = None
+    pod_seq = [0]
+    created_total = [0]
+
+    def create_pods(n: int, prefix: str, cpu: str = "50m") -> list[str]:
+        names = []
+        for _ in range(n):
+            pod_seq[0] += 1
+            names.append(f"{prefix}-{pod_seq[0]:06d}")
+        for i in range(0, n, 1000):
+            direct.create_list("pods", [_pod_json(nm, cpu=cpu)
+                                        for nm in names[i:i + 1000]])
+        created_total[0] += n
+        return names
+
+    def pending_count() -> int:
+        items, _ = store.list("pods")
+        return sum(1 for o in items
+                   if not (o.get("spec") or {}).get("nodeName")
+                   and (o.get("status") or {}).get("phase", "")
+                   not in ("Succeeded", "Failed"))
+
+    def wait_settled(timeout: float) -> float:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if pending_count() == 0:
+                return time.monotonic() - t0
+            time.sleep(0.25)
+        return -1.0
+
+    # Driver-side heartbeat loop: rotating slices of the fleet PUT their
+    # status THROUGH the proxy, so the heartbeat_drop rules bite and the
+    # scheduler's node reflector sees a production-shaped update stream
+    # feeding the dirty-row scatter path.
+    hb_client = APIClient(proxy.base_url, qps=0)
+    hb_stop = threading.Event()
+    hb_sent = [0]
+
+    def heartbeat_loop() -> None:
+        names = sorted(node_objs)
+        slice_n = max(len(names) // 10, 1)
+        at = 0
+        while not hb_stop.wait(heartbeat_period):
+            for name in names[at:at + slice_n]:
+                obj = node_objs.get(name)
+                if obj is None:
+                    continue
+                obj["status"]["conditions"][0]["lastHeartbeatTime"] = \
+                    time.time()
+                try:
+                    hb_client.update("nodes", obj)
+                    hb_sent[0] += 1
+                except Exception:  # noqa: BLE001 — drops are the point
+                    pass
+            at = (at + slice_n) % max(len(names), 1)
+
+    hb_thread = threading.Thread(target=heartbeat_loop, daemon=True,
+                                 name="soak-heartbeats")
+
+    report: dict = {
+        "harness": "kubernetes_tpu/perf/soak.py (churn soak: rolling "
+                   "updates + node drain/fail/re-add + scale-up storm + "
+                   "mid-drain scheduler kill, over HTTP through the "
+                   "chaos proxy)",
+        "scale": {"n_nodes": n_nodes},
+        "chaos": {"enabled": chaos},
+    }
+    try:
+        factory = _make_factory(proxy.base_url, stream_chunk,
+                                high_watermark)
+        sampler.attach(factory.daemon)
+        factory.run()
+        log("scheduler running (prewarmed, verifier on)")
+
+        # Phase 1: seed workload — the initial settle the ratchet pins.
+        t0 = time.monotonic()
+        create_pods(seed_pods, "seed")
+        settle_s = wait_settled(settle_timeout)
+        if settle_s < 0:
+            raise RuntimeError("seed workload never settled")
+        report["settle_s"] = round(settle_s, 2)
+        log(f"seeded {seed_pods} pods, settle {settle_s:.1f}s")
+
+        # Chaos on for the whole churn window.
+        rules = []
+        if chaos:
+            rules = (bind_conflict_storm(every_nth=7) +
+                     watch_cut_on_relist("pods", every_nth=3, count=8) +
+                     heartbeat_drop(every_nth=5))
+            proxy.add_rules(rules)
+            report["chaos"]["rules"] = [r.to_json() for r in rules]
+        hb_thread.start()
+        churn_t0 = time.monotonic()
+        churn_binds0 = monitor.binds
+
+        # Phase 2: scale-up storm — crosses the high watermark, so the
+        # daemon must shed load (largest-bucket drains) instead of
+        # building one storm-sized batch.
+        create_pods(storm_pods, "storm")
+        log(f"storm of {storm_pods} pods injected "
+            f"(watermark {high_watermark})")
+        if wait_settled(settle_timeout) < 0:
+            raise RuntimeError("storm never settled")
+
+        # Phase 3: rolling updates — delete/recreate in waves.
+        items, _ = store.list("pods")
+        bound_names = [o["metadata"]["name"] for o in items
+                       if (o.get("spec") or {}).get("nodeName")]
+        rng = np.random.RandomState(7)
+        for w in range(rolling_waves):
+            victims = rng.choice(len(bound_names),
+                                 size=min(wave_size, len(bound_names)),
+                                 replace=False)
+            for vi in victims.tolist():
+                try:
+                    direct.delete("pods", f"default/{bound_names[vi]}")
+                except Exception:  # noqa: BLE001 — already rolled
+                    pass
+            bound_names = [nm for i, nm in enumerate(bound_names)
+                           if i not in set(victims.tolist())]
+            create_pods(len(victims), f"roll{w}")
+            log(f"rolling wave {w + 1}/{rolling_waves} "
+                f"({len(victims)} pods)")
+        if wait_settled(settle_timeout) < 0:
+            raise RuntimeError("rolling updates never settled")
+
+        # Phase 4: node lifecycle — drain (cordon + evict), fail
+        # (delete), re-add with DIFFERENT capacity: the same-name/
+        # different-shape edge the tensor_epoch protocol must catch.
+        drained = sorted(node_objs)[:drain_nodes]
+        evicted = 0
+        for name in drained:
+            node_objs[name] = _node_json(name, unschedulable=True)
+            direct.update("nodes", node_objs[name])
+        items, _ = store.list("pods")
+        for o in items:
+            if (o.get("spec") or {}).get("nodeName") in set(drained):
+                try:
+                    direct.delete(
+                        "pods", f"default/{o['metadata']['name']}")
+                    evicted += 1
+                except Exception:  # noqa: BLE001
+                    pass
+        create_pods(evicted, "redrain")
+        log(f"drained {len(drained)} nodes, rescheduling {evicted} pods")
+        for name in drained:
+            direct.delete("nodes", name)
+            node_objs.pop(name, None)
+        time.sleep(1.0)
+        for name in drained:  # re-add, twice the capacity
+            node_objs[name] = _node_json(name, milli_cpu=32000)
+            direct.create("nodes", node_objs[name])
+        if wait_settled(settle_timeout) < 0:
+            raise RuntimeError("node lifecycle phase never settled")
+        report["node_lifecycle"] = {"drained": len(drained),
+                                    "evicted_pods": evicted,
+                                    "readded_with_new_capacity":
+                                        len(drained)}
+
+        # Phase 5: SIGKILL mid-drain + crash-safe restart.
+        if restart:
+            create_pods(kill_burst, "kill")
+            # Kill while the drain is demonstrably mid-flight: backlog
+            # present and binds landing.
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and \
+                    len(factory.daemon.queue) == 0:
+                time.sleep(0.01)
+            queue_at_kill = len(factory.daemon.queue)
+            peak_before_kill = factory.daemon.queue.peak_depth
+            factory.abandon()
+            log(f"KILLED scheduler mid-drain (queue depth "
+                f"{queue_at_kill}, {pending_count()} pending at "
+                f"apiserver)")
+            time.sleep(0.5)  # zombie binds from the dead pipeline land
+            t_re = time.monotonic()
+            factory = _make_factory(proxy.base_url, stream_chunk,
+                                    high_watermark)
+            sampler.attach(factory.daemon)
+            factory.run()
+            resettle_s = wait_settled(settle_timeout)
+            if resettle_s < 0:
+                raise RuntimeError("post-restart drain never settled")
+            report["restart"] = {
+                "killed_mid_drain": True,
+                "queue_at_kill": queue_at_kill,
+                "peak_before_kill": peak_before_kill,
+                "recovery": factory.last_recovery,
+                "restart_to_settle_s": round(
+                    time.monotonic() - t_re, 2),
+            }
+            log(f"restarted + recovered in "
+                f"{time.monotonic() - t_re:.1f}s "
+                f"(recovery: {factory.last_recovery})")
+
+        # Sustain small churn waves until the duration floor.
+        w = 0
+        while time.monotonic() - t_start < duration_s:
+            create_pods(min(wave_size // 2, 500), f"sustain{w}")
+            w += 1
+            if wait_settled(settle_timeout) < 0:
+                raise RuntimeError("sustain wave never settled")
+            time.sleep(0.5)
+
+        churn_s = time.monotonic() - churn_t0
+        churn_binds = monitor.binds - churn_binds0
+        report["steady_state_pods_per_s"] = round(churn_binds /
+                                                  max(churn_s, 1e-9), 1)
+        report["churn_window_s"] = round(churn_s, 1)
+
+        # Final settle + quiesce so confirms drain, then reconcile.
+        if wait_settled(settle_timeout) < 0:
+            raise RuntimeError("final settle failed")
+        time.sleep(max(verify_period, 2.0))  # a final verifier pass
+        report.update(_reconcile(store, factory, monitor))
+        report["restart_parity"] = _restart_parity(
+            store, factory, samples=parity_samples) \
+            if restart else None
+
+        # Verifier + violation accounting across both incarnations.
+        report["invariant_violations"] = \
+            metrics.CACHE_INVARIANT_VIOLATIONS.value - violations_before
+        report["verifier_passes"] = \
+            factory.verifier.passes if factory.verifier else 0
+        report["queue_depth"] = sampler.summary()
+        # Peak across BOTH incarnations: the storm's peak belongs to the
+        # pre-kill daemon, whose FIFO the restart replaced.
+        report["queue_peak_depth"] = max(
+            factory.daemon.queue.peak_depth,
+            report.get("restart", {}).get("peak_before_kill", 0))
+        report["degraded_drains"] = \
+            metrics.DEGRADED_DRAINS.value - degraded_before
+        report["stages"] = stage_breakdown(stages_before,
+                                           _stage_snapshot())
+        report["chaos"]["injected"] = proxy.stats()["injected"]
+        report["heartbeats_sent"] = hb_sent[0]
+        report["duration_s"] = round(time.monotonic() - t_start, 1)
+        report["scale"].update({
+            "pods_created_total": created_total[0],
+            "pods_scheduled_total": monitor.binds,
+            "fleet_bench_multiple": round(
+                monitor.binds / FLEET_BENCH_REPLICAS, 1)})
+        log(f"done: {monitor.binds} binds, "
+            f"{report['invariant_violations']} violations, "
+            f"{report['reconciliation']}")
+        return report
+    finally:
+        hb_stop.set()
+        sampler.stop()
+        monitor.stop()
+        if factory is not None:
+            try:
+                factory.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        proxy.stop()
+        api_srv.shutdown()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _reconcile(store: MemStore, factory, monitor: _BindMonitor) -> dict:
+    """Post-soak apiserver-vs-oracle reconciliation: the acceptance
+    invariants a mid-drain kill must not break."""
+    items, _ = store.list("pods")
+    node_names = {o["metadata"]["name"]
+                  for o in store.list("nodes")[0]}
+    bound = stranded = to_missing = 0
+    for o in items:
+        phase = (o.get("status") or {}).get("phase", "")
+        if phase in ("Succeeded", "Failed"):
+            continue
+        node = (o.get("spec") or {}).get("nodeName") or ""
+        if not node:
+            stranded += 1
+        else:
+            bound += 1
+            if node not in node_names:
+                to_missing += 1
+    orphaned = sum(1 for _k, _n, assumed
+                   in factory.algorithm.cache.tracked_pods() if assumed)
+    return {"reconciliation": {
+        "pods_bound": bound,
+        "stranded_pending": stranded,
+        "orphaned_assumes": orphaned,
+        "double_binds": monitor.double_binds,
+        "bound_to_missing_node": to_missing,
+    }}
+
+
+def _restart_parity(store: MemStore, factory, samples: int = 50) -> dict:
+    """Post-restart decision parity: the recovered scheduler's choices
+    for fresh probe pods vs the pure-Python oracle evaluated on the
+    apiserver's truth (the PARITY.json argmax-set-membership rule).  A
+    recovery that corrupted the rebuilt cache or resident tensors
+    diverges here; 100 % is the acceptance bar."""
+    from kubernetes_tpu import oracle
+    from kubernetes_tpu.engine.generic_scheduler import FitError
+    from kubernetes_tpu.perf.parity import IndexedClusterState
+    nodes = [api.node_from_json(o) for o in store.list("nodes")[0]]
+    pods = [api.pod_from_json(o) for o in store.list("pods")[0]
+            if (o.get("spec") or {}).get("nodeName")]
+    cluster = IndexedClusterState(nodes=nodes, pods=pods)
+    agree = disagree = 0
+    for i in range(samples):
+        probe = api.Pod(
+            name=f"__parity-{i}", namespace="default",
+            containers=[api.Container(
+                name="c", requests={"cpu": "50m", "memory": "64Mi"})])
+        fits, _ = oracle.find_nodes_that_fit(probe, cluster)
+        onames = {n.name for n in fits}
+        try:
+            choice = factory.algorithm.schedule(probe)
+        except FitError:
+            choice = None
+        if choice is None:
+            agree += 0 if onames else 1
+            disagree += 1 if onames else 0
+            continue
+        if choice not in onames:
+            disagree += 1
+            continue
+        scores = oracle.prioritize(probe, cluster)
+        best = max(scores[nm] for nm in onames)
+        if scores[choice] == best:
+            agree += 1
+        else:
+            disagree += 1
+    judged = agree + disagree
+    return {"samples": judged,
+            "decision_parity_pct": round(100.0 * agree /
+                                         max(judged, 1), 2)}
+
+
+def collect(**kw) -> dict:
+    """bench.py's soak phase entry point."""
+    return run_soak(**kw)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="SOAK_r07.json")
+    ap.add_argument("--nodes", type=int, default=2000)
+    ap.add_argument("--duration", type=float, default=60.0)
+    ap.add_argument("--no-chaos", action="store_true")
+    ap.add_argument("--no-restart", action="store_true")
+    opts = ap.parse_args()
+    rec = run_soak(n_nodes=opts.nodes, duration_s=opts.duration,
+                   chaos=not opts.no_chaos,
+                   restart=not opts.no_restart)
+    with open(opts.out, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(f"wrote {opts.out}: {rec['scale']['pods_scheduled_total']} "
+          f"pods over {rec['duration_s']}s, "
+          f"{rec['invariant_violations']} invariant violations")
+
+
+if __name__ == "__main__":
+    main()

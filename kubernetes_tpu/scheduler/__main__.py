@@ -206,9 +206,22 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
             elif path == "/debug/scheduler/decisions":
                 self._send(*_decisions_route(factory.daemon, query))
             elif path == "/debug/vars":
+                from kubernetes_tpu.utils.metrics import (
+                    CACHE_INVARIANT_VIOLATIONS)
                 cache = factory.algorithm.cache
+                queue = factory.daemon.queue
                 self._send(200, json.dumps({
-                    "queueDepth": len(factory.daemon.queue),
+                    "queueDepth": len(queue),
+                    "queueHighWatermark": queue.high_watermark,
+                    "queuePeakDepth": queue.peak_depth,
+                    # The degradation ladder's operator surface: 1 while
+                    # the daemon sheds load (largest-bucket drains, gang
+                    # holds bypassed).
+                    "degraded": queue.degraded(),
+                    "invariantViolations":
+                        CACHE_INVARIANT_VIOLATIONS.value,
+                    "lastRecovery": getattr(factory, "last_recovery",
+                                            None),
                     "cachedPods": cache.pod_count(),
                     "cachedNodes": len(cache.nodes()),
                     "cacheStats": cache.stats,

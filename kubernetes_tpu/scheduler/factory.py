@@ -147,6 +147,10 @@ class ConfigFactory:
         self._reflectors: list[Reflector] = []
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        # Startup reconciliation report (scheduler/recovery.py), served
+        # on /debug/vars; None until run() completes the pass.
+        self.last_recovery: Optional[dict] = None
+        self.verifier = None
 
     # -- reflector handlers (factory.go:128-227) -------------------------
 
@@ -276,7 +280,19 @@ class ConfigFactory:
         conds.append({"type": "PodScheduled", "status": "False",
                       "reason": reason, "message": message})
         try:
-            self.store.update("pods", obj)
+            if isinstance(self.store, MemStore):
+                # CAS on the version this update read: a condition write
+                # racing a concurrent bind (e.g. a replacement scheduler
+                # after this one was killed) must lose the CAS rather
+                # than clobber the bound spec.  Over HTTP the PUT handler
+                # applies the same precondition from the body's
+                # resourceVersion.
+                self.store.update(
+                    "pods", obj,
+                    expected_rv=(obj.get("metadata") or {})
+                    .get("resourceVersion"))
+            else:
+                self.store.update("pods", obj)
         except Exception:  # noqa: BLE001 — condition update is best-effort
             pass
 
@@ -314,6 +330,27 @@ class ConfigFactory:
             # and production daemons set KT_PREWARM=1 and, with the
             # persistent compile cache populated, pay near-zero here).
             self.daemon.prewarm()
+        if os.environ.get("KT_RECOVERY", "1") not in ("", "0"):
+            # Crash-safe restart: reconcile cache + queue against one
+            # apiserver relist (re-adopt bound pods, requeue orphans,
+            # expire stale assumes, re-seed the resident tensors) BEFORE
+            # the drain loop resumes — see scheduler/recovery.py.
+            from kubernetes_tpu.scheduler import recovery
+            self.last_recovery = recovery.reconcile(
+                self.daemon, self.store,
+                scheduler_name=self.daemon.config.scheduler_name)
+        verify_period = float(os.environ.get("KT_VERIFY_PERIOD", "0")
+                              or "0")
+        if verify_period > 0:
+            # Resident-state invariant checker (cache/verifier.py): a
+            # low-frequency background cross-check of cache aggregates vs
+            # the device-resident tensors vs apiserver truth, self-healing
+            # by full re-snapshot on mismatch.
+            from kubernetes_tpu.cache.verifier import Verifier
+            self.verifier = Verifier(
+                self.algorithm.cache, resident=self.algorithm.resident,
+                truth=lambda: self.store.list("pods")[0])
+            self._threads.append(self.verifier.run(period=verify_period))
         self._threads.append(self.daemon.run(batched=self.batched))
 
         def ttl_sweep():  # cleanupAssumedPods (cache.go:309-330)
@@ -329,8 +366,24 @@ class ConfigFactory:
         self._stop.set()
         for r in self._reflectors:
             r.stop()
+        if self.verifier is not None:
+            self.verifier.stop()
         self.daemon.stop()
         sink = getattr(self.daemon.config.recorder, "_sink", None)
         close = getattr(sink, "close", None)
         if close is not None:
             close()
+
+    def abandon(self) -> None:
+        """SIGKILL-style teardown for the restart scenarios: reflectors
+        and the drain loop stop, but NOTHING is drained or joined — the
+        pipeline's in-flight window (solved-but-uncommitted chunks,
+        dispatched binds, pending requeues) is abandoned exactly as a
+        kill -9 would leave it.  The next incarnation's startup
+        reconciliation cleans up (scheduler/recovery.py)."""
+        self._stop.set()
+        for r in self._reflectors:
+            r.stop()
+        if self.verifier is not None:
+            self.verifier.stop()
+        self.daemon.abandon()

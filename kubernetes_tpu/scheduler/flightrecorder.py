@@ -19,12 +19,18 @@ failed pods, capped, and only by the daemon path (the engine's
 from __future__ import annotations
 
 import itertools
+import json
+import os
 import threading
 import time
 from collections import deque
 
 # Ring capacity in BATCHES (a batch may be one pod or thirty thousand).
 DEFAULT_CAPACITY = 64
+# The ring's on-disk form under KT_FLIGHT_DIR: dumped on graceful
+# shutdown, reloaded on startup, so `kubectl explain pod` keeps answering
+# across a scheduler bounce (the soak's restart scenario).
+FLIGHT_FILE = "flight_ring.json"
 # Failure-detail entries kept per batch (explain_failures caps its device
 # work the same way).
 MAX_FAILURES_PER_BATCH = 256
@@ -60,10 +66,22 @@ class FlightRecorder:
     failures (bind conflicts arrive from the async bind fan-out after the
     batch record was written; they amend it in place)."""
 
-    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 flight_dir: str | None = None):
+        """``flight_dir`` (default: the KT_FLIGHT_DIR env var) names a
+        directory whose persisted ring, if any, is reloaded — batch ids
+        continue past the reloaded maximum so restart records never
+        collide with pre-restart ones."""
         self._ring: deque[BatchRecord] = deque(maxlen=max(1, capacity))
         self._lock = threading.Lock()
         self._seq = itertools.count(1)
+        if flight_dir is None:
+            flight_dir = os.environ.get("KT_FLIGHT_DIR", "")
+        if flight_dir:
+            try:
+                self.load(flight_dir)
+            except Exception:  # noqa: BLE001 — a torn, wrong-shaped, or
+                pass           # absent dump must never block startup
 
     # -- recording --------------------------------------------------------
 
@@ -160,6 +178,48 @@ class FlightRecorder:
             rec = BatchRecord(next(self._seq), "", time.time(), 0.0,
                               {pod_key: node}, {pod_key: detail})
             self._ring.append(rec)
+
+    # -- persistence across restarts (KT_FLIGHT_DIR) ----------------------
+
+    def save(self, flight_dir: str) -> str:
+        """Dump the ring to ``flight_dir/flight_ring.json`` (atomic
+        rename, so a crash mid-dump leaves the previous dump intact).
+        Called by Scheduler.stop(); returns the written path."""
+        os.makedirs(flight_dir, exist_ok=True)
+        with self._lock:
+            records = [{"batch_id": r.batch_id, "trace_id": r.trace_id,
+                        "ts": r.ts, "duration_s": r.duration_s,
+                        "placements": r.placements, "failures": r.failures}
+                       for r in self._ring]
+        path = os.path.join(flight_dir, FLIGHT_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"capacity": self._ring.maxlen,
+                       "records": records}, f, separators=(",", ":"))
+        os.replace(tmp, path)
+        return path
+
+    def load(self, flight_dir: str) -> int:
+        """Reload a persisted ring (newest records win if the dump holds
+        more than capacity); the id sequence resumes past the reloaded
+        maximum.  Returns the number of records restored."""
+        path = os.path.join(flight_dir, FLIGHT_FILE)
+        if not os.path.exists(path):
+            return 0
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        max_id = 0
+        with self._lock:
+            for rec in data.get("records", []):
+                self._ring.append(BatchRecord(
+                    int(rec["batch_id"]), rec.get("trace_id", ""),
+                    float(rec.get("ts", 0.0)),
+                    float(rec.get("duration_s", 0.0)),
+                    dict(rec.get("placements") or {}),
+                    dict(rec.get("failures") or {})))
+                max_id = max(max_id, int(rec["batch_id"]))
+            self._seq = itertools.count(max_id + 1)
+            return len(data.get("records", []))
 
     # -- querying ---------------------------------------------------------
 

@@ -23,11 +23,22 @@ Two workload-model extensions:
   contract as an incomplete gang the solver will reject) so a gang whose
   member binds got split by faults can still converge instead of
   deadlocking in the hold.
+
+BOUNDED DEGRADATION: past ``high_watermark`` pending items
+(``KT_QUEUE_HIGH_WATERMARK``, 0 = unbounded) the queue reports
+``degraded()`` and the daemon sheds load gracefully — drains switch to
+largest-warmed-bucket-first chunks (``pop_some``) so a storm never
+builds one unbounded batch, and NEW gang members bypass the hold (the
+solver's all-or-nothing reduction still protects atomicity; what the
+bypass drops is only the release-together latency optimization).  A
+storm therefore produces slower decisions, never unbounded per-drain
+memory growth.
 """
 
 from __future__ import annotations
 
 import heapq
+import os
 import time
 from typing import Optional
 
@@ -35,15 +46,29 @@ import threading
 
 from kubernetes_tpu.api import types as api
 
+# Degradation threshold default: far above any healthy backlog (the 30k
+# density burst fits with headroom) but low enough that a runaway storm
+# trips shedding before per-drain allocations hurt.
+DEFAULT_HIGH_WATERMARK = 65536
+
 
 class FIFO:
     # Incomplete gangs release anyway after this long in the hold (see
     # module docstring); the chaos suite compresses it.
     gang_linger_s: float = 5.0
 
-    def __init__(self) -> None:
+    def __init__(self, high_watermark: Optional[int] = None) -> None:
         self._lock = threading.Condition()
         self._items: dict[str, api.Pod] = {}
+        # Load-shedding threshold, read once at construction (the daemon's
+        # whole-lifetime discipline, like the stream floor): 0 disables.
+        if high_watermark is None:
+            high_watermark = int(os.environ.get(
+                "KT_QUEUE_HIGH_WATERMARK",
+                str(DEFAULT_HIGH_WATERMARK)) or str(DEFAULT_HIGH_WATERMARK))
+        self.high_watermark = high_watermark
+        # Churn observability: deepest backlog ever seen (soak artifact).
+        self.peak_depth = 0
         # Heap of (-priority, seq, key); stale keys skipped at pop (lazy
         # delete, like the old deque).  Equal priorities pop in seq
         # (FIFO) order.
@@ -62,11 +87,34 @@ class FIFO:
             prio = pod.effective_priority if priority is None else priority
             heapq.heappush(self._heap, (-prio, self._seq, key))
         self._items[key] = pod
+        depth = len(self._items) + sum(
+            len(h) for h in self._gang_hold.values())
+        if depth > self.peak_depth:
+            self.peak_depth = depth
+
+    def _degraded_locked(self) -> bool:
+        return bool(self.high_watermark) and \
+            len(self._items) + sum(len(h)
+                                   for h in self._gang_hold.values()) \
+            >= self.high_watermark
+
+    def degraded(self) -> bool:
+        """True while the backlog sits at/past the high watermark — the
+        daemon's signal to shed load (largest-bucket drains, gang holds
+        bypassed) and the ``scheduler_queue_degraded`` gauge's truth."""
+        with self._lock:
+            return self._degraded_locked()
 
     def add(self, pod: api.Pod) -> None:
         with self._lock:
             key = pod.key
             gname, gsize = pod.gang, pod.gang_size
+            if gname and gsize > 1 and self._degraded_locked():
+                # Degraded: bypass the hold — holding thousands of gangs
+                # during a storm defers work the drain could be shedding,
+                # and an incomplete gang is still admitted atomically (or
+                # rejected whole) by the solver's reduction.
+                gname = ""
             if gname and gsize > 1 and key not in self._items:
                 hold = self._gang_hold.setdefault(gname, {})
                 if not hold:
@@ -136,6 +184,11 @@ class FIFO:
             return len(self._items) + sum(
                 len(h) for h in self._gang_hold.values())
 
+    def __contains__(self, pod_key: str) -> bool:
+        with self._lock:
+            return pod_key in self._items or any(
+                pod_key in h for h in self._gang_hold.values())
+
     def held_gangs(self) -> dict[str, int]:
         """Gang name -> held member count (observability)."""
         with self._lock:
@@ -181,6 +234,26 @@ class FIFO:
         with self._lock:
             self._flush_overdue_gangs()
             while self._heap:
+                _, _, key = heapq.heappop(self._heap)
+                pod = self._items.pop(key, None)
+                if pod is not None:
+                    out.append(pod)
+        return out
+
+    def pop_some(self, limit: int, wait_first: bool = True,
+                 timeout: Optional[float] = None) -> list[api.Pod]:
+        """Drain at most ``limit`` pods (highest priority first) — the
+        degraded drain's entry point: each iteration solves one bounded,
+        pre-warmed bucket instead of materializing the whole storm as a
+        single batch, so per-drain memory stays O(limit) regardless of
+        backlog depth."""
+        if limit <= 0:
+            return self.pop_all(wait_first=wait_first, timeout=timeout)
+        first = self.pop(timeout=timeout) if wait_first else None
+        out = [first] if first is not None else []
+        with self._lock:
+            self._flush_overdue_gangs()
+            while self._heap and len(out) < limit:
                 _, _, key = heapq.heappop(self._heap)
                 pod = self._items.pop(key, None)
                 if pod is not None:

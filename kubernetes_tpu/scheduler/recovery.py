@@ -1,0 +1,118 @@
+"""Crash-safe restart reconciliation.
+
+A scheduler killed mid-drain (SIGKILL between solve and bind, a node
+dying under the daemon) leaves three classes of orphan behind:
+
+* pods the dead incarnation ASSUMED but whose binds never reached the
+  apiserver — unbound at relist; they must requeue, not strand Pending;
+* pods whose binds DID land but whose watch confirmations the dead
+  incarnation never processed — bound at relist; they must be re-adopted
+  into the cache as confirmed capacity, not double-scheduled;
+* cache entries with no apiserver record at all (the pod was deleted
+  while the scheduler was down) — stale assumes that must expire.
+
+The reflectors converge on all of this EVENTUALLY (relist Replace
+semantics); this module turns "eventually" into a verified startup step:
+one list against the apiserver, cross-checked against the cache and the
+queue, every discrepancy repaired and counted
+(``scheduler_restart_reconcile_total{action=}``), and the device-resident
+tensors re-seeded from the rebuilt cache (epoch bump → full re-upload)
+before the drain loop resumes.  Safety against the in-flight window the
+kill abandoned rests on the apiserver's bind CAS: a zombie bind from the
+dead incarnation either landed before the list (the pod shows bound and
+is adopted) or lands after and loses the CAS to nothing — the pod is on
+the queue, gets re-solved, and the zombie's 409 is absorbed by the
+normal forget+requeue path.  A pod can therefore never double-bind or
+strand across a restart.
+
+``ConfigFactory.run()`` calls :func:`reconcile` after the reflectors
+sync and before the drain loop starts (``KT_RECOVERY=0`` opts out).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils.logging import get_logger
+
+log = get_logger("recovery")
+
+
+def reconcile(daemon, store, scheduler_name: Optional[str] = None) -> dict:
+    """Reconcile the daemon's cache and queue against one apiserver
+    relist; returns the action report (also stored by the factory as
+    ``last_recovery`` and served on ``/debug/vars``).
+
+    ``daemon`` is the scheduler (queue + algorithm.cache + resident
+    mirror); ``store`` anything with ``list(kind)`` — a MemStore or an
+    APIClient.  ``scheduler_name`` filters requeues to pods this daemon
+    is responsible for (multi-scheduler dispatch)."""
+    t0 = time.perf_counter()
+    cache = daemon.config.algorithm.cache
+    items, _rv = store.list("pods")
+    report = {"readopted": 0, "requeued": 0, "expired": 0, "removed": 0,
+              "confirmed": 0, "pods_listed": len(items)}
+    seen: set[str] = set()
+    for obj in items:
+        key = api.key_from_json(obj)
+        seen.add(key)
+        if api.is_terminated_json(obj):
+            continue
+        node = (obj.get("spec") or {}).get("nodeName") or ""
+        if node:
+            # Bound at the apiserver.  An assumed entry agreeing on the
+            # node just flips to confirmed; anything else (unknown pod,
+            # or one tracked on a DIFFERENT node) re-adopts through the
+            # full add path — add_pod replaces the stale attachment, so
+            # the capacity stops being charged to the wrong node.
+            if cache.confirm_assumed(key, node):
+                report["confirmed"] += 1
+            else:
+                tracked = cache.get_pod(key)
+                if tracked is None or tracked.node_name != node:
+                    cache.add_pod(api.pod_from_json(obj))
+                    report["readopted"] += 1
+            daemon.queue.delete(key)
+        else:
+            # Unbound: the dead incarnation may have assumed it (bind
+            # never landed) — forget the stale assume and requeue.
+            if cache.is_assumed(key):
+                pod = cache.get_pod(key)
+                if pod is not None:
+                    cache.forget_pod(pod)
+                    pod.node_name = ""
+                report["expired"] += 1
+            if key not in daemon.queue:
+                pod = api.pod_from_json(obj)
+                if scheduler_name is None or \
+                        pod.scheduler_name == scheduler_name:
+                    daemon.enqueue(pod)
+                    if key in daemon.queue:
+                        report["requeued"] += 1
+    # Cache entries with no apiserver record: ghosts from the previous
+    # incarnation (pod deleted while the scheduler was down).
+    for key, _node, assumed in cache.tracked_pods():
+        if key in seen:
+            continue
+        pod = cache.get_pod(key)
+        if pod is not None:
+            cache.remove_pod(pod)
+            report["expired" if assumed else "removed"] += 1
+    # Re-seed the device-resident tensors from the reconciled cache: the
+    # epoch bump forces the next drain's sync to upload everything, so
+    # no pre-crash device state survives into post-restart decisions.
+    cache.force_resnapshot()
+    daemon.config.algorithm.resident.invalidate()
+    for action in ("readopted", "requeued", "expired", "removed",
+                   "confirmed"):
+        if report[action]:
+            metrics.RESTART_RECONCILE.labels(action=action).inc(
+                report[action])
+    report["duration_s"] = round(time.perf_counter() - t0, 4)
+    if any(report[a] for a in ("readopted", "requeued", "expired",
+                               "removed")):
+        log.info("restart reconciliation repaired state: %s", report)
+    return report

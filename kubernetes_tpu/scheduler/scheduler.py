@@ -95,6 +95,13 @@ class Scheduler:
         # Live queue depth at expose time (a set-per-mutation gauge would
         # put two lock acquisitions on every enqueue).
         config.metrics.queue_depth.set_fn(lambda: len(self.queue))
+        # Bounded-queue degradation surface (live at expose, same reason;
+        # the watermark reads through so rigs that retune it after
+        # construction stay honest).
+        config.metrics.queue_high_watermark.set_fn(
+            lambda: self.queue.high_watermark)
+        config.metrics.queue_degraded.set_fn(
+            lambda: 1.0 if self.queue.degraded() else 0.0)
         # Failure-detail cooldown: an unschedulable pod requeues every
         # backoff period and must not re-pay the explain device pass each
         # round.
@@ -205,11 +212,24 @@ class Scheduler:
         """Drain the queue and solve it as one device batch.  Returns the
         number of pods popped (scheduled or failed)."""
         t_wait = time.perf_counter()
-        pods = self.queue.pop_all(wait_first=wait_first, timeout=timeout)
+        degraded = self.queue.degraded()
+        if degraded:
+            # Load shedding: drain exactly one largest-warmed-bucket
+            # chunk — the storm's backlog stays in the queue (O(1) per
+            # pod) instead of becoming one unbounded batch's worth of
+            # [P, N] solve planes, and each iteration hits a pre-traced
+            # shape.  Slower decisions, bounded memory.
+            metrics_mod.DEGRADED_DRAINS.inc()
+            pods = self.queue.pop_some(self.degraded_drain_cap(),
+                                       wait_first=wait_first,
+                                       timeout=timeout)
+        else:
+            pods = self.queue.pop_all(wait_first=wait_first,
+                                      timeout=timeout)
         if not pods:
             return 0
         chunk = self.stream_chunk_size()
-        if self.accumulate_s > 0 and len(pods) < chunk:
+        if not degraded and self.accumulate_s > 0 and len(pods) < chunk:
             deadline = time.monotonic() + self.accumulate_s
             idle_polls = 0
             while len(pods) < chunk and idle_polls < 3 and \
@@ -235,7 +255,15 @@ class Scheduler:
             # The pods were already popped: requeue each through the
             # backoff path (condition + event + delayed retry) so a
             # crashing drain can't silently strand them Pending, and a
-            # poison pod retries at most once per 60 s.
+            # poison pod retries at most once per 60 s.  A daemon that
+            # was stopped/abandoned mid-drain does NOT requeue: the pods
+            # belong to the next incarnation (its startup reconciliation
+            # relists them), and a dead daemon writing conditions or
+            # requeue events would race the replacement's binds.
+            if self._stop.is_set():
+                log.info("drain interrupted by shutdown; %d pods left "
+                         "to the next incarnation", len(pods))
+                return len(pods)
             log.exception("drain of %d pods crashed; requeueing", len(pods))
             cache = self.config.algorithm.cache
             for pod in pods:
@@ -513,6 +541,14 @@ class Scheduler:
         pre-trace the same shape)."""
         return self.stream_chunk or min(self.STREAM_THRESHOLD, 8192)
 
+    def degraded_drain_cap(self) -> int:
+        """Pods per drain while shedding load: the largest bucket the
+        pre-warm traced (a degraded drain must never mint a fresh XLA
+        compile — the storm is exactly when compile stalls hurt most),
+        falling back to the one-shot pad limit when streaming is off."""
+        ladder = self.effective_ladder()
+        return max(ladder) if ladder else self._PAD_LIMIT
+
     def effective_ladder(self) -> list[int]:
         """The fixed set of chunk sizes this daemon's drains can compile
         at — pre-warm traces exactly this set; the drain paths can mint
@@ -750,6 +786,30 @@ class Scheduler:
             self._commit_pool.shutdown(wait=True)
         for t in self._bind_threads:
             t.join(timeout=5)
+        # Graceful shutdown persists the decision ring (KT_FLIGHT_DIR) so
+        # `kubectl explain pod` keeps answering across a scheduler bounce.
+        recorder = self.config.flight_recorder
+        flight_dir = os.environ.get("KT_FLIGHT_DIR", "")
+        if recorder is not None and flight_dir:
+            try:
+                recorder.save(flight_dir)
+            except OSError:
+                log.exception("flight-recorder dump to %s failed",
+                              flight_dir)
+
+    def abandon(self) -> None:
+        """SIGKILL-style stop: no graceful drain, no joins, no flight
+        dump — the in-flight pipeline window (solved-but-uncommitted
+        chunks, dispatched binds) is simply abandoned, exactly what a
+        kill between solve and bind leaves behind.  Safety then rests on
+        the apiserver's bind CAS (an abandoned bind that still lands
+        cannot be double-applied) and the next incarnation's startup
+        reconciliation (scheduler/recovery.py), which requeues anything
+        left unbound and adopts anything that did land."""
+        self._stop.set()
+        self.queue.close()
+        if self._commit_pool is not None:
+            self._commit_pool.shutdown(wait=False, cancel_futures=True)
 
     def wait_for_binds(self) -> None:
         for t in list(self._bind_threads):

@@ -407,6 +407,31 @@ COMPILE_CACHE_MISSES = register(Counter(
     "compile_cache_misses_total",
     "Jit compilations that missed the persistent XLA compilation cache "
     "and paid the full compile"))
+# Churn & recovery (cache/verifier.py, scheduler/recovery.py): the
+# resident-state invariant checker and the restart reconciler.  A nonzero
+# violations count is the signal that device-resident state drifted from
+# cache (or cache from apiserver) truth — the soak ratchet
+# (tools/check_bench.py) fails tier-1 on it.
+CACHE_INVARIANT_VIOLATIONS = register(Counter(
+    "scheduler_cache_invariant_violations_total",
+    "Resident-state invariant violations found by the background "
+    "verifier, by kind (aggregates: cache aggregate rows vs a recompute "
+    "from tracked pods; device_row: device-resident tensor rows vs host "
+    "arrays; apiserver: cache pod placements vs apiserver truth).  Each "
+    "triggers a self-heal full re-snapshot",
+    labelnames=("kind",)))
+RESTART_RECONCILE = register(Counter(
+    "scheduler_restart_reconcile_total",
+    "Startup reconciliation actions after a scheduler (re)start: "
+    "readopted (bound pod re-adopted into the cache), requeued (pending "
+    "orphan put back on the queue), expired (stale assume forgotten), "
+    "removed (cache ghost with no apiserver record dropped)",
+    labelnames=("action",)))
+# Bounded-queue degradation (scheduler/queue.py + scheduler.py).
+DEGRADED_DRAINS = register(Counter(
+    "scheduler_degraded_drains_total",
+    "Drains executed in degraded (load-shedding) mode because the "
+    "pending queue crossed its high watermark"))
 # Bind path (scheduler/scheduler.py).
 BIND_CONFLICTS = register(Counter(
     "scheduler_bind_conflicts_total",
@@ -464,6 +489,18 @@ class SchedulerMetrics:
             "Pod scheduling attempts by result (scheduled/unschedulable/"
             "bind_conflict/bind_error/error)",
             labelnames=("result",))
+        # Bounded-queue degradation surface: the configured watermark and
+        # whether the daemon is currently shedding load (live at expose,
+        # like queue_depth).
+        self.queue_high_watermark = Gauge(
+            "scheduler_queue_high_watermark",
+            "Pending-queue depth past which the daemon sheds load "
+            "(largest-bucket-first drains, gang holds bypassed); 0 = "
+            "unbounded")
+        self.queue_degraded = Gauge(
+            "scheduler_queue_degraded",
+            "1 while the pending queue is past its high watermark and "
+            "the daemon drains in degraded (load-shedding) mode")
 
     def expose(self) -> str:
         # The default registry (retry/breaker/degradation counters, stage
@@ -472,4 +509,5 @@ class SchedulerMetrics:
         return "".join(m.expose() for m in (
             self.e2e_scheduling_latency, self.scheduling_algorithm_latency,
             self.binding_latency, self.queue_depth, self.batch_size,
-            self.scheduling_attempts)) + expose_registry()
+            self.scheduling_attempts, self.queue_high_watermark,
+            self.queue_degraded)) + expose_registry()

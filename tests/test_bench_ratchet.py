@@ -79,3 +79,64 @@ def test_disappearing_stage_fails():
 def test_fewer_than_two_artifacts_is_vacuously_green():
     assert cb.check([]) == []
     assert cb.check([("BENCH_r01.json", _parsed(p50=1.0))]) == []
+
+
+# -- SOAK artifact ratchet (ISSUE 7) ----------------------------------------
+
+def _soak(violations=0, double_binds=0, stranded=0, orphaned=0,
+          monotonic=False, parity=100.0, settle=5.0):
+    return {"invariant_violations": violations,
+            "reconciliation": {"double_binds": double_binds,
+                               "stranded_pending": stranded,
+                               "orphaned_assumes": orphaned,
+                               "bound_to_missing_node": 0},
+            "queue_depth": {"monotonic_growth": monotonic,
+                            "steady_window_slope_pods_per_s":
+                                50.0 if monotonic else 0.0},
+            "restart_parity": {"decision_parity_pct": parity,
+                               "samples": 50},
+            "settle_s": settle}
+
+
+def test_repo_soak_artifacts_pass_the_ratchet():
+    problems = cb.check_soak()
+    assert problems == [], problems
+
+
+def test_soak_invariant_violation_fails():
+    problems = cb.check_soak([("SOAK_r07.json", _soak(violations=2))])
+    assert len(problems) == 1 and "invariant violation" in problems[0]
+
+
+def test_soak_reconciliation_failures_fail():
+    problems = cb.check_soak([("SOAK_r07.json", _soak(double_binds=1,
+                                                      orphaned=3))])
+    assert len(problems) == 2
+    assert any("double_binds" in p for p in problems)
+    assert any("orphaned_assumes" in p for p in problems)
+
+
+def test_soak_monotonic_queue_growth_fails():
+    problems = cb.check_soak([("SOAK_r07.json", _soak(monotonic=True))])
+    assert len(problems) == 1 and "monotonically" in problems[0]
+
+
+def test_soak_restart_parity_below_100_fails():
+    problems = cb.check_soak([("SOAK_r07.json", _soak(parity=99.5))])
+    assert len(problems) == 1 and "parity" in problems[0]
+
+
+def test_soak_settle_regression_beyond_tolerance_fails():
+    arts = [("SOAK_r07.json", _soak(settle=10.0)),
+            ("SOAK_r08.json", _soak(settle=12.0))]
+    problems = cb.check_soak(arts)
+    assert len(problems) == 1 and "settle regressed" in problems[0]
+    # Inside the noise band, and improvements, pass.
+    assert cb.check_soak([("SOAK_r07.json", _soak(settle=10.0)),
+                          ("SOAK_r08.json", _soak(settle=11.0))]) == []
+    assert cb.check_soak([("SOAK_r07.json", _soak(settle=10.0)),
+                          ("SOAK_r08.json", _soak(settle=7.0))]) == []
+
+
+def test_soak_green_artifact_passes_alone():
+    assert cb.check_soak([("SOAK_r07.json", _soak())]) == []

@@ -194,6 +194,58 @@ def test_forced_410_gone_watch(rig_factory):
     rig.assert_daemon_alive()
 
 
+def test_410_resume_relists_from_fresh_rv_not_zero(rig_factory,
+                                                   monkeypatch):
+    """ISSUE 7 satellite audit: after 410 Gone mid-storm the reflector
+    must relist and resume its watch from the FRESH list's
+    resourceVersion — never from 0, which would replay the server's
+    whole buffered event window (stale node rows straight into the
+    dirty-row path).  Instrumented at APIClient.watch: every watch open
+    after churn has begun must carry a nonzero, non-decreasing rv; and
+    a node capacity update applied during the storm must survive (a
+    stale replay would let an old row overwrite it)."""
+    from kubernetes_tpu.client.http import APIClient
+    opened: list[tuple[str, int]] = []
+    real_watch = APIClient.watch
+
+    def spying_watch(self, kind, from_rv, field_selector=""):
+        opened.append((kind, from_rv))
+        return real_watch(self, kind, from_rv,
+                          field_selector=field_selector)
+
+    monkeypatch.setattr(APIClient, "watch", spying_watch)
+    # 410 on every 2nd watch open, plus mid-event cuts to force extra
+    # relist cycles — the resume-after-410-mid-storm shape.
+    rig = rig_factory(rules=[
+        {"fault": "error", "method": "GET", "path": r"watch=1",
+         "status": 410, "every_nth": 2, "count": 4},
+        {"fault": "cut-stream", "path": r"pods\?watch=1",
+         "after_events": 1, "count": 2}])
+    names = rig.create_pods(8)
+    # Churn a node's capacity DURING the storm: the post-410 relist must
+    # deliver the newest row, and no stale replay may revert it.
+    node = rig.direct.get("nodes", "node-0")
+    node["status"]["allocatable"]["cpu"] = "48"
+    node["metadata"].pop("resourceVersion", None)
+    rig.direct.update("nodes", node)
+    rig.wait_bound(names)
+    more = rig.create_pods(4, prefix="late")
+    rig.wait_bound(more)
+    rig.assert_daemon_alive()
+    # The 410s really fired, forcing resume-after-410 cycles...
+    injected_410 = [r for r in rig.proxy.rules() if r.status == 410]
+    assert injected_410 and injected_410[0].fired >= 1
+    # ...and EVERY watch open (first syncs included — the reflector
+    # always lists first, and the rig created objects before the daemon
+    # started) carried a fresh nonzero resourceVersion: a 0 here would
+    # be the replay-the-whole-window bug this audit pins against.
+    assert len(opened) > 8, "storm produced no watch re-opens"
+    assert all(rv > 0 for _k, rv in opened), opened
+    # The churned capacity survived every relist (no stale replay).
+    cached = {n.name: n for n in rig.factory.algorithm.cache.nodes()}
+    assert cached["node-0"].allocatable_milli_cpu == 48000
+
+
 def test_injected_latency(rig_factory):
     """200 ms injected on a third of requests: slower, but the control
     plane converges and no thread trips a timeout it can't absorb."""

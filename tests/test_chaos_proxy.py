@@ -178,6 +178,107 @@ class TestChaosProxy:
         w.stop()
 
 
+# -- composable node-lifecycle rule helpers ---------------------------------
+
+class TestLifecycleRuleHelpers:
+    def test_heartbeat_drop_cadence_hits_every_nth_put(self, rig):
+        """heartbeat_drop targets node-status PUTs only, on the exact
+        every_nth cadence — GETs and pod traffic flow untouched."""
+        from kubernetes_tpu.chaos import heartbeat_drop
+        store, proxy, client, _ = rig
+        client.create("nodes", {"metadata": {"name": "hb1"}})
+        proxy.add_rules(heartbeat_drop(every_nth=2))
+        obj = client.get("nodes", "hb1")
+        failures = 0
+        for i in range(6):
+            obj["metadata"].pop("resourceVersion", None)
+            obj["metadata"]["labels"] = {"beat": str(i)}
+            try:
+                obj = client.update("nodes", obj)
+            except APIError as err:
+                assert err.status == 503
+                failures += 1
+        assert failures == 3  # PUTs 2, 4, 6
+        # Reads never matched the rule.
+        assert client.get("nodes", "hb1") is not None
+
+    def test_node_flap_kinds_and_scoping(self, rig):
+        from kubernetes_tpu.chaos import node_flap
+        store, proxy, client, _ = rig
+        client.create("nodes", {"metadata": {"name": "flappy"}})
+        client.create("nodes", {"metadata": {"name": "steady"}})
+        rules = node_flap(kind="drop", period=2, name="flappy")
+        assert len(rules) == 1 and rules[0].every_nth == 2
+        assert rules[0].matches("PUT", "/api/v1/nodes/flappy")
+        assert not rules[0].matches("PUT", "/api/v1/nodes/steady")
+        proxy.add_rules(rules)
+        flap = client.get("nodes", "flappy")
+        client.max_retries = 0
+        failures = 0
+        for i in range(4):
+            flap["metadata"].pop("resourceVersion", None)
+            try:
+                flap = client.update("nodes", flap)
+            except APIError:
+                failures += 1
+        assert failures == 2
+        # The reset and latency kinds build, the unknown kind refuses.
+        assert node_flap(kind="reset")[0].fault == "reset"
+        assert node_flap(kind="latency", delay_s=0.1)[0].delay_s == 0.1
+        with pytest.raises(ValueError):
+            node_flap(kind="nonsense")
+
+    def test_watch_cut_on_relist_cuts_every_nth_stream(self, rig):
+        """Every 2nd pods watch dies mid-event right after open; other
+        kinds' watches are untouched."""
+        from kubernetes_tpu.chaos import watch_cut_on_relist
+        store, proxy, client, upstream = rig
+        client.create("pods", {
+            "metadata": {"name": "w1", "namespace": "default"},
+            "spec": {"containers": [{"name": "c"}]}})
+        client.create("nodes", {"metadata": {"name": "wn1"}})
+        proxy.add_rules(watch_cut_on_relist("pods", every_nth=2))
+        w = client.watch("pods", 0)   # 1st open: healthy
+        assert w.next(timeout=3).type == "ADDED"
+        w.stop()
+        w = client.watch("pods", 0)   # 2nd open: cut mid-event
+        types = []
+        for _ in range(3):
+            ev = w.next(timeout=3)
+            if ev is None:
+                break
+            types.append(ev.type)
+            if ev.type == "ERROR":
+                break
+        assert types[-1] == "ERROR"
+        w.stop()
+        wn = client.watch("nodes", 0)  # other kinds never match
+        assert wn.next(timeout=3).type == "ADDED"
+        wn.stop()
+
+    def test_bind_conflict_storm_shape(self):
+        from kubernetes_tpu.chaos import bind_conflict_storm
+        rules = bind_conflict_storm(every_nth=3)
+        assert len(rules) == 1
+        r = rules[0]
+        assert r.status == 409 and r.method == "POST" and \
+            r.every_nth == 3
+        assert r.matches("POST", "/api/v1/namespaces/default/bindings")
+        assert not r.matches("POST", "/api/v1/pods")
+
+    def test_helpers_compose_by_concatenation(self, rig):
+        from kubernetes_tpu.chaos import (bind_conflict_storm,
+                                          heartbeat_drop,
+                                          watch_cut_on_relist)
+        _, proxy, _, _ = rig
+        rules = (heartbeat_drop(every_nth=5) +
+                 watch_cut_on_relist("pods", every_nth=3) +
+                 bind_conflict_storm(every_nth=7))
+        ids = proxy.add_rules(rules)
+        assert len(ids) == 3 and len(set(ids)) == 3
+        assert len(proxy.rules()) == 3
+
+
 # -- circuit breaker --------------------------------------------------------
 
 class TestCircuitBreaker:

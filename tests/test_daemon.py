@@ -171,6 +171,60 @@ class TestBatchedDrain:
         assert "scheduler_e2e_scheduling_latency_microseconds_bucket" in text
         assert 'le="1000"' in text and 'le="+Inf"' in text
 
+class TestFlightRecorderPersistence:
+    def test_ring_survives_a_scheduler_bounce(self, tmp_path,
+                                              monkeypatch):
+        """ISSUE 7 satellite: the decision ring dumps to KT_FLIGHT_DIR
+        on graceful shutdown and reloads on startup, so `kubectl explain
+        pod` keeps answering across a restart — with batch ids
+        continuing past the reloaded maximum."""
+        monkeypatch.setenv("KT_FLIGHT_DIR", str(tmp_path))
+        s = _scheduler()
+        s.enqueue(make_pod("fp1"))
+        assert s.schedule_one(timeout=0.1)
+        first = s.config.flight_recorder.explain("default/fp1")
+        assert first and first["result"] == "scheduled"
+        s.stop()  # dumps the ring
+        assert (tmp_path / "flight_ring.json").exists()
+        # The "restarted" daemon: a fresh config auto-loads the dump.
+        s2 = _scheduler()
+        again = s2.config.flight_recorder.explain("default/fp1")
+        assert again and again["node"] == first["node"]
+        assert again["batch_id"] == first["batch_id"]
+        # New decisions mint ids PAST the reloaded ones.
+        s2.enqueue(make_pod("fp2"))
+        assert s2.schedule_one(timeout=0.1)
+        newer = s2.config.flight_recorder.explain("default/fp2")
+        assert newer["batch_id"] > first["batch_id"]
+
+    def test_abandon_skips_the_dump_and_missing_dump_is_fine(
+            self, tmp_path, monkeypatch):
+        """SIGKILL-style abandon must not pretend to be a graceful
+        shutdown (no dump); startup with no dump present is a no-op."""
+        monkeypatch.setenv("KT_FLIGHT_DIR", str(tmp_path))
+        s = _scheduler()
+        s.enqueue(make_pod("fa1"))
+        assert s.schedule_one(timeout=0.1)
+        s.abandon()
+        assert not (tmp_path / "flight_ring.json").exists()
+        s2 = _scheduler()  # loads nothing, works normally
+        assert s2.config.flight_recorder.explain("default/fa1") is None
+
+    def test_torn_dump_never_blocks_startup(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KT_FLIGHT_DIR", str(tmp_path))
+        (tmp_path / "flight_ring.json").write_text("{not json")
+        s = _scheduler()
+        s.enqueue(make_pod("ft1"))
+        assert s.schedule_one(timeout=0.1)
+        assert s.config.flight_recorder.explain("default/ft1")
+        # Valid JSON of the wrong shape must not block startup either.
+        (tmp_path / "flight_ring.json").write_text(
+            '{"records": [{"batch_id": null}, "not-a-dict"]}')
+        s2 = _scheduler()
+        s2.enqueue(make_pod("ft2"))
+        assert s2.schedule_one(timeout=0.1)
+
+
 class TestDrainPadding:
     def test_padding_is_decision_neutral(self):
         """schedule_pending pads small drains to power-of-two buckets;

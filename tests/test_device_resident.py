@@ -135,6 +135,47 @@ class TestResidentCluster:
         assert algo.resident.stats["full_syncs"] == before + 1
         _assert_resident_matches_fresh(algo)
 
+    def test_node_delete_readd_same_name_different_capacity(self):
+        """ISSUE 7 satellite: delete a node and re-add it under the SAME
+        name with DIFFERENT capacity between drains.  The shape
+        signature is unchanged (same row count, same column caps), so
+        only the ``tensor_epoch`` bump can force the re-upload — a
+        stale mirror would keep scheduling against the old capacity."""
+        daemon = _rig(n_nodes=3)
+        algo = daemon.config.algorithm
+        # Fill the tiny fleet so only fresh capacity can take more.
+        for i, node in enumerate(("rn0", "rn1", "rn2")):
+            algo.cache.update_node(make_node(node, milli_cpu=1000))
+        fillers = [make_pod(f"fill{i}", cpu="900m") for i in range(3)]
+        for pod, dest in zip(fillers, algo.schedule_batch(fillers)):
+            assert dest is not None
+            algo.cache.assume_pod(pod, dest)
+        epoch_before = algo.cache.tensor_epoch
+        fulls_before = algo.resident.stats["full_syncs"]
+        # The churn: rn1 dies and rejoins with 8x the capacity.  Its
+        # pods stay tracked until their own deletes arrive (reference
+        # semantics) — remove them explicitly like the node drain does.
+        for pod in fillers:
+            if pod.node_name == "rn1":
+                algo.cache.remove_pod(pod)
+        algo.cache.remove_node("rn1")
+        algo.cache.add_node(make_node("rn1", milli_cpu=8000))
+        # A big pod fits ONLY the re-added node's new capacity: a stale
+        # resident row (old 1000m) would fail it everywhere.
+        [dest] = algo.schedule_batch([make_pod("big", cpu="4")])
+        assert dest == "rn1"
+        assert algo.cache.tensor_epoch > epoch_before
+        assert algo.resident.stats["full_syncs"] == fulls_before + 1
+        _assert_resident_matches_fresh(algo)
+        # And the reverse edge: re-add with SHRUNK capacity — the mirror
+        # must not keep placing against the old larger row.
+        algo.cache.remove_node("rn2")
+        algo.cache.add_node(make_node("rn2", milli_cpu=100))
+        placements = algo.schedule_batch(
+            [make_pod(f"post{i}", cpu="600m") for i in range(2)])
+        assert all(p != "rn2" for p in placements)
+        _assert_resident_matches_fresh(algo)
+
     def test_majority_dirty_falls_back_to_full_upload(self):
         """Dirtying most of a small cluster re-uploads instead of
         scattering (the gather would move most of the bytes anyway)."""

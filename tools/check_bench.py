@@ -151,6 +151,100 @@ def check_workloads(artifacts: list[tuple[str, dict]] | None = None,
     return problems
 
 
+def _committed_soak_names() -> set[str] | None:
+    """SOAK artifacts tracked at git HEAD (None when git is
+    unavailable) — the same committed-at-HEAD rule as the WORKLOADS
+    ratchet, and a separate ls-tree pass for the same reason."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "-C", REPO, "ls-tree", "-r", "--name-only", "HEAD"],
+            capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if out.returncode != 0:
+        return None
+    return {n for n in out.stdout.splitlines()
+            if re.fullmatch(r"SOAK_r\d+\.json", n)}
+
+
+def committed_soak_artifacts() -> list[tuple[str, dict]]:
+    """[(name, payload)] for committed SOAK_r{N}.json artifacts (the
+    churn-soak robustness rows emitted by perf/soak.py), ascending by
+    round number."""
+    committed = _committed_soak_names()
+    found: list[tuple[int, str, dict]] = []
+    for name in os.listdir(REPO):
+        m = re.fullmatch(r"SOAK_r(\d+)\.json", name)
+        if not m:
+            continue
+        if committed is not None and name not in committed:
+            continue
+        try:
+            with open(os.path.join(REPO, name)) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if "invariant_violations" in data:
+            found.append((int(m.group(1)), name, data))
+    found.sort()
+    return [(name, data) for _, name, data in found]
+
+
+def check_soak(artifacts: list[tuple[str, dict]] | None = None,
+               tolerance: float = TOLERANCE) -> list[str]:
+    """Problems with the newest SOAK artifact: ANY invariant violation,
+    any reconciliation failure (double-bind / stranded pod / orphaned
+    assume after the mid-drain kill), monotonically growing
+    steady-state queue depth, a restart-parity miss, or (vs the
+    predecessor) a settle-time regression beyond ``tolerance``.  The
+    soak is the robustness ratchet: these are invariants, so unlike the
+    perf rows most checks fail on the newest artifact alone."""
+    if artifacts is None:
+        artifacts = committed_soak_artifacts()
+    problems: list[str] = []
+    if not artifacts:
+        return problems
+    new_name, new = artifacts[-1]
+    if new.get("invariant_violations"):
+        problems.append(
+            f"{new_name}: {new['invariant_violations']} resident-state "
+            f"invariant violation(s) — cache/device/apiserver truth "
+            f"diverged during the soak")
+    rec = new.get("reconciliation") or {}
+    for field_name in ("double_binds", "stranded_pending",
+                       "orphaned_assumes", "bound_to_missing_node"):
+        if rec.get(field_name):
+            problems.append(
+                f"{new_name}: post-soak reconciliation found "
+                f"{rec[field_name]} {field_name} — the mid-drain "
+                f"restart broke an acceptance invariant")
+    if (new.get("queue_depth") or {}).get("monotonic_growth"):
+        problems.append(
+            f"{new_name}: steady-state queue depth grew monotonically "
+            f"(slope "
+            f"{new['queue_depth'].get('steady_window_slope_pods_per_s')}"
+            f" pods/s) — bounded-queue degradation failed")
+    parity = new.get("restart_parity") or {}
+    if parity and parity.get("decision_parity_pct", 100.0) < 100.0:
+        problems.append(
+            f"{new_name}: post-restart decision parity "
+            f"{parity['decision_parity_pct']}% < 100% — recovery "
+            f"corrupted the rebuilt scheduling state")
+    if len(artifacts) >= 2:
+        (prev_name, prev) = artifacts[-2]
+        prev_settle, new_settle = prev.get("settle_s"), \
+            new.get("settle_s")
+        if prev_settle and new_settle and \
+                float(new_settle) > float(prev_settle) * \
+                (1.0 + tolerance):
+            problems.append(
+                f"soak settle regressed: {new_name} {new_settle}s vs "
+                f"{prev_name} {prev_settle}s (tolerance "
+                f"{tolerance * 100:.0f}%)")
+    return problems
+
+
 def _shape_pods(parsed: dict) -> int:
     m = re.search(r"([\d,]+) pods onto", parsed.get("metric", ""))
     return int(m.group(1).replace(",", "")) if m else 30000
@@ -214,6 +308,7 @@ def check(artifacts: list[tuple[str, dict]] | None = None,
 
 def main() -> int:
     problems = check_workloads()
+    problems += check_soak()
     artifacts = committed_artifacts()
     if len(artifacts) < 2:
         print("bench ratchet: fewer than two committed BENCH artifacts; "
@@ -233,6 +328,11 @@ def main() -> int:
     if wl:
         print(f"workloads ratchet OK: {wl[-1][0]} quality "
               f"x{quality_row(wl[-1][1])}")
+    sk = committed_soak_artifacts()
+    if sk:
+        print(f"soak ratchet OK: {sk[-1][0]} settle "
+              f"{sk[-1][1].get('settle_s')}s, "
+              f"{sk[-1][1].get('invariant_violations')} violations")
     return 0
 
 
