@@ -231,6 +231,31 @@ def main() -> None:
         except Exception as err:  # noqa: BLE001 — phase is additive
             print(f"soak phase failed: {err}", file=sys.stderr)
 
+    # Serving path (ISSUE 8): per-decision submit->bind latency SLOs
+    # under Poisson trickle / recorded burst replay / ramp arrivals,
+    # through the full daemon over HTTP with deadline micro-batching on
+    # — written as its own committed artifact (SERVING_r{N}.json) that
+    # tools/check_bench.py ratchets (trickle SLO attainment below its
+    # recorded floor or p99 regressing >15% fails tier-1).
+    # BENCH_SERVING=0 skips (~60 s).
+    serving = None
+    if os.environ.get("BENCH_SERVING", "1") != "0":
+        from kubernetes_tpu.perf import serving as serving_mod
+        try:
+            serving = serving_mod.collect()
+            serving_path = os.environ.get("BENCH_SERVING_OUT",
+                                          "SERVING_r08.json")
+            with open(serving_path, "w") as f:
+                json.dump(serving, f, indent=1)
+                f.write("\n")
+            trickle = serving["workloads"]["poisson_trickle"]
+            print(f"serving: trickle p99 "
+                  f"{trickle['latency_ms']['p99']}ms, attainment "
+                  f"{trickle['slo']['attainment_pct']}% "
+                  f"-> {serving_path}", file=sys.stderr)
+        except Exception as err:  # noqa: BLE001 — phase is additive
+            print(f"serving phase failed: {err}", file=sys.stderr)
+
     # Kubemark-scale control plane (VERDICT r3 #9): 500 hollow kubelets +
     # 2,000 replicas through the real scheduler, controller sync cost and
     # heartbeat write load measured.  BENCH_FLEET=0 skips (~90 s).
@@ -303,6 +328,18 @@ def main() -> None:
             # The wire shape's own stage breakdown: diffed against the
             # in-process one above, it says where the 5x wire gap lives.
             "stages": wire.stages,
+        }
+    if serving is not None:
+        trickle = serving["workloads"]["poisson_trickle"]
+        out["serving"] = {
+            "deadline_ms": serving["deadline_ms"],
+            "trickle_p50_ms": trickle["latency_ms"]["p50"],
+            "trickle_p99_ms": trickle["latency_ms"]["p99"],
+            "trickle_slo_attainment_pct":
+                trickle["slo"]["attainment_pct"],
+            "burst_p99_ms": serving["workloads"]["burst_replay"]
+            ["latency_ms"]["p99"],
+            "goodput_pods_s": trickle["goodput_pods_s"],
         }
     if soak is not None:
         out["soak"] = {

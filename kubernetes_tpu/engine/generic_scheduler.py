@@ -606,7 +606,7 @@ class GenericScheduler:
         device->host readback and returns the placements — the daemon's
         overlapped pipeline calls it on the binder pool so the drain
         thread never blocks on the device and batch N's scan runs while
-        batch N-1 commits (scheduler.Scheduler._schedule_pending_stream).
+        batch N-1 commits (scheduler.pipeline.DrainPipeline._solve_stream).
 
         The last chunk is padded with inert pods (live=False rows are
         infeasible everywhere and bump no tie counter) so every chunk hits

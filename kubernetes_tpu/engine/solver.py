@@ -375,6 +375,43 @@ class ResidentCluster:
             self._scatter = jax.jit(scatter)
         return self._scatter
 
+    def prewarm_scatter(self, max_rows: int | None = None) -> int:
+        """Trace the dirty-row scatter kernel at EVERY reachable pow2
+        row-count bucket, so no drain after an assume ever compiles the
+        scatter mid-drain — measured as a fresh XLA compile on the clock
+        of the first post-warm-up stream drain (the warm-start audit,
+        ISSUE 8).  The reachable set is bounded by ``sync``'s own rule
+        (dirty * FULL_FRACTION >= N takes the full upload instead), so
+        this is log2(N/4) shapes — ~12 at 5k nodes, ~15 at 100k; an
+        explicit ``max_rows`` caps it for tests.  Requires a resident
+        copy (``sync`` must have run, which any ladder prewarm
+        guarantees); the traces scatter row 0's own values onto row 0 —
+        a no-op on the data.  Returns the number of shapes traced."""
+        if self.dc is None:
+            return 0
+        n = int(self.dc.alloc.shape[0])
+        # sync() only scatters when dirty * FULL_FRACTION < N; larger
+        # dirty sets take the full upload, so their shapes are unreachable.
+        limit = (max(n - 1, 1)) // self.FULL_FRACTION
+        if limit < 1:
+            return 0
+        limit = 1 << (limit - 1).bit_length() if limit > 1 else 1
+        if max_rows is not None:
+            limit = min(limit, max_rows)
+        scatter = self._scatter_fn()
+        traced = 0
+        k = 1
+        while k <= limit:
+            idx = np.zeros(k, np.int32)
+            rows = DeviceCluster(*[
+                np.repeat(np.asarray(arr[:1]), k, axis=0)
+                for arr in self.dc])
+            idx_d, rows_d = jax.device_put((idx, rows))
+            scatter(self.dc, idx_d, rows_d).alloc.block_until_ready()
+            traced += 1
+            k <<= 1
+        return traced
+
     def sync(self, nt: NodeTensors, agg: NodeAggregates,
              space: FeatureSpace, dirty: set[int],
              epoch: int) -> DeviceCluster:

@@ -256,10 +256,19 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         # KT_WIRE_ACCUM expose the space for measurement.
         daemon.stream_chunk = int(_os.environ.get(
             "KT_WIRE_CHUNK", str((num_pods + 2047) // 2048 * 2048)))
-        # Coalesce the arrival race into full chunks: a trickle-fed drain
-        # otherwise pays a full padded scan (plus per-launch tunnel
-        # overhead) for every fragment the creators happen to land.
-        daemon.accumulate_s = float(_os.environ.get("KT_WIRE_ACCUM", "3.0"))
+        # Coalesce the arrival race into full chunks through the batch
+        # former's deadline (scheduler/batchformer.py): a trickle-fed
+        # drain otherwise pays a full padded scan (plus per-launch tunnel
+        # overhead) for every fragment the creators happen to land.  The
+        # former exits early once arrivals go idle, so the deadline is a
+        # ceiling, not a tax.
+        daemon.pipeline.former.deadline_s = float(
+            _os.environ.get("KT_WIRE_ACCUM", "3.0"))
+        # Start the adaptive target at the wire chunk: this rig WANTS
+        # whole-burst accumulation (one launch beats chunking on a
+        # tunneled chip), not the serving default of growing up from
+        # the floor bucket.
+        daemon.pipeline.former._target = daemon.stream_chunk_size()
 
         # Warm before the clock (the reference excludes apiserver warmup
         # the same way); the cold-compile cost is reported, not hidden.

@@ -218,6 +218,16 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
                     # the daemon sheds load (largest-bucket drains, gang
                     # holds bypassed).
                     "degraded": queue.degraded(),
+                    # The serving surface: the formation deadline in
+                    # force, the former's adaptive target bucket, and
+                    # the warm-start audit's per-signature cache stats.
+                    "batchDeadlineMs": round(
+                        factory.daemon.pipeline.former.deadline_s * 1e3,
+                        1),
+                    "batchFormerTarget":
+                        factory.daemon.pipeline.former.target,
+                    "prewarmCacheStats":
+                        factory.daemon.prewarm_cache_stats,
                     "invariantViolations":
                         CACHE_INVARIANT_VIOLATIONS.value,
                     "lastRecovery": getattr(factory, "last_recovery",

@@ -8,6 +8,13 @@ machine and adds the TPU-native batched drain: ``schedule_pending`` pops the
 whole queue and solves it as ONE device batch, assuming and binding every
 placement — same observable behavior, three orders of magnitude fewer
 device round-trips.
+
+The batched drain itself — batch formation (deadline micro-batching,
+scheduler/batchformer.py), mode routing, the overlapped solve/commit
+worker, and crash handling — lives in ``scheduler.pipeline.DrainPipeline``;
+this module keeps the commit-side state machine (assume/bind,
+preemption, failure requeue, backoff) the pipeline calls back into, plus
+the daemon lifecycle (run loops, prewarm, stop/abandon).
 """
 
 from __future__ import annotations
@@ -24,6 +31,7 @@ from kubernetes_tpu.apiserver.memstore import ConflictError
 from kubernetes_tpu.engine.extender_client import ExtenderError
 from kubernetes_tpu.engine.generic_scheduler import FitError, GenericScheduler
 from kubernetes_tpu.scheduler.backoff import PodBackoff
+from kubernetes_tpu.scheduler.batchformer import first_seen
 from kubernetes_tpu.scheduler.binder import Binder, BindConflict, InMemoryBinder
 from kubernetes_tpu.scheduler.flightrecorder import FlightRecorder
 from kubernetes_tpu.scheduler.queue import FIFO
@@ -32,7 +40,7 @@ from kubernetes_tpu.utils import trace as trace_mod
 from kubernetes_tpu.utils.events import EventRecorder
 from kubernetes_tpu.utils.logging import get_logger
 from kubernetes_tpu.utils.metrics import SchedulerMetrics
-from kubernetes_tpu.utils.trace import Trace, stage
+from kubernetes_tpu.utils.trace import stage
 
 
 def _record_bind_failure(err) -> str:
@@ -88,10 +96,12 @@ class Scheduler:
         # behavior).
         self.pipeline_window = int(os.environ.get(
             "KT_PIPELINE_WINDOW", "2") or "2")
-        self._commit_pool = None
         # Workload-subsystem prewarm timings (string-keyed; see
         # _prewarm_workloads) — {} until prewarm() runs.
         self.workloads_prewarm_s: dict = {}
+        # Per-ladder-bucket persistent-compile-cache hits/misses observed
+        # during prewarm (the warm-start audit) — {} until prewarm() runs.
+        self.prewarm_cache_stats: dict = {}
         # Live queue depth at expose time (a set-per-mutation gauge would
         # put two lock acquisitions on every enqueue).
         config.metrics.queue_depth.set_fn(lambda: len(self.queue))
@@ -106,6 +116,14 @@ class Scheduler:
         # backoff period and must not re-pay the explain device pass each
         # round.
         self._explain_ts: dict[str, float] = {}
+        # First-seen registry for the e2e decision-latency SLO, keyed by
+        # pod key: watch redeliveries (a condition write, any MODIFIED)
+        # arrive as FRESH pod objects, so an object-only stamp would
+        # reset the SLO clock on exactly the retried tail pods the
+        # histogram exists to measure.  Entries clear at bind ack;
+        # leftovers (pods deleted while pending) are pruned when the
+        # registry outgrows its bound.
+        self._first_seen: dict[str, float] = {}
         self._stop = threading.Event()
         self._bind_threads: list[threading.Thread] = []
         # Single requeue worker over a timer heap (a thread per failed pod
@@ -114,6 +132,30 @@ class Scheduler:
         self._requeue_cv = threading.Condition()
         self._requeue_seq = 0
         self._requeue_thread: Optional[threading.Thread] = None
+        # THE drain path: every batched drain goes queue -> DrainPipeline
+        # (form -> solve -> commit); constructed last so the former can
+        # read the daemon's ladder/chunk/cap knobs.
+        from kubernetes_tpu.scheduler.pipeline import DrainPipeline
+        self.pipeline = DrainPipeline(self)
+
+    @property
+    def _commit_pool(self):
+        """The overlapped commit worker now lives on the pipeline; kept
+        as a read-through so rigs inspecting the daemon keep working."""
+        return self.pipeline._commit_pool
+
+    @property
+    def accumulate_s(self) -> float:
+        """DEPRECATED alias for the batch former's deadline (the old
+        arrival-coalescing linger window): reads/writes map onto
+        ``pipeline.former.deadline_s`` so pre-serving rig configs keep
+        their meaning, but the linger loop itself is gone — the former
+        is the only place that decides wait-vs-solve."""
+        return self.pipeline.former.deadline_s
+
+    @accumulate_s.setter
+    def accumulate_s(self, value: float) -> None:
+        self.pipeline.former.deadline_s = max(float(value), 0.0)
 
     # -- queue feed (the reflector-handler analogue) ---------------------
 
@@ -123,7 +165,27 @@ class Scheduler:
 
     def enqueue(self, pod: api.Pod) -> None:
         if self.responsible_for(pod) and not pod.node_name:
+            # Admission timestamp for the e2e decision-latency SLO
+            # (first-seen -> bind ack): the registry keeps the EARLIEST
+            # admission per key, so requeues and watch redeliveries
+            # (fresh objects) never reset the clock; the object carries
+            # a copy for the bind path.
+            pod._kt_first_seen = self._first_seen.setdefault(
+                pod.key, time.perf_counter())
+            if len(self._first_seen) > 65536:
+                self._prune_first_seen()
             self.queue.add(pod)
+
+    def _prune_first_seen(self) -> None:
+        """Drop registry entries for pods no longer anywhere in flight
+        (deleted while pending): keep keys still queued, in backoff, or
+        assumed — everything else bound (cleared at ack) or vanished."""
+        cache = self.config.algorithm.cache
+        with self._requeue_cv:
+            backoff = {pod.key for _, _, pod in self._requeue_heap}
+        self._first_seen = {
+            k: t for k, t in self._first_seen.items()
+            if k in backoff or k in self.queue or cache.contains(k)}
 
     # -- one-pod path (scheduleOne, scheduler.go:93-154) -----------------
 
@@ -199,160 +261,14 @@ class Scheduler:
     # unwarmed shapes mid-run.
     STREAM_MIN_BUCKET = 256
 
-    # Arrival-coalescing window (seconds): when a drain pops fewer pods
-    # than one stream chunk while more are clearly arriving, linger up to
-    # this long topping the batch up.  A trickle-fed drain otherwise pays
-    # a full padded chunk scan (plus ~250 ms launch overhead on a
-    # tunneled chip) for every fragment of the arrival race.  0 = off
-    # (the default: interactive paths keep their latency).
-    accumulate_s: float = 0.0
-
     def schedule_pending(self, wait_first: bool = True,
                          timeout: Optional[float] = None) -> int:
-        """Drain the queue and solve it as one device batch.  Returns the
-        number of pods popped (scheduled or failed)."""
-        t_wait = time.perf_counter()
-        degraded = self.queue.degraded()
-        if degraded:
-            # Load shedding: drain exactly one largest-warmed-bucket
-            # chunk — the storm's backlog stays in the queue (O(1) per
-            # pod) instead of becoming one unbounded batch's worth of
-            # [P, N] solve planes, and each iteration hits a pre-traced
-            # shape.  Slower decisions, bounded memory.
-            metrics_mod.DEGRADED_DRAINS.inc()
-            pods = self.queue.pop_some(self.degraded_drain_cap(),
-                                       wait_first=wait_first,
-                                       timeout=timeout)
-        else:
-            pods = self.queue.pop_all(wait_first=wait_first,
-                                      timeout=timeout)
-        if not pods:
-            return 0
-        chunk = self.stream_chunk_size()
-        if not degraded and self.accumulate_s > 0 and len(pods) < chunk:
-            deadline = time.monotonic() + self.accumulate_s
-            idle_polls = 0
-            while len(pods) < chunk and idle_polls < 3 and \
-                    time.monotonic() < deadline:
-                time.sleep(0.02)
-                more = self.queue.pop_all(wait_first=False)
-                idle_polls = 0 if more else idle_polls + 1
-                pods.extend(more)
-        # The batch root span is backdated to cover the wait: queue_wait
-        # (blocking pop + arrival coalescing) is the pipeline's first
-        # stage, even though the batch only existed at its end.
-        root = trace_mod.begin_span("schedule_batch", start=t_wait,
-                                    pods=len(pods))
-        trace_mod.record_stage("queue_wait", start=t_wait,
-                               pods=len(pods))
-        self.config.metrics.batch_size.set(len(pods))
-        tr = Trace(f"Scheduling batch of {len(pods)} pods")
-        tr.start = t_wait
-        tr.step("Queue drained")
-        try:
-            return self._solve_drain(pods, tr=tr, trace_id=root.trace_id)
-        except Exception:  # noqa: BLE001 — HandleCrash analogue
-            # The pods were already popped: requeue each through the
-            # backoff path (condition + event + delayed retry) so a
-            # crashing drain can't silently strand them Pending, and a
-            # poison pod retries at most once per 60 s.  A daemon that
-            # was stopped/abandoned mid-drain does NOT requeue: the pods
-            # belong to the next incarnation (its startup reconciliation
-            # relists them), and a dead daemon writing conditions or
-            # requeue events would race the replacement's binds.
-            if self._stop.is_set():
-                log.info("drain interrupted by shutdown; %d pods left "
-                         "to the next incarnation", len(pods))
-                return len(pods)
-            log.exception("drain of %d pods crashed; requeueing", len(pods))
-            cache = self.config.algorithm.cache
-            for pod in pods:
-                # Skip pods the crash didn't strand: anything tracked in
-                # the cache (assumed by a completed chunk, or already
-                # confirmed bound by the watch) made it through.
-                if not cache.contains(pod.key):
-                    self._handle_failure(pod, "SchedulingError",
-                                         "internal error during scheduling",
-                                         result="error")
-            return len(pods)
-        finally:
-            root.end()
-            # The reference's 20 ms slow-log (generic_scheduler.go:79-85),
-            # now fed by the batched drain too; a slow batch also records
-            # as a span with the step breakdown.
-            tr.log_if_long()
-
-    def _solve_drain(self, pods: list, tr: Optional[Trace] = None,
-                     trace_id: str = "") -> int:
-        from kubernetes_tpu.engine.workloads import gang as gang_mod
-        from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATE
-        joint = DEFAULT_FEATURE_GATE.enabled("JointSolver")
-        # Gangs must be admitted all-or-nothing over ONE assignment
-        # vector — a chunked stream could split a gang across chunk
-        # boundaries, so gang batches take the one-shot solve (padded to
-        # a warm bucket below).
-        gangs = DEFAULT_FEATURE_GATE.enabled("GangScheduling") and \
-            gang_mod.batch_has_gangs(pods)
-        # The joint solve needs the whole queue at once (prices couple
-        # every pod); it supersedes the streaming split.
-        streaming = DEFAULT_FEATURE_GATE.enabled("StreamingDrain") \
-            and not joint and not gangs
-        if streaming and len(pods) >= self.STREAM_THRESHOLD and \
-                not self.config.algorithm.extenders:
-            return self._schedule_pending_stream(pods, trace_id=trace_id)
-        if streaming and len(pods) < self._PAD_LIMIT and \
-                not self.config.algorithm.extenders:
-            # Small drain: one power-of-two stream chunk (live-flag
-            # padded), so arrival races don't mint a new compiled shape
-            # per queue length; floored so the tail of the ladder doesn't
-            # either.
-            bucket = max(1 << (len(pods) - 1).bit_length(),
-                         self.stream_min_bucket)
-            return self._schedule_pending_stream(pods, chunk_size=bucket,
-                                                 trace_id=trace_id)
-        start = time.perf_counter()
-        # Workload-constrained one-shot drains pad to the same bucket
-        # ladder the stream path compiles at, so gang/joint solves hit
-        # pre-warmed shapes instead of minting one per queue length.
-        pad_to = 0
-        if (gangs or joint) and len(pods) < self._PAD_LIMIT and \
-                not self.config.algorithm.extenders:
-            pad_to = max(1 << (len(pods) - 1).bit_length(),
-                         self.stream_min_bucket)
-        placements = self.config.algorithm.schedule_batch(
-            pods, joint=joint, pad_to=pad_to)
-        failure_info: dict[str, tuple[str, str]] = {}
-        if gangs:
-            placements, rejected = gang_mod.reduce_all_or_nothing(
-                pods, placements)
-            for name, info in rejected.items():
-                metrics_mod.GANG_ADMISSIONS.labels(
-                    result="rejected").inc()
-                msg = gang_mod.gang_failure_message(name, info)
-                log.debug("gang rejection: %s", msg)
-                for i in info["members"]:
-                    failure_info[pods[i].key] = (msg, "gang_rejected")
-            admitted = [name for name in gang_mod.gang_groups(pods)
-                        if name not in rejected]
-            for _ in admitted:
-                metrics_mod.GANG_ADMISSIONS.labels(
-                    result="admitted").inc()
-        if tr is not None:
-            tr.step("Computed placements")
-        algo_us = (time.perf_counter() - start) * 1e6 / len(pods)
-        self.config.metrics.scheduling_algorithm_latency.observe_many(
-            algo_us, len(pods))
-        if log.isEnabledFor(10):  # V(2)-style guard (predicates.go:478)
-            placed_n = sum(1 for d in placements if d is not None)
-            log.debug("drained %d pods: %d placed, %.0f us/pod algorithm",
-                      len(pods), placed_n, algo_us)
-        self._record_batch_decisions(pods, placements, trace_id,
-                                     time.perf_counter() - start)
-        self._assume_and_bind_batch(pods, placements, start,
-                                    failure_info=failure_info)
-        if tr is not None:
-            tr.step("Assumed and dispatched binds")
-        return len(pods)
+        """Drain the queue through the pipeline (form -> solve ->
+        commit; scheduler/pipeline.py).  Returns the number of pods
+        popped (scheduled or failed).  This is the ONLY batched drain
+        entry path — one-shot, streamed, and joint are solve modes the
+        pipeline routes internally, not separate control flows."""
+        return self.pipeline.drain(wait_first=wait_first, timeout=timeout)
 
     def _record_batch_decisions(self, pods: list, placements: list,
                                 trace_id: str, duration_s: float) -> None:
@@ -602,6 +518,26 @@ class Scheduler:
             return {}
         ladder = self.effective_ladder()
         timings: dict[int, float] = {}
+        # Warm-start audit: per-bucket persistent-compile-cache traffic.
+        # A bucket whose trace shows misses on a supposedly-warm start is
+        # a signature dodging the cache — exactly the 3-4 s "warm" tail
+        # ROADMAP item 3 chases.  (The counters ride JAX monitoring
+        # events, engine/compile_cache; zero/zero means the executable
+        # was already live in process memory.)
+        cache_stats: dict = {}
+
+        def audited(key, fn):
+            h0 = metrics_mod.COMPILE_CACHE_HITS.value
+            m0 = metrics_mod.COMPILE_CACHE_MISSES.value
+            t0 = time.perf_counter()
+            fn()
+            dt = time.perf_counter() - t0
+            cache_stats[key] = {
+                "hits": metrics_mod.COMPILE_CACHE_HITS.value - h0,
+                "misses": metrics_mod.COMPILE_CACHE_MISSES.value - m0,
+                "seconds": round(dt, 3)}
+            return dt
+
         for bucket in ladder:
             want = 2 * bucket  # both scan signatures (no-carry + carry)
             if sample_pods:
@@ -610,19 +546,40 @@ class Scheduler:
                 pods = []
             pods += [api.Pod(name=f"__warm-{i}", namespace="__warm__")
                      for i in range(want - len(pods))]
-            t0 = time.perf_counter()
-            for _ in alg.schedule_batch_stream(pods, chunk_size=bucket):
+
+            def run_bucket(pods=pods, bucket=bucket):
+                for _ in alg.schedule_batch_stream(pods,
+                                                   chunk_size=bucket):
+                    pass
+
+            timings[bucket] = audited(bucket, run_bucket)
+        # The single-pod decision path (schedule_one / the recovery
+        # parity probes): evaluate/masks/select_hosts at P=1 are NOT the
+        # scan's signatures, so without this trace the first interactive
+        # decision after every start paid ~30 compiles on the clock —
+        # a measured 0.3-0.7 s warm-start tail the ladder never covered.
+        def run_single():
+            try:
+                alg.schedule(api.Pod(name="__warm-one",
+                                     namespace="__warm__"))
+            except Exception:  # noqa: BLE001 — FitError etc. still traced
                 pass
-            timings[bucket] = time.perf_counter() - t0
+
+        audited("single_pod", run_single)
+        # The dirty-row scatter kernel compiles per pow2 dirty-row count;
+        # untraced, the first drain after any assume paid it mid-drain.
+        audited("scatter", lambda: alg.resident.prewarm_scatter())
         # Workload-subsystem signatures warm separately (string-keyed on
         # the daemon, not in the int-keyed bucket dict callers inspect).
         self.workloads_prewarm_s = self._prewarm_workloads(ladder)
+        self.prewarm_cache_stats = cache_stats
         log.info("pre-warmed stream ladder %s (floor %d, chunk %d): %s "
-                 "workloads=%s",
+                 "workloads=%s cache=%s",
                  ladder, self.stream_min_bucket, self.stream_chunk_size(),
                  {b: f"{s:.2f}s" for b, s in timings.items()},
                  {k: f"{s:.2f}s"
-                  for k, s in self.workloads_prewarm_s.items()})
+                  for k, s in self.workloads_prewarm_s.items()},
+                 cache_stats)
         return timings
 
     def _prewarm_workloads(self, ladder: list[int]) -> dict:
@@ -670,91 +627,6 @@ class Scheduler:
                           "drain will compile on the clock")
         return timings
 
-    def _schedule_pending_stream(self, pods: list[api.Pod],
-                                 chunk_size: Optional[int] = None,
-                                 trace_id: str = "") -> int:
-        """The overlapped drain: while the device scans chunk N, chunk
-        N-1's readback/assume/bind runs on a single commit worker, with at
-        most ``pipeline_window`` chunks in flight uncommitted.  The one
-        worker keeps chunks committing in solve order, and within a chunk
-        assume completes before its bind fan-out dispatches — the per-pod
-        assume-before-bind ordering of the one-shot path.  Commits are
-        joined before returning, so the caller-observable state machine
-        (every popped pod assumed-or-failed by return) is unchanged."""
-        start = time.perf_counter()
-        window = max(self.pipeline_window, 0)
-        chunk = chunk_size or self.stream_chunk_size()
-        if window == 0:
-            solve_done = start
-            for chunk_pods, placements in \
-                    self.config.algorithm.schedule_batch_stream(
-                        pods, chunk_size=chunk):
-                solve_done = time.perf_counter()
-                self._record_batch_decisions(chunk_pods, placements,
-                                             trace_id, solve_done - start)
-                self._assume_and_bind_batch(chunk_pods, placements, start)
-        else:
-            if self._commit_pool is None:
-                from concurrent.futures import ThreadPoolExecutor
-                self._commit_pool = ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="chunk-commit")
-            sem = threading.BoundedSemaphore(window)
-            ctx = trace_mod.current_context()
-            # A mutable cell: the commit worker stamps when each chunk's
-            # readback landed; the last stamp bounds algorithm latency.
-            solve_done_cell = [start]
-            futures = []
-            err = None
-            try:
-                for _, resolve in \
-                        self.config.algorithm.schedule_batch_stream(
-                            pods, chunk_size=chunk, defer_readback=True):
-                    # Bounded in-flight window: block the drain thread
-                    # (and with it further device launches) until an
-                    # outstanding chunk commits.
-                    sem.acquire()
-                    futures.append(self._commit_pool.submit(
-                        self._commit_chunk, resolve, start, trace_id, sem,
-                        ctx, solve_done_cell))
-            finally:
-                # Join EVERY submitted commit before surfacing anything:
-                # schedule_pending's crash handler requeues pods not yet
-                # assumed, and a still-running commit assuming them
-                # concurrently would double-track the pod.
-                for fut in futures:
-                    try:
-                        fut.result()
-                    except Exception as exc:  # noqa: BLE001 — requeue
-                        err = err or exc
-            if err is not None:
-                # Surface the first commit failure to schedule_pending's
-                # crash handler, which requeues every pod the completed
-                # commits didn't assume.
-                raise err
-            solve_done = solve_done_cell[0]
-        # Algorithm latency spans until the LAST chunk's results landed
-        # (interleaved assume/bind of earlier chunks overlaps the device
-        # and is deliberately excluded, matching the one-shot path).
-        algo_us = (solve_done - start) * 1e6 / len(pods)
-        self.config.metrics.scheduling_algorithm_latency.observe_many(
-            algo_us, len(pods))
-        return len(pods)
-
-    def _commit_chunk(self, resolve, start: float, trace_id: str, sem,
-                      trace_ctx, solve_done_cell: list) -> None:
-        """One chunk's commit on the pipeline worker: blocking readback,
-        flight-recorder feed, bulk assume, bind dispatch."""
-        try:
-            with trace_mod.use_context(trace_ctx):
-                chunk_pods, placements = resolve()
-                solve_done_cell[0] = time.perf_counter()
-                self._record_batch_decisions(
-                    chunk_pods, placements, trace_id,
-                    solve_done_cell[0] - start)
-                self._assume_and_bind_batch(chunk_pods, placements, start)
-        finally:
-            sem.release()
-
     # -- run loops --------------------------------------------------------
 
     def run(self, batched: bool = True) -> threading.Thread:
@@ -782,8 +654,7 @@ class Scheduler:
     def stop(self) -> None:
         self._stop.set()
         self.queue.close()
-        if self._commit_pool is not None:
-            self._commit_pool.shutdown(wait=True)
+        self.pipeline.shutdown(wait=True)
         for t in self._bind_threads:
             t.join(timeout=5)
         # Graceful shutdown persists the decision ring (KT_FLIGHT_DIR) so
@@ -808,8 +679,7 @@ class Scheduler:
         left unbound and adopts anything that did land."""
         self._stop.set()
         self.queue.close()
-        if self._commit_pool is not None:
-            self._commit_pool.shutdown(wait=False, cancel_futures=True)
+        self.pipeline.shutdown(cancel=True)
 
     def wait_for_binds(self) -> None:
         for t in list(self._bind_threads):
@@ -861,10 +731,15 @@ class Scheduler:
                                  f"Binding rejected: {err}",
                                  result=result)
             return
-        us = (time.perf_counter() - bind_start) * 1e6
-        self.config.metrics.binding_latency.observe(us)
+        now = time.perf_counter()
+        self.config.metrics.binding_latency.observe(
+            (now - bind_start) * 1e6)
         self.config.metrics.e2e_scheduling_latency.observe(
-            (time.perf_counter() - start) * 1e6)
+            (now - start) * 1e6)
+        seen = first_seen(pod)
+        if seen is not None:
+            metrics_mod.E2E_DECISION_LATENCY.observe((now - seen) * 1e6)
+        self._first_seen.pop(pod.key, None)
         self.config.metrics.scheduling_attempts.labels(
             result="scheduled").inc()
         self.config.recorder.eventf(
@@ -890,6 +765,7 @@ class Scheduler:
         recorder = self.config.recorder
         bind_start = time.perf_counter()
         bind_many = getattr(self.config.binder, "bind_many", None)
+        bound_pods: list[api.Pod] = []
         if bind_many is not None:
             failed = {pod.key: err for pod, err in bind_many(placed)}
             ok = 0
@@ -906,6 +782,7 @@ class Scheduler:
                         result=result)
                 else:
                     ok += 1
+                    bound_pods.append(pod)
                     items.append((pod.key, "Normal", "Scheduled",
                                   f"Successfully assigned {pod.name} to {dest}"))
             recorder.eventf_many(items)
@@ -922,6 +799,7 @@ class Scheduler:
                                          result=result)
                     continue
                 ok += 1
+                bound_pods.append(pod)
                 recorder.eventf(
                     pod.key, "Normal", "Scheduled",
                     f"Successfully assigned {pod.name} to {dest}")
@@ -930,6 +808,15 @@ class Scheduler:
             (done - bind_start) * 1e6 / max(len(placed), 1), ok)
         self.config.metrics.e2e_scheduling_latency.observe_many(
             (done - start) * 1e6, ok)
+        # The serving SLO number: per-pod first-seen -> bind ack (NOT
+        # amortized — every pod carries its own admission stamp, so the
+        # histogram captures the real tail the deadline trades against).
+        for pod in bound_pods:
+            seen = first_seen(pod)
+            if seen is not None:
+                metrics_mod.E2E_DECISION_LATENCY.observe(
+                    (done - seen) * 1e6)
+            self._first_seen.pop(pod.key, None)
         if ok:
             self.config.metrics.scheduling_attempts.labels(
                 result="scheduled").inc(ok)

@@ -432,6 +432,27 @@ DEGRADED_DRAINS = register(Counter(
     "scheduler_degraded_drains_total",
     "Drains executed in degraded (load-shedding) mode because the "
     "pending queue crossed its high watermark"))
+# Serving path (scheduler/batchformer.py + scheduler/pipeline.py): the
+# per-decision latency SLO surface.  The e2e decision histogram is the
+# number a latency SLO is declared against — first-seen (enqueue) to
+# bind ack, spanning batch formation, the solve, and the bind wire
+# round-trip, across requeues.
+E2E_DECISION_LATENCY = register(Histogram(
+    "scheduler_e2e_decision_latency_microseconds",
+    "Per-pod decision latency from the pod first entering the "
+    "scheduling queue to its bind acknowledgement (the serving SLO "
+    "number; spans batch formation, solve, and bind, across requeues)",
+    exponential_buckets(1000, 2, 18)))
+BATCH_FORMATION_LATENCY = register(Histogram(
+    "scheduler_batch_formation_latency_microseconds",
+    "Wall time the batch former spent assembling each drained batch "
+    "(first pod popped to hand-off at the solve)",
+    exponential_buckets(100, 2, 18)))
+BATCH_DEADLINE_MISSES = register(Counter(
+    "scheduler_batch_deadline_misses_total",
+    "Batches the former handed off later than its formation deadline "
+    "(KT_BATCH_DEADLINE_MS) plus the 25% grace — formation overran the "
+    "latency budget instead of choosing to wait"))
 # Bind path (scheduler/scheduler.py).
 BIND_CONFLICTS = register(Counter(
     "scheduler_bind_conflicts_total",

@@ -140,3 +140,53 @@ def test_soak_settle_regression_beyond_tolerance_fails():
 
 def test_soak_green_artifact_passes_alone():
     assert cb.check_soak([("SOAK_r07.json", _soak())]) == []
+
+
+# -- SERVING artifact ratchet (ISSUE 8) --------------------------------------
+
+def _serving(trickle_p99=150.0, trickle_att=99.8, trickle_floor=99.0,
+             burst_p99=900.0, burst_att=99.0, burst_floor=95.0):
+    def row(p99, att, floor, slo):
+        return {"latency_ms": {"p50": p99 / 2, "p99": p99},
+                "slo": {"slo_ms": slo, "attainment_pct": att,
+                        "attainment_floor_pct": floor}}
+    return {"deadline_ms": 100.0,
+            "workloads": {
+                "poisson_trickle": row(trickle_p99, trickle_att,
+                                       trickle_floor, 1000.0),
+                "burst_replay": row(burst_p99, burst_att, burst_floor,
+                                    5000.0)}}
+
+
+def test_repo_serving_artifacts_pass_the_ratchet():
+    problems = cb.check_serving()
+    assert problems == [], problems
+
+
+def test_serving_attainment_below_recorded_floor_fails():
+    problems = cb.check_serving(
+        [("SERVING_r08.json", _serving(trickle_att=97.0))])
+    assert len(problems) == 1 and "below its recorded floor" in problems[0]
+    # The floor is per-row: a burst-row miss fails too.
+    problems = cb.check_serving(
+        [("SERVING_r08.json", _serving(burst_att=90.0))])
+    assert len(problems) == 1 and "burst_replay" in problems[0]
+
+
+def test_serving_p99_regression_beyond_tolerance_fails():
+    arts = [("SERVING_r08.json", _serving(trickle_p99=100.0)),
+            ("SERVING_r09.json", _serving(trickle_p99=130.0))]
+    problems = cb.check_serving(arts)
+    assert len(problems) == 1 and "p99 regressed" in problems[0]
+    # Inside the noise band, and improvements, pass.
+    assert cb.check_serving(
+        [("SERVING_r08.json", _serving(trickle_p99=100.0)),
+         ("SERVING_r09.json", _serving(trickle_p99=110.0))]) == []
+    assert cb.check_serving(
+        [("SERVING_r08.json", _serving(trickle_p99=100.0)),
+         ("SERVING_r09.json", _serving(trickle_p99=60.0))]) == []
+
+
+def test_serving_green_artifact_passes_alone():
+    assert cb.check_serving([("SERVING_r08.json", _serving())]) == []
+    assert cb.check_serving([]) == []

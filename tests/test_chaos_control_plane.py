@@ -502,6 +502,44 @@ def test_infeasible_gang_never_partially_binds_under_chaos(rig_factory):
     assert "scheduler_gang_admissions_total" in exposed
 
 
+def test_serving_bursts_converge_during_bind_conflict_storm(rig_factory):
+    """ISSUE 8 satellite: arrival BURSTS land while every Nth bind 409s,
+    with deadline micro-batching on (the batch former lingering up to
+    its budget per drain).  The former must keep forming batches from
+    the mixed stream of fresh arrivals and conflict requeues — nothing
+    strands, every pod from every burst ends bound, and the deadline
+    misses stay observable rather than becoming lost pods."""
+    from kubernetes_tpu.utils import featuregate
+    before = metrics.BIND_CONFLICTS.value
+    # BatchBindings off: one POST per bind, so the every_nth cadence
+    # actually bites inside each burst's bind fan-out.
+    old_gate = featuregate.DEFAULT_FEATURE_GATE
+    featuregate.set_default(
+        featuregate.FeatureGate({"BatchBindings": False}))
+    try:
+        rig = rig_factory(rules=[
+            {"fault": "error", "method": "POST", "path": "/bindings",
+             "status": 409, "every_nth": 3, "count": 5}], nodes=6)
+        rig.factory.daemon.pipeline.former.deadline_s = 0.05
+        names = []
+        for wave in range(3):
+            for i in range(8):
+                name = f"burst{wave}-{i}"
+                rig.direct.create("pods", _pod_json(name))
+                names.append(name)
+            time.sleep(0.08)  # next burst lands mid-formation/mid-storm
+        bound = rig.wait_bound(names)
+        assert set(bound) == set(names) and all(bound.values())
+        rig.assert_daemon_alive()
+        assert metrics.BIND_CONFLICTS.value > before
+        # The serving surface stayed observable through the storm.
+        exposed = rig.factory.daemon.config.metrics.expose()
+        assert "scheduler_batch_formation_latency_microseconds" in exposed
+        assert "scheduler_e2e_decision_latency_microseconds" in exposed
+    finally:
+        featuregate.set_default(old_gate)
+
+
 # -- leader election under latency ------------------------------------------
 
 def test_leader_failover_under_injected_latency():

@@ -135,3 +135,81 @@ def test_joint_warm_start_reuses_persistent_compile_cache(tmp_path,
         compile_cache._reset_for_tests()
         monkeypatch.delenv("KT_COMPILE_CACHE", raising=False)
         compile_cache.configure()
+
+
+def test_prewarm_covers_the_single_pod_path_and_scatter(tmp_path,
+                                                        monkeypatch):
+    """ISSUE 8 warm-start audit: after ``prewarm()`` NO post-warm-up
+    decision path may mint a fresh XLA compile on the clock.  Measured
+    before the fix, the single-pod path (evaluate/masks/select_hosts at
+    P=1 — the first ``schedule_one`` and every recovery parity probe)
+    paid ~30 compiles (~0.7 s cold), and the dirty-row scatter kernel
+    compiled mid-drain on the first post-assume drain; both signatures
+    dodged the ladder prewarm entirely.  Cold-vs-warm pin: a restart
+    analogue (``jax.clear_caches``) re-traces everything prewarm traced
+    out of the persistent cache — hits only, zero misses."""
+    import jax
+
+    from kubernetes_tpu.engine import compile_cache
+    from kubernetes_tpu.perf import synth
+    from kubernetes_tpu.scheduler.binder import InMemoryBinder
+    from kubernetes_tpu.scheduler.scheduler import (Scheduler,
+                                                    SchedulerConfig)
+    from kubernetes_tpu.utils.metrics import (COMPILE_CACHE_HITS,
+                                              COMPILE_CACHE_MISSES)
+
+    monkeypatch.setenv("KT_COMPILE_CACHE", str(tmp_path))
+    compile_cache._reset_for_tests()
+    try:
+        assert compile_cache.configure() == str(tmp_path)
+
+        def build() -> Scheduler:
+            sched, _ = synth.make_rig(16, 0)
+            d = Scheduler(SchedulerConfig(algorithm=sched,
+                                          binder=InMemoryBinder(),
+                                          async_bind=False))
+            d.STREAM_THRESHOLD = 16
+            d.stream_chunk = 16
+            d.stream_min_bucket = 8
+            return d
+
+        # Drop executables earlier tests left in process memory: the
+        # cold pass must actually compile (and persist) into THIS cache
+        # dir for the warm half of the pin to mean anything.
+        jax.clear_caches()
+        daemon = build()
+        timings = daemon.prewarm()
+        assert timings  # the ladder traced
+        # The audit's per-signature cache stats cover the ladder AND the
+        # single-pod + scatter signatures the ladder used to miss.
+        stats = daemon.prewarm_cache_stats
+        assert "single_pod" in stats and "scatter" in stats
+        assert all(b in stats for b in timings)
+        # Post-prewarm, the previously-dodging paths compile NOTHING on
+        # the clock: a schedule_one and a dirtying drain are all cache
+        # hits already live in memory.
+        misses0 = COMPILE_CACHE_MISSES.value
+        daemon.enqueue(synth.make_pods(1, name_prefix="sp")[0])
+        assert daemon.schedule_one(timeout=0.1)
+        for p in synth.make_pods(12, name_prefix="dirty"):
+            daemon.enqueue(p)
+        daemon.schedule_pending(wait_first=False)  # scatters dirty rows
+        daemon.wait_for_binds()
+        assert COMPILE_CACHE_MISSES.value == misses0, \
+            "a post-prewarm decision path still compiles on the clock"
+        # Cold vs warm: a fresh-executable re-trace (restart analogue)
+        # deserializes every prewarmed signature from the persistent
+        # cache instead of recompiling.
+        jax.clear_caches()
+        hits0, misses0 = COMPILE_CACHE_HITS.value, \
+            COMPILE_CACHE_MISSES.value
+        daemon2 = build()
+        daemon2.prewarm()
+        assert COMPILE_CACHE_HITS.value > hits0
+        assert COMPILE_CACHE_MISSES.value == misses0, \
+            "warm prewarm recompiled instead of hitting the persistent " \
+            "cache"
+    finally:
+        compile_cache._reset_for_tests()
+        monkeypatch.delenv("KT_COMPILE_CACHE", raising=False)
+        compile_cache.configure()

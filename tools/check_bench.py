@@ -73,12 +73,13 @@ def committed_artifacts() -> list[tuple[str, dict]]:
     return [(name, parsed) for _, name, parsed in found]
 
 
-def _committed_workloads_names() -> set[str] | None:
-    """WORKLOADS artifacts tracked at git HEAD (None when git is
-    unavailable).  The BENCH helper (sync_bench_docs) pattern-filters to
-    BENCH_r*.json, so the workloads ratchet needs its own ls-tree pass —
-    reusing it would silently exclude every WORKLOADS artifact and turn
-    check_workloads into dead code."""
+def _committed_family_names(prefix: str) -> set[str] | None:
+    """``{prefix}_r{N}.json`` artifacts tracked at git HEAD (None when
+    git is unavailable) — ONE implementation of the committed-at-HEAD
+    rule for every non-BENCH artifact family (WORKLOADS/SOAK/SERVING).
+    The BENCH helper stays in sync_bench_docs (shared with the docs
+    ratchet), and pattern-filters to BENCH_r*.json — which is why the
+    other families need this pass at all."""
     import subprocess
     try:
         out = subprocess.run(
@@ -89,18 +90,17 @@ def _committed_workloads_names() -> set[str] | None:
     if out.returncode != 0:
         return None
     return {n for n in out.stdout.splitlines()
-            if re.fullmatch(r"WORKLOADS_r\d+\.json", n)}
+            if re.fullmatch(prefix + r"_r\d+\.json", n)}
 
 
-def committed_workloads_artifacts() -> list[tuple[str, dict]]:
-    """[(name, payload)] for committed WORKLOADS_r{N}.json artifacts
-    (the workloads subsystem's quality/parity/gang rows, emitted by
-    bench.py), ascending by round number.  Same committed-at-HEAD rule
-    as the BENCH artifacts."""
-    committed = _committed_workloads_names()
+def _committed_family_artifacts(prefix: str, validator) -> \
+        list[tuple[str, dict]]:
+    """[(name, payload)] for committed ``{prefix}_r{N}.json`` artifacts
+    whose payload satisfies ``validator``, ascending by round number."""
+    committed = _committed_family_names(prefix)
     found: list[tuple[int, str, dict]] = []
     for name in os.listdir(REPO):
-        m = re.fullmatch(r"WORKLOADS_r(\d+)\.json", name)
+        m = re.fullmatch(prefix + r"_r(\d+)\.json", name)
         if not m:
             continue
         if committed is not None and name not in committed:
@@ -110,10 +110,17 @@ def committed_workloads_artifacts() -> list[tuple[str, dict]]:
                 data = json.load(f)
         except (OSError, ValueError):
             continue
-        if data.get("joint_quality"):
+        if validator(data):
             found.append((int(m.group(1)), name, data))
     found.sort()
     return [(name, data) for _, name, data in found]
+
+
+def committed_workloads_artifacts() -> list[tuple[str, dict]]:
+    """Committed WORKLOADS_r{N}.json artifacts (the workloads
+    subsystem's quality/parity/gang rows, emitted by bench.py)."""
+    return _committed_family_artifacts(
+        "WORKLOADS", lambda d: bool(d.get("joint_quality")))
 
 
 def quality_row(payload: dict) -> float | None:
@@ -151,44 +158,11 @@ def check_workloads(artifacts: list[tuple[str, dict]] | None = None,
     return problems
 
 
-def _committed_soak_names() -> set[str] | None:
-    """SOAK artifacts tracked at git HEAD (None when git is
-    unavailable) — the same committed-at-HEAD rule as the WORKLOADS
-    ratchet, and a separate ls-tree pass for the same reason."""
-    import subprocess
-    try:
-        out = subprocess.run(
-            ["git", "-C", REPO, "ls-tree", "-r", "--name-only", "HEAD"],
-            capture_output=True, text=True, timeout=10)
-    except (OSError, subprocess.SubprocessError):
-        return None
-    if out.returncode != 0:
-        return None
-    return {n for n in out.stdout.splitlines()
-            if re.fullmatch(r"SOAK_r\d+\.json", n)}
-
-
 def committed_soak_artifacts() -> list[tuple[str, dict]]:
-    """[(name, payload)] for committed SOAK_r{N}.json artifacts (the
-    churn-soak robustness rows emitted by perf/soak.py), ascending by
-    round number."""
-    committed = _committed_soak_names()
-    found: list[tuple[int, str, dict]] = []
-    for name in os.listdir(REPO):
-        m = re.fullmatch(r"SOAK_r(\d+)\.json", name)
-        if not m:
-            continue
-        if committed is not None and name not in committed:
-            continue
-        try:
-            with open(os.path.join(REPO, name)) as f:
-                data = json.load(f)
-        except (OSError, ValueError):
-            continue
-        if "invariant_violations" in data:
-            found.append((int(m.group(1)), name, data))
-    found.sort()
-    return [(name, data) for _, name, data in found]
+    """Committed SOAK_r{N}.json artifacts (the churn-soak robustness
+    rows emitted by perf/soak.py)."""
+    return _committed_family_artifacts(
+        "SOAK", lambda d: "invariant_violations" in d)
 
 
 def check_soak(artifacts: list[tuple[str, dict]] | None = None,
@@ -242,6 +216,54 @@ def check_soak(artifacts: list[tuple[str, dict]] | None = None,
                 f"soak settle regressed: {new_name} {new_settle}s vs "
                 f"{prev_name} {prev_settle}s (tolerance "
                 f"{tolerance * 100:.0f}%)")
+    return problems
+
+
+def committed_serving_artifacts() -> list[tuple[str, dict]]:
+    """Committed SERVING_r{N}.json artifacts (the serving-path latency
+    rows emitted by perf/serving.py)."""
+    return _committed_family_artifacts(
+        "SERVING", lambda d: bool(d.get("workloads")))
+
+
+def check_serving(artifacts: list[tuple[str, dict]] | None = None,
+                  tolerance: float = TOLERANCE) -> list[str]:
+    """Problems with the newest SERVING artifact: any workload row whose
+    SLO attainment sits below its own recorded floor (an absolute
+    invariant — the artifact declares the floor it must meet), or (vs
+    the predecessor) a per-row p99 submit->bind regression beyond
+    ``tolerance``.  The serving rows are the latency ratchet next to the
+    throughput ones: the pipeline unification must never quietly trade
+    tail latency back."""
+    if artifacts is None:
+        artifacts = committed_serving_artifacts()
+    problems: list[str] = []
+    if not artifacts:
+        return problems
+    new_name, new = artifacts[-1]
+    for row_name, row in (new.get("workloads") or {}).items():
+        slo = row.get("slo") or {}
+        floor = slo.get("attainment_floor_pct")
+        got = slo.get("attainment_pct")
+        if floor is not None and got is not None and \
+                float(got) < float(floor):
+            problems.append(
+                f"{new_name}: {row_name} SLO attainment {got}% fell "
+                f"below its recorded floor {floor}% "
+                f"(slo {slo.get('slo_ms')}ms)")
+    if len(artifacts) >= 2:
+        prev_name, prev = artifacts[-2]
+        for row_name, row in (new.get("workloads") or {}).items():
+            prev_row = (prev.get("workloads") or {}).get(row_name) or {}
+            prev_p99 = (prev_row.get("latency_ms") or {}).get("p99")
+            new_p99 = (row.get("latency_ms") or {}).get("p99")
+            if prev_p99 and new_p99 and \
+                    float(new_p99) > float(prev_p99) * (1.0 + tolerance):
+                problems.append(
+                    f"serving p99 regressed: {new_name} {row_name} "
+                    f"{new_p99}ms vs {prev_name} {prev_p99}ms "
+                    f"(+{(float(new_p99) / float(prev_p99) - 1) * 100:.0f}"
+                    f"%, tolerance {tolerance * 100:.0f}%)")
     return problems
 
 
@@ -309,6 +331,7 @@ def check(artifacts: list[tuple[str, dict]] | None = None,
 def main() -> int:
     problems = check_workloads()
     problems += check_soak()
+    problems += check_serving()
     artifacts = committed_artifacts()
     if len(artifacts) < 2:
         print("bench ratchet: fewer than two committed BENCH artifacts; "
@@ -333,6 +356,14 @@ def main() -> int:
         print(f"soak ratchet OK: {sk[-1][0]} settle "
               f"{sk[-1][1].get('settle_s')}s, "
               f"{sk[-1][1].get('invariant_violations')} violations")
+    sv = committed_serving_artifacts()
+    if sv:
+        trickle = (sv[-1][1].get("workloads") or {}) \
+            .get("poisson_trickle") or {}
+        print(f"serving ratchet OK: {sv[-1][0]} trickle p99 "
+              f"{(trickle.get('latency_ms') or {}).get('p99')}ms, "
+              f"attainment "
+              f"{(trickle.get('slo') or {}).get('attainment_pct')}%")
     return 0
 
 
