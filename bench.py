@@ -17,8 +17,12 @@ vs_baseline is against the reference's cluster-saturation SLO floor of
 absolute throughput number the reference publishes.
 
 Env knobs (for CPU smoke runs): BENCH_NODES, BENCH_PODS, BENCH_PROFILE.
+``--profile-dir DIR`` (or KT_PROFILE_DIR) wraps every device solve in the
+density and serving phases in a ``jax.profiler`` trace (viewable in
+TensorBoard/XProf); unset, the hook is a zero-overhead no-op.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -70,7 +74,24 @@ def _joint_quality(n_nodes: int = 500, n_pods: int = 6000) -> dict:
     }
 
 
-def main() -> None:
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--profile-dir", default="",
+                   help="write jax.profiler device traces of every solve "
+                        "in the density and serving phases here (also "
+                        "KT_PROFILE_DIR; view with TensorBoard/XProf)")
+    return p
+
+
+def main(argv=None) -> None:
+    opts = build_parser().parse_args(argv)
+    if opts.profile_dir or os.environ.get("KT_PROFILE_DIR"):
+        # Wire utils/profiling.device_trace into every solve the bench
+        # phases run (the engine wraps its solve dispatches in it; the
+        # flag just arms the directory).
+        from kubernetes_tpu.utils.profiling import set_profile_dir
+        set_profile_dir(opts.profile_dir
+                        or os.environ.get("KT_PROFILE_DIR", ""))
     n_nodes = int(os.environ.get("BENCH_NODES", "5000"))
     n_pods = int(os.environ.get("BENCH_PODS", "30000"))
     profile = os.environ.get("BENCH_PROFILE", "mixed")
@@ -120,6 +141,13 @@ def main() -> None:
             wire_all.append(r)
             if wire is None or r.pods_per_second > wire.pods_per_second:
                 wire = r
+
+    # The wire daemons' prewarm armed the recompile watchdog process-
+    # wide; the remaining phases build FRESH rigs whose first compiles
+    # are expected, so disarm — each phase that cares measures its own
+    # window.
+    from kubernetes_tpu.engine import devicestats
+    devicestats.disarm()
 
     # Joint-assignment quality (BASELINE's last config: "global batched
     # assignment ... solved jointly"): on a contended fleet, the
@@ -291,6 +319,12 @@ def main() -> None:
         # actually goes — queue_wait/snapshot/compile/transfer/solve/
         # readback/assume/bind, from the stage histogram.
         "stages": result.stages,
+        # Device telemetry columns (best run): HBM peak, per-cause
+        # transfer bytes-per-pod over the steady-state waves, and the
+        # recompile-watchdog count — ratcheted by tools/check_bench.py
+        # (any post-prewarm compile, or >15% bytes-per-pod growth,
+        # fails tier-1).
+        "device": result.device,
     }
     if cold_vs_warm is not None:
         out["cold_vs_warm"] = cold_vs_warm

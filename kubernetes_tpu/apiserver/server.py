@@ -238,30 +238,25 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                     return
 
         def _send_json(self, code: int, obj) -> None:
+            self._send_raw(code, json.dumps(obj).encode(),
+                           "application/json")
+
+        def _send_raw(self, code: int, body: bytes, ctype: str) -> None:
+            """One response-assembly path for every content type."""
             self._code = code
-            body = json.dumps(obj).encode()
             self.wfile.write(
                 _STATUS_LINES.get(code, _STATUS_LINES[400])
-                + b"Content-Type: application/json\r\nContent-Length: "
+                + b"Content-Type: " + ctype.encode()
+                + b"\r\nContent-Length: "
                 + str(len(body)).encode() + b"\r\n\r\n" + body)
             self.wfile.flush()
 
         def _send_json_bytes(self, code: int, body: bytes) -> None:
             """Pre-serialized JSON body (the trace export)."""
-            self._code = code
-            self.wfile.write(
-                _STATUS_LINES.get(code, _STATUS_LINES[400])
-                + b"Content-Type: application/json\r\nContent-Length: "
-                + str(len(body)).encode() + b"\r\n\r\n" + body)
-            self.wfile.flush()
+            self._send_raw(code, body, "application/json")
 
         def _send_text(self, code: int, body: bytes) -> None:
-            self._code = code
-            self.wfile.write(
-                _STATUS_LINES.get(code, _STATUS_LINES[400])
-                + b"Content-Type: text/plain\r\nContent-Length: "
-                + str(len(body)).encode() + b"\r\n\r\n" + body)
-            self.wfile.flush()
+            self._send_raw(code, body, "text/plain")
 
         def _admit(self, kind: str, body: dict,
                    op: str = "create") -> bool:
@@ -341,6 +336,15 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                 self._send_text(200, b"ok")
                 return True
             if parts == ["metrics"]:
+                if query.get("format", [""])[0] == "openmetrics":
+                    # Exemplar-carrying OpenMetrics rendering.
+                    from kubernetes_tpu.utils.debugmux import \
+                        OPENMETRICS_CTYPE
+                    from kubernetes_tpu.utils.metrics import \
+                        expose_registry_openmetrics
+                    body = expose_registry_openmetrics().encode()
+                    self._send_raw(200, body, OPENMETRICS_CTYPE)
+                    return True
                 # Prometheus text exposition: the default registry carries
                 # the per-verb/resource/code request latencies this server
                 # records plus the shared client/breaker counters.
@@ -352,6 +356,16 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                 # a traceparent header was propagated.
                 self._send_json_bytes(200,
                                       trace_mod.to_chrome_trace().encode())
+                return True
+            if parts == ["debug", "timeseries"]:
+                from kubernetes_tpu.utils import telemetry
+                self._send_json_bytes(
+                    200, telemetry.timeseries_json().encode())
+                return True
+            if parts == ["debug", "dashboard"]:
+                from kubernetes_tpu.utils import telemetry
+                self._send_raw(200, telemetry.dashboard_html().encode(),
+                               "text/html; charset=utf-8")
                 return True
             if len(parts) == 3 and parts[:2] == ["api", "v1"]:
                 kind = parts[2]
@@ -712,6 +726,10 @@ def serve(store: MemStore, port: int = 0,
     name, O -> groups — the x509 request authenticator,
     plugin/pkg/auth/authenticator/request/x509), taking precedence over
     bearer tokens."""
+    # The apiserver self-scrapes like every other daemon: its request-
+    # latency registry lands in the ring /debug/timeseries serves.
+    from kubernetes_tpu.utils import telemetry
+    telemetry.ensure_started()
     server = _Server((host, port),
                      make_handler(store, auth, admission_control))
     if tls_cert:

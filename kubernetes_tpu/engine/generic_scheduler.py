@@ -26,6 +26,7 @@ from kubernetes_tpu.api.policy import (Policy, default_provider,
                                        service_affinity_labels,
                                        service_anti_affinity_labels)
 from kubernetes_tpu.cache.scheduler_cache import SchedulerCache
+from kubernetes_tpu.engine import devicestats
 from kubernetes_tpu.engine import solver as sv
 from kubernetes_tpu.engine.extender_client import (ExtenderError,
                                                    ExtenderUnavailable,
@@ -241,10 +242,11 @@ class GenericScheduler:
         trace = Trace(f"Scheduling {pod.namespace}/{pod.name}")
         if not self.cache.nodes():
             raise FitError(pod, {})
-        batch, db, dc, nt = self._compile([pod])
-        trace.step("Computing predicates & priorities")
-        feasible, scores = self.solver.evaluate(db, dc,
-                                                self._pinned_flags(batch))
+        with devicestats.live_path("single_pod"):
+            batch, db, dc, nt = self._compile([pod])
+            trace.step("Computing predicates & priorities")
+            feasible, scores = self.solver.evaluate(
+                db, dc, self._pinned_flags(batch))
         topo_mask_np = None
         if self._topo_terms is not None:
             from kubernetes_tpu.engine.workloads import topology
@@ -382,7 +384,8 @@ class GenericScheduler:
         self._agg_handoff = None
         from kubernetes_tpu.utils.profiling import device_trace
         if joint:
-            with device_trace("solve_joint"), \
+            with devicestats.live_path("joint"), \
+                    device_trace("solve_joint"), \
                     stage("solve", pods=len(pods), mode="joint"):
                 choices, new_last, _ = self.solver.solve_joint(
                     db, dc, jnp.uint32(self.last_node_index), flags=flags,
@@ -390,14 +393,17 @@ class GenericScheduler:
                     live=live)
                 choices.block_until_ready()
             with stage("readback", pods=len(pods)):
-                rows = np.asarray(choices)[:real_p].tolist()
+                choices_np = np.asarray(choices)
+                devicestats.record_transfer("readback", choices_np.nbytes)
+                rows = choices_np[:real_p].tolist()
             self.last_node_index = np.uint32(new_last)
         else:
             # One packed device->host fetch for the whole drain (each fetch
             # is a full RTT on a tunneled chip): choices + tie counter +
             # final aggregates.
             p, n = len(pods), dc.alloc.shape[0]
-            with device_trace("solve_sequential"), \
+            with devicestats.live_path("oneshot"), \
+                    device_trace("solve_sequential"), \
                     stage("solve", pods=p, mode="sequential"):
                 host_dev = self.solver.solve_sequential_packed(
                     db, dc, jnp.uint32(self.last_node_index), flags,
@@ -408,6 +414,7 @@ class GenericScheduler:
                 host_dev.block_until_ready()
             with stage("readback", pods=p):
                 host = np.asarray(host_dev)
+                devicestats.record_transfer("readback", host.nbytes)
             rows = host[:real_p].tolist()
             self.last_node_index = np.uint32(host[p])
             # Device-aggregate handoff: the scan's final requested/nonzero
@@ -666,6 +673,7 @@ class GenericScheduler:
         def emit(start: int, choices) -> tuple[list, list]:
             with stage("readback", chunk_at=start):
                 rows = np.asarray(choices)  # blocks only on this chunk
+                devicestats.record_transfer("readback", rows.nbytes)
             stop = min(start + chunk_size, p)
             chunk_pods = pods[start:stop]
             placements = [nt.names[int(c)] if c >= 0 else None
@@ -690,7 +698,8 @@ class GenericScheduler:
             # The launch is async: device time surfaces in the next
             # chunk's readback, which is what keeps the pipeline
             # overlapped — this stage measures dispatch only.
-            with device_trace("solve_stream_chunk"), \
+            with devicestats.live_path("stream"), \
+                    device_trace("solve_stream_chunk"), \
                     stage("solve", chunk_at=start, mode="stream"):
                 choices_k, counter, carry = self.solver._solve_scan(
                     db_k, dc, counter, sb_k, flags, carry, live, em_k)

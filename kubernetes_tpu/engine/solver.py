@@ -350,11 +350,14 @@ class ResidentCluster:
         resource-truth fields the dirty-row protocol must keep equal to
         the host arrays.  One gather per field, k rows each — cheap at
         verifier cadence."""
+        from kubernetes_tpu.engine import devicestats
         i = jnp.asarray(np.asarray(idx, np.int32))
-        return {"schedulable": np.asarray(self.dc.schedulable[i]),
-                "alloc": np.asarray(self.dc.alloc[i]),
-                "requested": np.asarray(self.dc.requested[i]),
-                "nonzero": np.asarray(self.dc.nonzero[i])}
+        out = {"schedulable": np.asarray(self.dc.schedulable[i]),
+               "alloc": np.asarray(self.dc.alloc[i]),
+               "requested": np.asarray(self.dc.requested[i]),
+               "nonzero": np.asarray(self.dc.nonzero[i])}
+        devicestats.record_transfer("readback", devicestats.nbytes(out))
+        return out
 
     def _scatter_fn(self):
         if self._scatter is None:
@@ -418,6 +421,7 @@ class ResidentCluster:
         """The current cluster state on device: scatter ``dirty`` rows
         into the resident arrays, or re-upload everything when the
         resident copy cannot be patched (see class docstring)."""
+        from kubernetes_tpu.engine import devicestats
         n = nt.alloc.shape[0]
         sig = self.signature(nt, space)
         if self.dc is None or self._sig != sig or self._epoch != epoch \
@@ -426,6 +430,16 @@ class ResidentCluster:
             self._sig = sig
             self._epoch = epoch
             self.stats["full_syncs"] += 1
+            # Device accounting: the whole-cluster re-snapshot is the
+            # EXPENSIVE transfer the residency protocol exists to avoid
+            # — full_upload bytes dominating steady-state drains is the
+            # regression signature (a silent re-upload where a dirty-row
+            # scatter should have run).  (HBM peak sampling deliberately
+            # NOT here: on backends without memory_stats the fallback
+            # walks every live array — the telemetry scrape cadence
+            # covers it off the drain path.)
+            devicestats.record_transfer("full_upload",
+                                        devicestats.nbytes(self.dc))
             return self.dc
         if not dirty:
             return self.dc
@@ -465,6 +479,9 @@ class ResidentCluster:
         self.dc = self._scatter_fn()(self.dc, idx_d, rows_d)
         self.stats["row_syncs"] += 1
         self.stats["rows_scattered"] += len(dirty)
+        # Only the gathered rows crossed the wire (idx + padded rows).
+        devicestats.record_transfer(
+            "scatter", idx.nbytes + devicestats.nbytes(rows))
         return self.dc
 
 

@@ -41,6 +41,12 @@ class DensityResult:
     # tax; bench.py's cold_vs_warm phase re-measures it in a second
     # process against the populated cache.
     warm_s: float = 0.0
+    # Device-plane accounting (engine/devicestats.py): per-cause
+    # transfer bytes + bytes-per-pod over the steady-state waves, HBM
+    # live/peak, and the recompile-watchdog count over the whole
+    # measured window (timed drain + waves) — the columns the BENCH
+    # artifact carries and tools/check_bench.py ratchets.
+    device: dict = None
 
 
 def _stage_snapshot() -> dict:
@@ -79,33 +85,84 @@ def _make_daemon(num_nodes: int, profile: str = "uniform",
 
 def density(num_nodes: int, num_pods: int, profile: str = "uniform",
             preexisting: int = 0, warm: bool = True,
-            quiet: bool = False) -> DensityResult:
+            quiet: bool = False, steady_waves: int = 3) -> DensityResult:
     """Density test (scheduler_test.go:26-60): N pods onto M nodes, full
-    daemon path, wall-clock throughput."""
+    daemon path, wall-clock throughput.
+
+    After the timed avalanche, ``steady_waves`` smaller follow-up
+    drains run on the SAME rig (each scattering the previous wave's
+    dirty rows into the resident mirror) with the recompile watchdog
+    armed — the steady-state window whose per-cause transfer bytes and
+    compile count the BENCH artifact carries.  A steady-state drain
+    whose full_upload bytes dominate, or that compiles at all, is the
+    residency/prewarm regression the device plane exists to catch."""
+    from kubernetes_tpu.engine import devicestats
     daemon = _make_daemon(num_nodes, profile, preexisting)
     pods = synth.make_pods(num_pods, profile=profile)
+    # Steady-wave size: small enough that a wave's dirty-row set stays
+    # under the scatter threshold (N/4 rows) on the headline shape.
+    # Waves are BEST-EFFORT pods: always placeable even on the fleet
+    # the avalanche just filled (the pods-count aggregate still dirties
+    # their rows, which is all the scatter window needs), so the
+    # failure-explain pass — an unwarmed compile shape — never runs
+    # inside the armed window.
+    from kubernetes_tpu.api import types as api_types
+    wave_n = max(min(num_pods // 40, max(num_nodes // 8, 1)), 1)
+    wave_pods = [api_types.Pod(name=f"steady-{i}",
+                               namespace="__steady__")
+                 for i in range(steady_waves * wave_n)] \
+        if steady_waves > 0 else []
     warm_s = 0.0
+    alg = daemon.config.algorithm
     if warm:
-        # Pre-trace the device program at the batch shape (first XLA compile
-        # is excluded like the reference excludes apiserver warmup).
+        # Pre-trace the device program at the batch shape (first XLA
+        # compile is excluded like the reference excludes apiserver
+        # warmup), routed EXACTLY like the pipeline will route the
+        # drain — the recompile watchdog flagged the old one-shot-only
+        # warm here: small drains stream through a pow2 bucket, and
+        # warming a different path left the real one to compile on the
+        # clock.
+        from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATE
         t_warm = time.perf_counter()
-        alg = daemon.config.algorithm
-        if num_pods >= daemon.STREAM_THRESHOLD and not alg.extenders:
+        streaming = DEFAULT_FEATURE_GATE.enabled("StreamingDrain") \
+            and not alg.extenders
+        if streaming and num_pods >= daemon.STREAM_THRESHOLD:
             for _ in alg.schedule_batch_stream(
                     pods, chunk_size=daemon.stream_chunk_size()):
                 pass
+        elif streaming and num_pods < daemon._PAD_LIMIT:
+            bucket = max(1 << (num_pods - 1).bit_length(),
+                         daemon.stream_min_bucket)
+            for _ in alg.schedule_batch_stream(pods, chunk_size=bucket):
+                pass
         else:
             alg.schedule_batch(pods)
+        if wave_pods:
+            # The steady-wave shape and the dirty-row scatter kernel are
+            # live-path programs too: trace them before the watchdog
+            # arms, exactly like Scheduler.prewarm does.  Waves drain
+            # through the pipeline's small-drain stream path, so warm
+            # the same pow2 bucket it will route them onto.
+            bucket = max(1 << (wave_n - 1).bit_length(),
+                         daemon.stream_min_bucket)
+            for _ in alg.schedule_batch_stream(wave_pods[:wave_n],
+                                               chunk_size=bucket):
+                pass
+            alg.resident.prewarm_scatter()
         warm_s = time.perf_counter() - t_warm
     for pod in pods:
         daemon.enqueue(pod)
     stages_before = _stage_snapshot()
-    start = time.perf_counter()
-    popped = daemon.schedule_pending(wait_first=False)
-    daemon.wait_for_binds()
-    elapsed = time.perf_counter() - start
+    with devicestats.watchdog_window() as compiles:
+        start = time.perf_counter()
+        popped = daemon.schedule_pending(wait_first=False)
+        daemon.wait_for_binds()
+        elapsed = time.perf_counter() - start
+        device = _steady_state_device_window(daemon, wave_pods, wave_n,
+                                             quiet=quiet)
+    device["post_prewarm_compiles"] = compiles()
     stages = stage_breakdown(stages_before, _stage_snapshot())
-    scheduled = daemon.config.binder.count()
+    scheduled = daemon.config.binder.count() - device.pop("_steady_bound")
     if not quiet:
         print(f"density {num_nodes} nodes x {num_pods} pods: "
               f"{scheduled} scheduled in {elapsed:.3f}s = "
@@ -115,7 +172,54 @@ def density(num_nodes: int, num_pods: int, profile: str = "uniform",
         num_nodes=num_nodes, num_pods=num_pods, elapsed_s=elapsed,
         scheduled=scheduled, pods_per_second=scheduled / elapsed,
         algorithm_ms_per_pod=elapsed / max(scheduled, 1) * 1e3,
-        stages=stages, warm_s=warm_s)
+        stages=stages, warm_s=warm_s, device=device)
+
+
+def _steady_state_device_window(daemon, wave_pods: list, wave_n: int,
+                                quiet: bool = False) -> dict:
+    """Drive the steady-state waves and account the device plane over
+    them.  The FIRST wave is a settling drain (it absorbs the avalanche's
+    whole-cluster dirty set, legitimately a full upload) and is excluded;
+    the measured window covers the remaining waves, whose dirty sets are
+    one wave each — the window where scatter bytes must dominate."""
+    from kubernetes_tpu.engine import devicestats
+    bound_before = daemon.config.binder.count()
+    waves = [wave_pods[i:i + wave_n]
+             for i in range(0, len(wave_pods), wave_n)]
+    transfers_before = None
+    for i, wave in enumerate(waves):
+        if i == 1:
+            transfers_before = devicestats.transfer_snapshot()
+        for pod in wave:
+            daemon.enqueue(pod)
+        daemon.schedule_pending(wait_first=False)
+        daemon.wait_for_binds()
+        # Peak sampling per wave, not per sync: benches have no
+        # telemetry ring scraping for them.
+        devicestats.sample_hbm()
+    if transfers_before is None:  # 0 or 1 waves: nothing steady to measure
+        transfers_before = devicestats.transfer_snapshot()
+    after = devicestats.transfer_snapshot()
+    delta = {c: after[c] - transfers_before[c] for c in after}
+    steady_pods = max(sum(len(w) for w in waves[1:]), 1) \
+        if len(waves) > 1 else 1
+    device = {
+        "transfer_bytes": delta,
+        "bytes_per_pod": {c: round(v / steady_pods, 1)
+                          for c, v in delta.items()},
+        "steady_pods": steady_pods if len(waves) > 1 else 0,
+        "scatter_dominates":
+            delta["scatter"] > delta["full_upload"],
+        "hbm_live_bytes": devicestats.hbm_live_bytes(),
+        "hbm_peak_bytes": devicestats.hbm_peak_bytes(),
+        "_steady_bound": daemon.config.binder.count() - bound_before,
+    }
+    if not quiet and len(waves) > 1:
+        print(f"steady-state device window ({len(waves) - 1} waves x "
+              f"{wave_n} pods): {delta} "
+              f"scatter_dominates={device['scatter_dominates']}",
+              file=sys.stderr)
+    return device
 
 
 def warm_start_compile_s(num_nodes: int, num_pods: int,

@@ -292,6 +292,9 @@ def collect(n_nodes: int = 500, deadline_ms: float = DEFAULT_DEADLINE_MS,
             quiet: bool = False) -> dict:
     """bench.py's serving phase: all three arrival rows as one artifact
     payload."""
+    from kubernetes_tpu.engine import devicestats
+    transfers_before = devicestats.transfer_snapshot()
+    compiles_before = devicestats.post_prewarm_compiles()
     trace = load_trace(burst_trace) if burst_trace else None
     rows = {
         "poisson_trickle": run_workload(
@@ -310,6 +313,10 @@ def collect(n_nodes: int = 500, deadline_ms: float = DEFAULT_DEADLINE_MS,
             slo_ms=BURST_SLO_MS, attainment_floor_pct=95.0,
             quiet=quiet),
     }
+    after = devicestats.transfer_snapshot()
+    delta = {c: after[c] - transfers_before[c] for c in after}
+    bound = sum((row.get("bound") or row.get("pods") or 0)
+                for row in rows.values()) or 1
     return {
         "harness": "kubernetes_tpu/perf/serving.py (full daemon over "
                    "HTTP: Poisson trickle + recorded burst replay + "
@@ -319,6 +326,21 @@ def collect(n_nodes: int = 500, deadline_ms: float = DEFAULT_DEADLINE_MS,
         "trickle": {"rate_pods_s": trickle_rate,
                     "duration_s": trickle_s},
         "workloads": rows,
+        # Device telemetry columns over the whole serving run: the wire
+        # PRs will be debugged through these (a trickle whose drains
+        # full-upload, or compile, is burning its latency budget on the
+        # device side).
+        "device": {
+            "transfer_bytes": delta,
+            "bytes_per_pod": {c: round(v / bound, 1)
+                              for c, v in delta.items()},
+            # Process-lifetime allocator peak at stamp time (the
+            # backend keeps no per-window peak; transfer bytes ARE
+            # windowed via the snapshot delta above).
+            "hbm_peak_bytes_process": devicestats.hbm_peak_bytes(),
+            "post_prewarm_compiles":
+                devicestats.post_prewarm_compiles() - compiles_before,
+        },
     }
 
 

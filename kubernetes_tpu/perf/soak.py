@@ -579,8 +579,25 @@ def _restart_parity(store: MemStore, factory, samples: int = 50) -> dict:
 
 
 def collect(**kw) -> dict:
-    """bench.py's soak phase entry point."""
-    return run_soak(**kw)
+    """bench.py's soak phase entry point, with the device-plane columns
+    (per-cause transfer bytes-per-pod, HBM peak) stamped around the
+    run — churn is exactly where a resident-state invalidation bug
+    turns scatters into silent full re-uploads."""
+    from kubernetes_tpu.engine import devicestats
+    before = devicestats.transfer_snapshot()
+    rec = run_soak(**kw)
+    after = devicestats.transfer_snapshot()
+    delta = {c: after[c] - before[c] for c in after}
+    pods = (rec.get("scale") or {}).get("pods_scheduled_total") or 1
+    rec["device"] = {
+        "transfer_bytes": delta,
+        "bytes_per_pod": {c: round(v / pods, 1)
+                          for c, v in delta.items()},
+        # Process-lifetime allocator peak at stamp time (transfer
+        # bytes are windowed; the peak cannot be).
+        "hbm_peak_bytes_process": devicestats.hbm_peak_bytes(),
+    }
+    return rec
 
 
 def main() -> None:

@@ -161,6 +161,12 @@ def _decisions_route(daemon, query: str) -> tuple[int, bytes, str]:
 def _status_mux(factory: ConfigFactory, configz: dict, port: int
                 ) -> ThreadingHTTPServer:
     """The daemon's own HTTP surface (server.go:93-109)."""
+    from kubernetes_tpu.utils import telemetry
+    # Self-scrape ring: the daemon-scoped metric set (queue depth, batch
+    # size, attempts) rides the ring next to the default registry so the
+    # dashboard's queue/stage/SLO sparklines have their sources.
+    telemetry.ensure_started(
+        factory.daemon.config.metrics.all_metrics())
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -181,8 +187,18 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
             if path == "/healthz":
                 self._send(200, b"ok")
             elif path == "/metrics":
-                self._send(200,
-                           factory.daemon.config.metrics.expose().encode())
+                if "format=openmetrics" in query:
+                    from kubernetes_tpu.utils.debugmux import \
+                        OPENMETRICS_CTYPE
+                    self._send(
+                        200,
+                        factory.daemon.config.metrics
+                        .expose_openmetrics().encode(),
+                        OPENMETRICS_CTYPE)
+                else:
+                    self._send(
+                        200,
+                        factory.daemon.config.metrics.expose().encode())
             elif path == "/configz":
                 self._send(200, json.dumps(configz).encode(),
                            "application/json")
@@ -205,9 +221,17 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
                            "application/json")
             elif path == "/debug/scheduler/decisions":
                 self._send(*_decisions_route(factory.daemon, query))
+            elif path == "/debug/timeseries":
+                from kubernetes_tpu.utils import telemetry
+                self._send(200, telemetry.timeseries_json().encode(),
+                           "application/json")
+            elif path == "/debug/dashboard":
+                from kubernetes_tpu.utils import telemetry
+                self._send(200, telemetry.dashboard_html().encode(),
+                           "text/html; charset=utf-8")
             elif path == "/debug/vars":
                 from kubernetes_tpu.utils.metrics import (
-                    CACHE_INVARIANT_VIOLATIONS)
+                    CACHE_INVARIANT_VIOLATIONS, POST_PREWARM_COMPILES)
                 cache = factory.algorithm.cache
                 queue = factory.daemon.queue
                 self._send(200, json.dumps({
@@ -228,6 +252,10 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
                         factory.daemon.pipeline.former.target,
                     "prewarmCacheStats":
                         factory.daemon.prewarm_cache_stats,
+                    # The SLO plane: live burn rates + budget left
+                    # (scheduler/slo.py) and the device-side watchdog.
+                    "slo": factory.slo.report(),
+                    "postPrewarmCompiles": POST_PREWARM_COMPILES.value,
                     "invariantViolations":
                         CACHE_INVARIANT_VIOLATIONS.value,
                     "lastRecovery": getattr(factory, "last_recovery",

@@ -151,6 +151,10 @@ class ConfigFactory:
         # on /debug/vars; None until run() completes the pass.
         self.last_recovery: Optional[dict] = None
         self.verifier = None
+        # Decision-latency SLO burn monitor (scheduler/slo.py); started
+        # by run() at KT_SLO_PERIOD cadence, reported on /debug/vars.
+        from kubernetes_tpu.scheduler.slo import SLOMonitor
+        self.slo = SLOMonitor()
 
     # -- reflector handlers (factory.go:128-227) -------------------------
 
@@ -339,6 +343,12 @@ class ConfigFactory:
             self.last_recovery = recovery.reconcile(
                 self.daemon, self.store,
                 scheduler_name=self.daemon.config.scheduler_name)
+        slo_period = float(os.environ.get("KT_SLO_PERIOD", "5") or "0")
+        if slo_period > 0:
+            # Multi-window SLO burn: one cheap bucket read per tick
+            # feeding scheduler_slo_burn_rate{window=} and the budget
+            # gauge (scheduler/slo.py).
+            self._threads.append(self.slo.run(period=slo_period))
         verify_period = float(os.environ.get("KT_VERIFY_PERIOD", "0")
                               or "0")
         if verify_period > 0:
@@ -368,6 +378,7 @@ class ConfigFactory:
             r.stop()
         if self.verifier is not None:
             self.verifier.stop()
+        self.slo.stop()
         self.daemon.stop()
         sink = getattr(self.daemon.config.recorder, "_sink", None)
         close = getattr(sink, "close", None)
@@ -386,4 +397,5 @@ class ConfigFactory:
             r.stop()
         if self.verifier is not None:
             self.verifier.stop()
+        self.slo.stop()
         self.daemon.abandon()

@@ -511,11 +511,17 @@ class Scheduler:
         signatures (first chunk carries no state dict, later chunks do).
         Returns {bucket: seconds}; no-ops when streaming is off, an
         extender is configured, or the cluster is empty."""
+        from kubernetes_tpu.engine import devicestats
         from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATE
         alg = self.config.algorithm
         if not DEFAULT_FEATURE_GATE.enabled("StreamingDrain") or \
                 alg.extenders or not alg.cache.nodes():
             return {}
+        # Prewarm compiles are never "post-prewarm": disarm for the
+        # duration so a fresh rig warming up in an already-armed process
+        # (the serving bench builds three in a row) doesn't count its
+        # own ladder traces as live-path stalls.
+        devicestats.disarm()
         ladder = self.effective_ladder()
         timings: dict[int, float] = {}
         # Warm-start audit: per-bucket persistent-compile-cache traffic.
@@ -573,6 +579,11 @@ class Scheduler:
         # the daemon, not in the int-keyed bucket dict callers inspect).
         self.workloads_prewarm_s = self._prewarm_workloads(ladder)
         self.prewarm_cache_stats = cache_stats
+        # Recompile watchdog: from here on, ANY XLA compile on a live
+        # path is a stall the ladder should have traced — counted in
+        # scheduler_post_prewarm_compiles_total{path=}, recorded as a
+        # post_prewarm_compile span, and failed by the bench ratchet.
+        devicestats.arm()
         log.info("pre-warmed stream ladder %s (floor %d, chunk %d): %s "
                  "workloads=%s cache=%s",
                  ladder, self.stream_min_bucket, self.stream_chunk_size(),
@@ -738,7 +749,9 @@ class Scheduler:
             (now - start) * 1e6)
         seen = first_seen(pod)
         if seen is not None:
-            metrics_mod.E2E_DECISION_LATENCY.observe((now - seen) * 1e6)
+            metrics_mod.E2E_DECISION_LATENCY.observe(
+                (now - seen) * 1e6,
+                exemplar=trace_mod.current_trace_id())
         self._first_seen.pop(pod.key, None)
         self.config.metrics.scheduling_attempts.labels(
             result="scheduled").inc()
@@ -811,11 +824,14 @@ class Scheduler:
         # The serving SLO number: per-pod first-seen -> bind ack (NOT
         # amortized — every pod carries its own admission stamp, so the
         # histogram captures the real tail the deadline trades against).
+        # The batch's trace id rides along as the bucket exemplar: a bad
+        # p99 bucket then names the exact trace to pull from the ring.
+        tid = trace_mod.current_trace_id()
         for pod in bound_pods:
             seen = first_seen(pod)
             if seen is not None:
                 metrics_mod.E2E_DECISION_LATENCY.observe(
-                    (done - seen) * 1e6)
+                    (done - seen) * 1e6, exemplar=tid)
             self._first_seen.pop(pod.key, None)
         if ok:
             self.config.metrics.scheduling_attempts.labels(

@@ -478,7 +478,8 @@ def make_handler(core: ExtenderCore):
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/configz":
+            path, _, query = self.path.partition("?")
+            if path == "/configz":
                 cfg = {"predicates": [p.name for p in core.policy.predicates],
                        "priorities": [(s.name, s.weight)
                                       for s in core.policy.priorities]}
@@ -486,8 +487,9 @@ def make_handler(core: ExtenderCore):
                 return
             # healthz / metrics / debug tree: the shared daemon routes.
             from kubernetes_tpu.utils.debugmux import common_route
-            resolved = common_route(self.path,
-                                    metrics_fn=core.metrics.expose)
+            resolved = common_route(
+                path, metrics_fn=core.metrics.expose, query=query,
+                openmetrics_fn=core.metrics.expose_openmetrics)
             if resolved is None:
                 self._send(404, b"not found", "text/plain")
             else:
@@ -524,6 +526,10 @@ def make_handler(core: ExtenderCore):
 def serve(port: int = 12346, policy: Policy | None = None,
           host: str = "127.0.0.1") -> ThreadingHTTPServer:
     core = ExtenderCore(policy)
+    # Self-scrape ring behind /debug/timeseries + /debug/dashboard: the
+    # extender's verb-latency metric set rides next to the registry.
+    from kubernetes_tpu.utils import telemetry
+    telemetry.ensure_started(core.metrics.all_metrics())
     server = ThreadingHTTPServer((host, port), make_handler(core))
     _freeze_baseline_heap()
     return server

@@ -19,22 +19,41 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from kubernetes_tpu.utils import trace
-from kubernetes_tpu.utils.metrics import expose_registry
+from kubernetes_tpu.utils.metrics import (expose_registry,
+                                          expose_registry_openmetrics)
+
+OPENMETRICS_CTYPE = "application/openmetrics-text; version=1.0.0; " \
+                    "charset=utf-8"
 
 
 def common_route(path: str,
-                 metrics_fn: Optional[Callable[[], str]] = None
+                 metrics_fn: Optional[Callable[[], str]] = None,
+                 query: str = "",
+                 openmetrics_fn: Optional[Callable[[], str]] = None
                  ) -> Optional[tuple[int, bytes, str]]:
     """Resolve a shared status route to (code, body, content-type), or
     None when the path is not one of ours.  ``metrics_fn`` overrides the
-    default-registry exposition (daemons with their own metric set)."""
+    default-registry exposition (daemons with their own metric set);
+    ``openmetrics_fn`` likewise for ``/metrics?format=openmetrics``,
+    the exemplar-carrying rendering."""
     if path == "/healthz":
         return 200, b"ok", "text/plain"
     if path == "/metrics":
+        if "format=openmetrics" in query:
+            text = (openmetrics_fn or expose_registry_openmetrics)()
+            return 200, text.encode(), OPENMETRICS_CTYPE
         text = (metrics_fn or expose_registry)()
         return 200, text.encode(), "text/plain"
     if path == "/debug/traces":
         return 200, trace.to_chrome_trace().encode(), "application/json"
+    if path == "/debug/timeseries":
+        from kubernetes_tpu.utils import telemetry
+        return (200, telemetry.timeseries_json().encode(),
+                "application/json")
+    if path == "/debug/dashboard":
+        from kubernetes_tpu.utils import telemetry
+        return (200, telemetry.dashboard_html().encode(),
+                "text/html; charset=utf-8")
     if path.startswith("/debug/pprof"):
         from kubernetes_tpu.utils.profiling import thread_stacks
         return 200, thread_stacks().encode(), "text/plain"
@@ -50,6 +69,10 @@ def serve_status_mux(port: int = 0, host: str = "127.0.0.1",
     prefix to ``handler(path, query_string) -> (code, body, ctype)`` for
     daemon-specific routes (the scheduler's decisions endpoint)."""
     extra = extra or {}
+    # The self-scrape ring behind /debug/timeseries + /debug/dashboard
+    # starts with the mux: a daemon that serves the routes also samples.
+    from kubernetes_tpu.utils import telemetry
+    telemetry.ensure_started()
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -75,7 +98,7 @@ def serve_status_mux(port: int = 0, host: str = "127.0.0.1",
                 if path == prefix or path.startswith(prefix + "/"):
                     self._send(*handler(path, query))
                     return
-            resolved = common_route(path, metrics_fn)
+            resolved = common_route(path, metrics_fn, query=query)
             if resolved is None:
                 self._send(404, b"not found")
             else:

@@ -1,0 +1,23 @@
+"""Shared environment-knob parsing for the telemetry/SLO plane."""
+
+from __future__ import annotations
+
+import os
+
+from kubernetes_tpu.utils.logging import get_logger
+
+log = get_logger("envutil")
+
+
+def env_float(name: str, default: float) -> float:
+    """``float(os.environ[name])`` with the daemon-knob contract: empty
+    or unset means the default, garbage logs a warning and means the
+    default (a mistyped knob must not kill a daemon at startup)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        log.warning("bad %s=%r; using %s", name, raw, default)
+        return default

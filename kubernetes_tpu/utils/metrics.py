@@ -12,11 +12,22 @@ escaped (``\\`` and newlines), label values are escaped (``\\``, ``"``,
 newlines), histogram buckets are exposed cumulatively but stored
 per-bucket so ``observe()`` is one bisect instead of a walk over every
 upper bound.
+
+Histograms additionally accept an OPTIONAL per-observation exemplar (a
+trace id): the last exemplar per bucket is kept and emitted in the
+OpenMetrics exposition (``expose_openmetrics`` /
+``/metrics?format=openmetrics``) as ``# {trace_id="..."} value ts`` on
+the ``_bucket`` lines — a slow p99 bucket then links straight to a
+trace retrievable from ``/debug/traces``.  The Prometheus text format
+(the default ``/metrics`` body) is unchanged; exemplars ride only the
+OpenMetrics rendering, which ends with the spec's ``# EOF`` terminator
+and names counter families without their ``_total`` suffix.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from bisect import bisect_left
 from typing import Iterable
 
@@ -123,20 +134,29 @@ class Histogram(_Family):
         self._counts = [0] * len(self.uppers)
         self._sum = 0.0
         self._count = 0
-        # Pending events: floats (observe) or (value, count) tuples
-        # (observe_many).  Appends are GIL-atomic; the folder drains a
-        # fixed prefix (copy + del of [:n] are each single bytecode ops),
-        # so appends racing the fold land past n and survive it.
+        # Pending events: floats (observe), (value, count) tuples
+        # (observe_many) or (value, trace_id, ts) exemplar triples.
+        # Appends are GIL-atomic; the folder drains a fixed prefix (copy
+        # + del of [:n] are each single bytecode ops), so appends racing
+        # the fold land past n and survive it.
         self._events: list = []
+        # bucket index (len(uppers) = +Inf) -> (value, trace_id, ts):
+        # the LAST exemplar observed per bucket, OpenMetrics-rendered.
+        self._exemplars: dict[int, tuple[float, str, float]] = {}
 
     def _make_child(self, key) -> "Histogram":
         child = Histogram(self.name, self.help, self.uppers)
         child._labelvalues = key  # rendered by the family's expose
         return child
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: str | None = None) -> None:
+        """Record one observation; ``exemplar`` optionally attaches a
+        trace id (the hot no-exemplar path stays one list append)."""
         self._check_unlabeled()
-        self._events.append(value)
+        if exemplar:
+            self._events.append((value, exemplar, time.time()))
+        else:
+            self._events.append(value)
         if len(self._events) >= self._FOLD_AT:
             with self._lock:
                 self._fold_locked()
@@ -167,7 +187,10 @@ class Histogram(_Family):
         top = len(counts)
         for item in items:
             if type(item) is tuple:
-                value, k = item
+                if len(item) == 3:           # (value, trace_id, ts)
+                    value, k = item[0], 1
+                else:
+                    value, k = item
             else:
                 value, k = item, 1
             i = bisect_left(uppers, value)
@@ -175,6 +198,8 @@ class Histogram(_Family):
             self._count += k
             if i < top:
                 counts[i] += k
+            if type(item) is tuple and len(item) == 3:
+                self._exemplars[i] = item
 
     @property
     def count(self) -> int:
@@ -192,21 +217,44 @@ class Histogram(_Family):
             self._fold_locked()
             return self._sum
 
-    def _sample_lines(self, labelvalues: tuple = ()) -> list[str]:
+    def bucket_counts(self) -> tuple[list[float], list[int], int, float]:
+        """(uppers, per-bucket counts (non-cumulative; +Inf excluded),
+        total count, sum) as one consistent snapshot — the reader the
+        SLO burn monitor and the telemetry ring use to compute
+        good-vs-bad counts without re-parsing the exposition."""
+        self._check_unlabeled()
+        with self._lock:
+            self._fold_locked()
+            return (list(self.uppers), list(self._counts), self._count,
+                    self._sum)
+
+    def _sample_lines(self, labelvalues: tuple = (),
+                      openmetrics: bool = False) -> list[str]:
         with self._lock:
             self._fold_locked()
             counts = list(self._counts)
             total, s = self._count, self._sum
+            exemplars = dict(self._exemplars) if openmetrics else {}
+
+        def ex(i: int) -> str:
+            item = exemplars.get(i)
+            if item is None:
+                return ""
+            value, tid, ts = item
+            return (f' # {{trace_id="{_escape_label_value(tid)}"}} '
+                    f"{value:g} {ts:.3f}")
+
         lines = []
         cum = 0
-        for upper, n in zip(self.uppers, counts):
+        for i, (upper, n) in enumerate(zip(self.uppers, counts)):
             cum += n
             lab = _label_str(self._family_labelnames, labelvalues,
                              f'le="{upper:g}"')
-            lines.append(f"{self.name}_bucket{lab} {cum}")
+            lines.append(f"{self.name}_bucket{lab} {cum}{ex(i)}")
         lab = _label_str(self._family_labelnames, labelvalues,
                          'le="+Inf"')
-        lines.append(f"{self.name}_bucket{lab} {total}")
+        lines.append(f"{self.name}_bucket{lab} {total}"
+                     f"{ex(len(self.uppers))}")
         plain = _label_str(self._family_labelnames, labelvalues)
         lines.append(f"{self.name}_sum{plain} {s:g}")
         lines.append(f"{self.name}_count{plain} {total}")
@@ -224,6 +272,19 @@ class Histogram(_Family):
                 lines.extend(child._sample_lines(key))
         else:
             lines.extend(self._sample_lines())
+        return "\n".join(lines) + "\n"
+
+    def expose_openmetrics(self) -> str:
+        """The family as an OpenMetrics block: same samples, plus the
+        per-bucket exemplars on ``_bucket`` lines."""
+        lines = [f"# TYPE {self.name} histogram",
+                 f"# HELP {self.name} {_escape_help(self.help)}"]
+        if self._labelnames:
+            for key, child in self._sorted_children():
+                child._family_labelnames = self._labelnames
+                lines.extend(child._sample_lines(key, openmetrics=True))
+        else:
+            lines.extend(self._sample_lines(openmetrics=True))
         return "\n".join(lines) + "\n"
 
 
@@ -256,6 +317,21 @@ class Counter(_Family):
                 lines.append(f"{self.name}{lab} {child.value}")
         else:
             lines.append(f"{self.name} {self.value}")
+        return "\n".join(lines) + "\n"
+
+    def expose_openmetrics(self) -> str:
+        """OpenMetrics names the counter FAMILY without the ``_total``
+        suffix the samples carry (the spec's MetricFamily naming)."""
+        family = self.name[:-6] if self.name.endswith("_total") \
+            else self.name
+        lines = [f"# TYPE {family} counter",
+                 f"# HELP {family} {_escape_help(self.help)}"]
+        if self._labelnames:
+            for key, child in self._sorted_children():
+                lab = _label_str(self._labelnames, key)
+                lines.append(f"{family}_total{lab} {child.value}")
+        else:
+            lines.append(f"{family}_total {self.value}")
         return "\n".join(lines) + "\n"
 
 
@@ -313,6 +389,17 @@ class Gauge(_Family):
             lines.append(f"{self.name} {self.value:g}")
         return "\n".join(lines) + "\n"
 
+    def expose_openmetrics(self) -> str:
+        lines = [f"# TYPE {self.name} gauge",
+                 f"# HELP {self.name} {_escape_help(self.help)}"]
+        if self._labelnames:
+            for key, child in self._sorted_children():
+                lab = _label_str(self._labelnames, key)
+                lines.append(f"{self.name}{lab} {child.value:g}")
+        else:
+            lines.append(f"{self.name} {self.value:g}")
+        return "\n".join(lines) + "\n"
+
 
 def exponential_buckets(start: float, factor: float, count: int) -> list[float]:
     """prometheus.ExponentialBuckets."""
@@ -348,6 +435,16 @@ def registry_metrics() -> list:
 
 def expose_registry() -> str:
     return "".join(m.expose() for m in registry_metrics())
+
+
+def openmetrics(metrics: Iterable) -> str:
+    """Render ``metrics`` as one OpenMetrics exposition, terminated by
+    the spec's mandatory ``# EOF`` line."""
+    return "".join(m.expose_openmetrics() for m in metrics) + "# EOF\n"
+
+
+def expose_registry_openmetrics() -> str:
+    return openmetrics(registry_metrics())
 
 
 # Client -> apiserver path (client/http.py), labeled by verb.
@@ -453,6 +550,49 @@ BATCH_DEADLINE_MISSES = register(Counter(
     "Batches the former handed off later than its formation deadline "
     "(KT_BATCH_DEADLINE_MS) plus the 25% grace — formation overran the "
     "latency budget instead of choosing to wait"))
+# Device telemetry plane (engine/devicestats.py): per-cause host<->device
+# traffic and HBM occupancy — the regressions ROADMAP items 1 and 3 name
+# (a silent full re-upload where a dirty-row scatter should run, HBM
+# growth toward OOM) are invisible without these.
+DEVICE_TRANSFER_BYTES = register(Counter(
+    "scheduler_device_transfer_bytes_total",
+    "Bytes moved between host and device by the drain path, by cause: "
+    "scatter (dirty-row updates into the resident cluster mirror), "
+    "full_upload (whole-cluster re-snapshot on relist/capacity growth), "
+    "readback (device->host result fetches)",
+    labelnames=("cause",)))
+DEVICE_TRANSFERS = register(Counter(
+    "scheduler_device_transfers_total",
+    "Host<->device transfer operations by cause (same label set as the "
+    "bytes counter; bytes/ops is the mean transfer size)",
+    labelnames=("cause",)))
+DEVICE_HBM_LIVE_BYTES = register(Gauge(
+    "scheduler_device_hbm_live_bytes",
+    "Device memory held by live arrays (device.memory_stats when the "
+    "backend reports it, else the jax.live_arrays() fallback)"))
+DEVICE_HBM_PEAK_BYTES = register(Gauge(
+    "scheduler_device_hbm_peak_bytes",
+    "Peak observed device memory (backend peak_bytes_in_use when "
+    "available, else the high-water mark of sampled live bytes)"))
+POST_PREWARM_COMPILES = register(Counter(
+    "scheduler_post_prewarm_compiles_total",
+    "XLA compilations observed AFTER prewarm() armed the recompile "
+    "watchdog, by live path — every one is a compile stall on the "
+    "serving clock that the bucket-ladder prewarm should have traced "
+    "(the bench ratchet fails on any in the density run)",
+    labelnames=("path",)))
+# SLO burn plane (scheduler/slo.py): multi-window error-budget burn
+# computed from the decision-latency histogram above.
+SLO_BURN_RATE = register(Gauge(
+    "scheduler_slo_burn_rate",
+    "Error-budget burn rate of the decision-latency SLO over a trailing "
+    "window (1.0 = exactly exhausting the budget at period end; >1 is "
+    "an alerting burn), labeled by window (5m/1h)",
+    labelnames=("window",)))
+SLO_BUDGET_REMAINING = register(Gauge(
+    "scheduler_slo_budget_remaining",
+    "Fraction of the decision-latency error budget left over the "
+    "longest burn window (1.0 = untouched, 0.0 = exhausted)"))
 # Bind path (scheduler/scheduler.py).
 BIND_CONFLICTS = register(Counter(
     "scheduler_bind_conflicts_total",
@@ -523,12 +663,21 @@ class SchedulerMetrics:
             "1 while the pending queue is past its high watermark and "
             "the daemon drains in degraded (load-shedding) mode")
 
+    def all_metrics(self) -> tuple:
+        """This set's own metric objects (the default registry rides
+        along separately at expose)."""
+        return (self.e2e_scheduling_latency,
+                self.scheduling_algorithm_latency, self.binding_latency,
+                self.queue_depth, self.batch_size,
+                self.scheduling_attempts, self.queue_high_watermark,
+                self.queue_degraded)
+
     def expose(self) -> str:
         # The default registry (retry/breaker/degradation counters, stage
         # latencies) rides along so any daemon serving a SchedulerMetrics
         # /metrics endpoint also exposes the shared-path observability.
-        return "".join(m.expose() for m in (
-            self.e2e_scheduling_latency, self.scheduling_algorithm_latency,
-            self.binding_latency, self.queue_depth, self.batch_size,
-            self.scheduling_attempts, self.queue_high_watermark,
-            self.queue_degraded)) + expose_registry()
+        return "".join(m.expose() for m in self.all_metrics()) + \
+            expose_registry()
+
+    def expose_openmetrics(self) -> str:
+        return openmetrics(list(self.all_metrics()) + registry_metrics())

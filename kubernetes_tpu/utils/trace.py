@@ -124,6 +124,16 @@ def use_context(ctx: tuple[str, str, bool] | None):
         _tls.ctx = prev
 
 
+def current_trace_id() -> str | None:
+    """The active SAMPLED trace id, or None — the exemplar the metric
+    histograms attach to observations so a p99 bucket links back to a
+    retrievable trace."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is None or not ctx[2]:
+        return None
+    return ctx[0]
+
+
 def traceparent() -> str | None:
     """The active context as a ``traceparent`` header value, or None.
     Callers attach it to outbound HTTP so the server's request span lands
@@ -289,7 +299,9 @@ def record_server_span(name: str, traceparent_header: str,
 def stage(name: str, **attrs):
     """A named pipeline stage: a span (when tracing is on) AND an
     observation in the per-stage labeled histogram (always — metrics are
-    the cheap, always-on layer; spans are the sampled, detailed one)."""
+    the cheap, always-on layer; spans are the sampled, detailed one).
+    The span's trace id rides the observation as an OpenMetrics
+    exemplar, so a slow histogram bucket links to its trace."""
     t0 = time.perf_counter()
     if _enabled:
         h = begin_span(name, **attrs)
@@ -297,10 +309,11 @@ def stage(name: str, **attrs):
             yield h
         finally:
             h.end()
-            _observe_stage(name, (time.perf_counter() - t0) * 1e6)
+            _observe_stage(name, (time.perf_counter() - t0) * 1e6,
+                           h.trace_id or None)
     else:
         yield _NOOP
-        _observe_stage(name, (time.perf_counter() - t0) * 1e6)
+        _observe_stage(name, (time.perf_counter() - t0) * 1e6, None)
 
 
 def record_stage(name: str, start: float, end: float | None = None,
@@ -309,14 +322,19 @@ def record_stage(name: str, start: float, end: float | None = None,
     (``start``/``end`` are ``time.perf_counter()`` readings) — for stages
     that begin before their span parent exists (queue wait)."""
     end = time.perf_counter() if end is None else end
+    tid = None
     if _enabled:
-        begin_span(name, start=start, **attrs).end()
-    _observe_stage(name, (end - start) * 1e6)
+        h = begin_span(name, start=start, **attrs)
+        h.end()
+        tid = h.trace_id or None
+    _observe_stage(name, (end - start) * 1e6, tid)
 
 
-def _observe_stage(name: str, us: float) -> None:
+def _observe_stage(name: str, us: float, trace_id: str | None = None
+                   ) -> None:
     from kubernetes_tpu.utils import metrics
-    metrics.STAGE_LATENCY.labels(stage=name).observe(us)
+    metrics.STAGE_LATENCY.labels(stage=name).observe(us,
+                                                     exemplar=trace_id)
 
 
 # -- export ----------------------------------------------------------------

@@ -18,7 +18,8 @@ cb = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(cb)
 
 
-def _parsed(p50=None, median=None, stages=None, pods=30000):
+def _parsed(p50=None, median=None, stages=None, pods=30000,
+            device=None):
     d = {"metric": f"scheduler throughput, {pods} pods onto 5000 nodes"}
     if p50 is not None:
         d["elapsed_s_p50"] = p50
@@ -26,7 +27,20 @@ def _parsed(p50=None, median=None, stages=None, pods=30000):
         d["median"] = median
     if stages is not None:
         d["stages"] = stages
+    if device is not None:
+        d["device"] = device
     return d
+
+
+def _device(compiles=0, scatter=150.0, full=0.0, readback=120.0):
+    return {"post_prewarm_compiles": compiles,
+            "bytes_per_pod": {"scatter": scatter, "full_upload": full,
+                              "readback": readback},
+            "transfer_bytes": {"scatter": int(scatter * 100),
+                               "full_upload": int(full * 100),
+                               "readback": int(readback * 100)},
+            "scatter_dominates": scatter > full,
+            "hbm_peak_bytes": 1 << 20}
 
 
 def test_repo_artifacts_pass_the_ratchet():
@@ -79,6 +93,51 @@ def test_disappearing_stage_fails():
 def test_fewer_than_two_artifacts_is_vacuously_green():
     assert cb.check([]) == []
     assert cb.check([("BENCH_r01.json", _parsed(p50=1.0))]) == []
+
+
+# -- device-plane ratchet (ISSUE 9) ------------------------------------------
+
+def test_post_prewarm_compile_fails_even_without_predecessor():
+    arts = [("BENCH_r09.json", _parsed(p50=1.0,
+                                       device=_device(compiles=2)))]
+    problems = cb.check(arts)
+    assert len(problems) == 1 and "post-prewarm" in problems[0]
+
+
+def test_zero_compiles_and_steady_bytes_pass():
+    arts = [("BENCH_r08.json", _parsed(p50=1.0, device=_device())),
+            ("BENCH_r09.json", _parsed(p50=1.0, device=_device()))]
+    assert cb.check(arts) == []
+
+
+def test_transfer_bytes_per_pod_regression_fails():
+    # Scatter giving way to full uploads: the per-pod byte total more
+    # than doubles -> the device ratchet trips with the per-cause story.
+    arts = [("BENCH_r08.json", _parsed(p50=1.0, device=_device())),
+            ("BENCH_r09.json", _parsed(
+                p50=1.0, device=_device(scatter=10.0, full=900.0)))]
+    problems = cb.check(arts)
+    assert len(problems) == 1 and "bytes-per-pod regressed" in problems[0]
+    assert "full_upload" in problems[0]
+    # Inside the noise band, and improvements, pass.
+    assert cb.check(
+        [("BENCH_r08.json", _parsed(p50=1.0, device=_device())),
+         ("BENCH_r09.json", _parsed(p50=1.0, device=_device(
+             scatter=160.0)))]) == []
+    assert cb.check(
+        [("BENCH_r08.json", _parsed(p50=1.0, device=_device())),
+         ("BENCH_r09.json", _parsed(p50=1.0, device=_device(
+             scatter=80.0, readback=60.0)))]) == []
+
+
+def test_artifacts_predating_device_columns_ratchet_nothing():
+    arts = [("BENCH_r05.json", _parsed(p50=1.0)),
+            ("BENCH_r09.json", _parsed(p50=1.0, device=_device()))]
+    assert cb.check(arts) == []
+    # ...and a newest artifact without the section is not penalized.
+    arts = [("BENCH_r05.json", _parsed(p50=1.0, device=_device())),
+            ("BENCH_r09.json", _parsed(p50=1.0))]
+    assert cb.check(arts) == []
 
 
 # -- SOAK artifact ratchet (ISSUE 7) ----------------------------------------

@@ -1,0 +1,103 @@
+"""Audit of every /debug & status route across the four daemon muxes
+(ISSUE 9 satellite): one parametrized smoke test asserting each
+registered route answers non-500 with the right Content-Type — the
+drift this catches is a route added to one mux and forgotten on
+another, or a handler returning JSON under text/plain."""
+
+from __future__ import annotations
+
+import urllib.request
+
+import pytest
+
+from tests.helpers import make_node
+
+# (route, expected content-type prefix) — the shared surface every mux
+# must serve identically.
+COMMON = [
+    ("/healthz", "text/plain"),
+    ("/metrics", "text/plain"),
+    ("/metrics?format=openmetrics", "application/openmetrics-text"),
+    ("/debug/traces", "application/json"),
+    ("/debug/timeseries", "application/json"),
+    ("/debug/dashboard", "text/html"),
+]
+
+ROUTES = {
+    "scheduler": COMMON + [
+        ("/configz", "application/json"),
+        ("/debug/pprof", "text/plain"),
+        ("/debug/vars", "application/json"),
+        ("/debug/scheduler/decisions", "application/json"),
+    ],
+    "apiserver": COMMON,
+    "extender": COMMON + [
+        ("/configz", "application/json"),
+        ("/debug/pprof", "text/plain"),
+    ],
+    "controller": COMMON + [
+        ("/debug/pprof", "text/plain"),
+    ],
+}
+
+PARAMS = [(daemon, route, ctype)
+          for daemon, routes in sorted(ROUTES.items())
+          for route, ctype in routes]
+
+
+@pytest.fixture(scope="module")
+def daemons():
+    """All four daemon muxes, started once for the whole audit."""
+    from kubernetes_tpu.api.types import node_to_json
+    from kubernetes_tpu.apiserver.memstore import MemStore
+    from kubernetes_tpu.apiserver.server import serve
+    from kubernetes_tpu.controller.__main__ import status_mux
+    from kubernetes_tpu.scheduler.__main__ import _status_mux
+    from kubernetes_tpu.scheduler.factory import ConfigFactory
+    from kubernetes_tpu.server.extender import serve_in_thread
+
+    store = MemStore()
+    store.create("nodes", node_to_json(make_node("dbg-n1")))
+    factory = ConfigFactory(store).run()
+    sched_mux = _status_mux(factory, {"enableProfiling": True}, 0)
+    api_srv = serve(MemStore(), port=0)
+    ext_srv = serve_in_thread(port=0)
+    ctl_mux = status_mux(port=0)
+    ports = {
+        "scheduler": sched_mux.server_address[1],
+        "apiserver": api_srv.server_address[1],
+        "extender": ext_srv.server_address[1],
+        "controller": ctl_mux.server_address[1],
+    }
+    try:
+        yield ports
+    finally:
+        factory.stop()
+        for srv in (sched_mux, api_srv, ext_srv, ctl_mux):
+            srv.shutdown()
+
+
+@pytest.mark.parametrize("daemon,route,ctype", PARAMS)
+def test_route_answers_with_correct_content_type(daemons, daemon,
+                                                 route, ctype):
+    url = f"http://127.0.0.1:{daemons[daemon]}{route}"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        assert r.status < 500, f"{daemon}{route} -> {r.status}"
+        assert r.status == 200, f"{daemon}{route} -> {r.status}"
+        got = r.headers.get("Content-Type", "")
+        assert got.startswith(ctype), \
+            f"{daemon}{route}: Content-Type {got!r}, wanted {ctype!r}"
+        body = r.read()
+        assert body, f"{daemon}{route}: empty body"
+
+
+def test_unknown_route_is_404_not_500(daemons):
+    for daemon, port in daemons.items():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/debug/definitely-not-a-route")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                status = r.status
+        except urllib.error.HTTPError as err:
+            status = err.code
+        assert status == 404, f"{daemon}: {status}"

@@ -4,7 +4,14 @@ exposition-spec details the hand-rolled writer must honor: HELP escaping,
 label-value escaping, monotone cumulative buckets, _sum/_count
 consistency, and labeled failure-path counters (the chaos-suite
 assertion: breaker/degraded counters carry labels after PR 1's fault
-scenarios)."""
+scenarios).
+
+Also the strict OPENMETRICS round-trip (``/metrics?format=openmetrics``):
+mandatory ``# EOF`` terminator, counter families named without their
+``_total`` suffix, exemplars only on histogram ``_bucket`` lines with the
+spec's 128-rune labelset bound — and the exemplar contract itself: a
+bucket's ``trace_id`` must resolve to a trace retrievable from the span
+ring ``/debug/traces`` serves."""
 
 from __future__ import annotations
 
@@ -131,6 +138,90 @@ def _fetch(url: str) -> str:
         return r.read().decode()
 
 
+# -- a strict OpenMetrics parser --------------------------------------------
+
+_OM_SAMPLE_RE = re.compile(
+    rf"^({_NAME})(?:\{{(.*?)\}})? (-?(?:[0-9.e+-]+|Inf|NaN))"
+    rf"(?: # \{{(.*)\}} (-?(?:[0-9.e+-]+|Inf|NaN))(?: ([0-9.]+))?)?$")
+
+
+def parse_openmetrics(text: str) -> dict:
+    """Parse an OpenMetrics exposition strictly.  Returns
+    {family: {"type", "help", "samples": [(name, labels, value)],
+    "exemplars": [(name, labels, exemplar_labels, value, ts)]}} and
+    raises AssertionError on: a missing/extra ``# EOF``, samples before
+    TYPE, a counter sample not named ``<family>_total``, exemplars
+    anywhere but histogram ``_bucket`` lines, an exemplar labelset over
+    the spec's 128-rune bound, bad label syntax, duplicates."""
+    lines = text.splitlines()
+    assert lines and lines[-1] == "# EOF", "missing # EOF terminator"
+    assert lines.count("# EOF") == 1, "multiple # EOF lines"
+    families: dict = {}
+    seen: set = set()
+    for lineno, line in enumerate(lines[:-1], 1):
+        assert line.strip(), f"line {lineno}: blank line before # EOF"
+        if line.startswith("# TYPE "):
+            name, _, type_name = line[len("# TYPE "):].partition(" ")
+            assert name not in families, \
+                f"line {lineno}: duplicate TYPE for {name}"
+            assert type_name in ("counter", "gauge", "histogram",
+                                 "summary", "info", "stateset",
+                                 "unknown"), \
+                f"line {lineno}: bad type {type_name!r}"
+            families[name] = {"type": type_name, "help": None,
+                              "samples": [], "exemplars": []}
+            continue
+        if line.startswith("# HELP "):
+            name, _, help_text = line[len("# HELP "):].partition(" ")
+            assert name in families, \
+                f"line {lineno}: HELP before TYPE for {name}"
+            families[name]["help"] = help_text
+            continue
+        assert not line.startswith("#"), \
+            f"line {lineno}: unexpected comment {line!r}"
+        match = _OM_SAMPLE_RE.match(line)
+        assert match, f"line {lineno}: malformed sample {line!r}"
+        name, label_blob, value, ex_blob, ex_value, ex_ts = match.groups()
+        base = re.sub(r"_(bucket|sum|count|total)$", "", name)
+        family = name if name in families else base
+        assert family in families, \
+            f"line {lineno}: sample {name} without TYPE"
+        ftype = families[family]["type"]
+        if ftype == "counter":
+            assert name == f"{family}_total", \
+                f"line {lineno}: counter sample {name} must be " \
+                f"{family}_total"
+        if ftype == "histogram":
+            assert name != family, \
+                f"line {lineno}: bare histogram sample {name}"
+        labels = {}
+        if label_blob:
+            consumed = 0
+            for lm in _LABEL_RE.finditer(label_blob):
+                labels[lm.group(1)] = _unescape(lm.group(2))
+                consumed = lm.end()
+            assert not label_blob[consumed:].strip(", "), \
+                f"line {lineno}: bad label syntax {label_blob!r}"
+        key = (name, tuple(sorted(labels.items())))
+        assert key not in seen, f"line {lineno}: duplicate sample {key}"
+        seen.add(key)
+        families[family]["samples"].append((name, labels, float(value)))
+        if ex_blob is not None:
+            assert ftype == "histogram" and name.endswith("_bucket"), \
+                f"line {lineno}: exemplar on non-bucket sample {name}"
+            assert len(ex_blob) <= 128, \
+                f"line {lineno}: exemplar labelset over 128 runes"
+            ex_labels = {lm.group(1): _unescape(lm.group(2))
+                         for lm in _LABEL_RE.finditer(ex_blob)}
+            assert ex_labels, f"line {lineno}: empty exemplar labelset"
+            families[family]["exemplars"].append(
+                (name, labels, ex_labels, float(ex_value),
+                 float(ex_ts) if ex_ts else None))
+    for name, fam in families.items():
+        assert fam["help"] is not None, f"{name}: TYPE without HELP"
+    return families
+
+
 # -- exposition-spec details ------------------------------------------------
 
 class TestExpositionSpec:
@@ -185,6 +276,56 @@ class TestExpositionSpec:
             c.inc()
         with pytest.raises(ValueError):
             c.labels(wrong="a")
+
+
+class TestOpenMetrics:
+    def test_exemplar_renders_and_parses(self):
+        h = m.Histogram("om_ex_us", "h", [1, 10, 100])
+        h.observe(5, exemplar="ab" * 16)
+        h.observe(7)          # no exemplar: the bucket keeps the last one
+        h.observe(500, exemplar="cd" * 16)
+        fams = parse_openmetrics(m.openmetrics([h]))
+        ex = fams["om_ex_us"]["exemplars"]
+        by_bucket = {labels["le"]: (exl["trace_id"], v)
+                     for _, labels, exl, v, _ in ex}
+        assert by_bucket["10"] == ("ab" * 16, 5.0)
+        assert by_bucket["+Inf"] == ("cd" * 16, 500.0)
+        # The Prometheus rendering stays exemplar-free.
+        assert "trace_id" not in h.expose()
+
+    def test_counter_family_naming(self):
+        c = m.Counter("om_things_total", "h", labelnames=("kind",))
+        c.labels(kind="a").inc(2)
+        fams = parse_openmetrics(m.openmetrics([c]))
+        assert "om_things" in fams
+        (name, labels, value), = fams["om_things"]["samples"]
+        assert name == "om_things_total" and value == 2
+
+    def test_registry_openmetrics_round_trips(self):
+        fams = parse_openmetrics(m.expose_registry_openmetrics())
+        # Spot-check the three metric kinds made it through strictly.
+        assert fams["apiclient_retries"]["type"] == "counter"
+        assert fams["scheduler_device_hbm_live_bytes"]["type"] == "gauge"
+        assert fams["scheduler_e2e_decision_latency_microseconds"][
+            "type"] == "histogram"
+
+    def test_stage_exemplar_resolves_to_trace_in_ring(self):
+        """The exemplar contract end to end: a stage observation inside
+        a span carries the span's trace id, and that id resolves to a
+        trace retrievable from the ring /debug/traces serves."""
+        from kubernetes_tpu.utils import trace
+        with trace.span("exemplar-root"):
+            with trace.stage("solve"):
+                pass
+        fams = parse_openmetrics(
+            m.openmetrics([m.STAGE_LATENCY]))
+        tids = {exl["trace_id"] for _, labels, exl, _, _ in
+                fams["scheduler_batch_stage_latency_microseconds"]
+                ["exemplars"] if labels.get("stage") == "solve"}
+        assert tids, "no exemplar on the solve stage"
+        ring_ids = {s["trace_id"] for s in trace.snapshot()}
+        assert tids & ring_ids, \
+            "no stage exemplar trace id resolves to a recorded trace"
 
 
 # -- the four daemon endpoints ---------------------------------------------
@@ -242,6 +383,14 @@ class TestEndpointRoundTrips:
                        ["samples"]}
             assert results.get("scheduled", 0) >= 1
             assert results.get("unschedulable", 0) >= 1
+            # The same endpoint's OpenMetrics rendering parses under the
+            # strict parser and carries stage exemplars from the drain.
+            om = parse_openmetrics(_fetch(
+                f"http://127.0.0.1:{port}/metrics?format=openmetrics"))
+            stage_fam = om["scheduler_batch_stage_latency_microseconds"]
+            assert stage_fam["type"] == "histogram"
+            assert stage_fam["exemplars"], \
+                "drain left no stage exemplars"
         finally:
             factory.stop()
             mux.shutdown()

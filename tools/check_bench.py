@@ -285,17 +285,58 @@ def density_p50_s(parsed: dict) -> float | None:
     return _shape_pods(parsed) / float(median)
 
 
+def check_device(artifacts: list[tuple[str, dict]],
+                 tolerance: float = TOLERANCE) -> list[str]:
+    """The device-plane ratchet over BENCH artifacts: ANY post-prewarm
+    compile in the density run fails outright (every one is a compile
+    stall on the serving clock the prewarm ladder should have traced),
+    and the steady-state transfer bytes-per-pod (scatter + full_upload
+    + readback) must not grow more than ``tolerance`` vs the
+    predecessor — the dirty-row scatter quietly giving way to full
+    re-uploads is exactly the regression these columns exist to catch.
+    Artifacts predating the ``device`` section ratchet nothing."""
+    problems: list[str] = []
+    if not artifacts:
+        return problems
+    new_name, new = artifacts[-1]
+    dev = new.get("device") or {}
+    compiles = dev.get("post_prewarm_compiles")
+    if compiles:
+        problems.append(
+            f"{new_name}: {compiles} post-prewarm XLA compile(s) in the "
+            f"density run — a live-path shape the prewarm ladder never "
+            f"traced")
+    if len(artifacts) < 2:
+        return problems
+    prev_dev = (artifacts[-2][1].get("device") or {})
+    prev_name = artifacts[-2][0]
+    prev_bpp = prev_dev.get("bytes_per_pod") or {}
+    new_bpp = dev.get("bytes_per_pod") or {}
+    prev_total = sum(v for v in prev_bpp.values() if v)
+    new_total = sum(v for v in new_bpp.values() if v)
+    if prev_total and new_total > prev_total * (1.0 + tolerance):
+        problems.append(
+            f"device transfer bytes-per-pod regressed: {new_name} "
+            f"{new_total:.0f} B/pod vs {prev_name} {prev_total:.0f} "
+            f"B/pod (+{(new_total / prev_total - 1) * 100:.0f}%, "
+            f"tolerance {tolerance * 100:.0f}%) — per cause "
+            f"{new_bpp} vs {prev_bpp}")
+    return problems
+
+
 def check(artifacts: list[tuple[str, dict]] | None = None,
           tolerance: float = TOLERANCE) -> list[str]:
     """Problems with the newest artifact vs its predecessor (empty =
-    ratchet holds).  Fewer than two comparable artifacts: nothing to
-    ratchet against, vacuously green."""
+    ratchet holds).  The device-plane checks (post-prewarm compiles,
+    bytes-per-pod) apply even with a single artifact; the rest need a
+    predecessor — fewer than two comparable artifacts is vacuously
+    green."""
     if artifacts is None:
         artifacts = committed_artifacts()
+    problems = check_device(artifacts, tolerance)
     if len(artifacts) < 2:
-        return []
+        return problems
     (prev_name, prev), (new_name, new) = artifacts[-2], artifacts[-1]
-    problems: list[str] = []
     prev_p50, new_p50 = density_p50_s(prev), density_p50_s(new)
     if prev_p50 and new_p50 and new_p50 > prev_p50 * (1.0 + tolerance):
         problems.append(
