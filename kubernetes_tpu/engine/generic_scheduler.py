@@ -27,7 +27,9 @@ from kubernetes_tpu.api.policy import (Policy, default_provider,
                                        service_anti_affinity_labels)
 from kubernetes_tpu.cache.scheduler_cache import SchedulerCache
 from kubernetes_tpu.engine import devicestats
+from kubernetes_tpu.engine import guard as guard_mod
 from kubernetes_tpu.engine import solver as sv
+from kubernetes_tpu.engine.hostsolver import HostSolver
 from kubernetes_tpu.engine.extender_client import (ExtenderError,
                                                    ExtenderUnavailable,
                                                    HTTPExtender)
@@ -144,6 +146,14 @@ class GenericScheduler:
         # growth (sv.ResidentCluster).
         self.resident = sv.ResidentCluster()
         self.extenders = [HTTPExtender(cfg) for cfg in self.policy.extenders]
+        # Guarded device execution (engine/guard.py): every solve site
+        # runs inside the guard so accelerator faults classify, count,
+        # and recover (OOM -> evict + bisect, repeated/terminal -> the
+        # host fallback engine below) instead of stalling the drain.
+        self.guard = guard_mod.DeviceGuard(evict_fn=self.resident.invalidate)
+        # The NumPy fallback engine behind the same masks/evaluate/solve
+        # surface — slower than the device scan, always available.
+        self.host_solver = HostSolver(self.solver)
         self.last_node_index = np.uint32(0)
         # Monotonic compile state (features.padcap): table-axis capacities
         # and the OR of all content flags seen, so a long-running daemon
@@ -169,9 +179,15 @@ class GenericScheduler:
 
     # -- compilation helpers --------------------------------------------
 
-    def _compile(self, pods: list[api.Pod], device: bool = True
+    def _compile(self, pods: list[api.Pod], device: bool = True,
+                 host_only: bool = False
                  ) -> tuple[fb.PodBatch, sv.DeviceBatch,
                             sv.DeviceCluster, list[str]]:
+        """``host_only=True`` is the fallback engine's compile: the same
+        snapshot + feature compile, but NO device participation — the
+        cluster comes back as host numpy (``_host_cluster``) and the
+        dirty-row set is NOT consumed (it belongs to the device mirror,
+        which must replay every mutation when the breaker closes)."""
         from kubernetes_tpu.engine.workloads import topology
         # Topology keys named by spread constraints must be interned
         # BEFORE the snapshot so topo_dom columns exist for them (a NEW
@@ -220,6 +236,9 @@ class GenericScheduler:
                     pods, nt, self.cache.space,
                     self.cache.topo_domain_counts_bulk) \
                     if has_spread else None
+            if host_only:
+                return (batch, sv.host_batch(batch),
+                        sv._host_cluster(nt, agg, self.cache.space), nt)
             with stage("transfer", device=device):
                 # device=False keeps the batch pytree on host (the chunked
                 # drain slices it in numpy and transfers fixed-shape
@@ -239,29 +258,48 @@ class GenericScheduler:
     # -- single-pod path (Schedule, generic_scheduler.go:78) -------------
 
     def schedule(self, pod: api.Pod) -> str:
+        """One decision through the guarded device path; a classified
+        device fault (or an open breaker) decides the pod on the host
+        fallback engine instead — FitError semantics are identical on
+        both engines."""
+        if self.guard.enabled and self.guard.mode == "host":
+            return self._schedule_host(pod)
+        try:
+            return self._schedule_device(pod)
+        except guard_mod.DeviceFault as fault:
+            self.guard.recover(fault, can_bisect=False)
+            return self._schedule_host(pod)
+
+    def _schedule_device(self, pod: api.Pod) -> str:
         trace = Trace(f"Scheduling {pod.namespace}/{pod.name}")
         if not self.cache.nodes():
             raise FitError(pod, {})
-        with devicestats.live_path("single_pod"):
+        with devicestats.live_path("single_pod"), \
+                self.guard.watch("single_pod"):
             batch, db, dc, nt = self._compile([pod])
             trace.step("Computing predicates & priorities")
             feasible, scores = self.solver.evaluate(
                 db, dc, self._pinned_flags(batch))
-        topo_mask_np = None
-        if self._topo_terms is not None:
-            from kubernetes_tpu.engine.workloads import topology
-            tmask, tscore = topology.spread_planes(self._topo_terms,
-                                                   dc.topo_dom)
-            if tmask is not None:
-                feasible = feasible & tmask
-                topo_mask_np = np.asarray(tmask[0])
-            if tscore is not None:
-                scores = scores + tscore
-        trace.step("Selecting host")
-        feasible_np = np.asarray(feasible[0])
+            topo_mask_np = None
+            if self._topo_terms is not None:
+                from kubernetes_tpu.engine.workloads import topology
+                tmask, tscore = topology.spread_planes(self._topo_terms,
+                                                       dc.topo_dom)
+                if tmask is not None:
+                    feasible = feasible & tmask
+                    topo_mask_np = np.asarray(tmask[0])
+                if tscore is not None:
+                    scores = scores + tscore
+            trace.step("Selecting host")
+            feasible_np, _ = self.guard.checked_scores(
+                "single_pod", np.asarray(feasible[0]),
+                np.asarray(scores[0]))
         if not feasible_np.any():
-            masks = {k: np.asarray(v[0]) for k, v in
-                     self.solver.masks(db, dc).items()}
+            # The masks pass is device work too: a fault here must take
+            # the same classify -> host-fallback road as the evaluate.
+            with self.guard.watch("single_pod", inject=False):
+                masks = {k: np.asarray(v[0]) for k, v in
+                         self.solver.masks(db, dc).items()}
             if topo_mask_np is not None:
                 masks["TopologySpread"] = topo_mask_np
             failed: dict[str, list[str]] = {}
@@ -275,11 +313,13 @@ class GenericScheduler:
                 pod, nt, feasible_np, np.asarray(scores[0]))
             trace.log_if_long()
             return host
-        choice, new_last = sv.combine.select_hosts(
-            scores, feasible, jnp.uint32(self.last_node_index))
+        with self.guard.watch("single_pod", inject=False):
+            choice, new_last = sv.combine.select_hosts(
+                scores, feasible, jnp.uint32(self.last_node_index))
+            picked = int(choice[0])
         self.last_node_index = np.uint32(new_last)
         trace.log_if_long()
-        return nt.names[int(choice[0])]
+        return nt.names[picked]
 
     def _schedule_with_extenders(self, pod: api.Pod, nt,
                                  feasible_np: np.ndarray,
@@ -329,6 +369,100 @@ class GenericScheduler:
         self.last_node_index = np.uint32(int(self.last_node_index) + 1)
         return choice
 
+    # -- host fallback engine paths (engine/hostsolver.py) ----------------
+
+    def _compile_host(self, pods: list[api.Pod]):
+        """The fallback engine's compile: ``_compile`` with
+        ``host_only=True`` — ONE implementation of the snapshot/feature
+        sequence, so predicate and workload-constraint additions reach
+        both engines automatically (incl. ``self._topo_terms``, which
+        the host paths consume through ``topology.spread_planes_host``)."""
+        return self._compile(pods, host_only=True)
+
+    def _host_topo_planes(self, hc):
+        """(extra_mask, score_bias) numpy planes for the host solve —
+        the fallback must honor hard DoNotSchedule spread terms too
+        (quality may degrade on host, constraints may not)."""
+        if self._topo_terms is None:
+            return None, None
+        from kubernetes_tpu.engine.workloads import topology
+        return topology.spread_planes_host(self._topo_terms,
+                                           np.asarray(hc.topo_dom))
+
+    def _schedule_host(self, pod: api.Pod) -> str:
+        """The single-pod decision on the host fallback engine — same
+        FitError / extender / round-robin contract as the device path."""
+        trace = Trace(f"Scheduling {pod.namespace}/{pod.name} "
+                      f"(host engine)")
+        if not self.cache.nodes():
+            raise FitError(pod, {})
+        metrics.SOLVE_FALLBACKS.labels(mode="host").inc()
+        batch, hb, hc, nt = self._compile_host([pod])
+        trace.step("Computing predicates & priorities (host)")
+        feasible, scores = self.host_solver.evaluate(hb, hc)
+        extra_mask, score_bias = self._host_topo_planes(hc)
+        topo_mask_np = None
+        if extra_mask is not None:
+            feasible = feasible & extra_mask
+            topo_mask_np = extra_mask[0]
+        if score_bias is not None:
+            scores = scores + score_bias
+        feasible_np, scores_np = feasible[0], scores[0]
+        trace.step("Selecting host")
+        if not feasible_np.any():
+            masks = {k: m[0] for k, m in
+                     self.host_solver.masks(hb, hc).items()}
+            if topo_mask_np is not None:
+                masks["TopologySpread"] = topo_mask_np
+            failed: dict[str, list[str]] = {}
+            for i, name in enumerate(nt.names):
+                if nt.schedulable[i]:
+                    failed[name] = [p for p, m in masks.items()
+                                    if not m[i]]
+            trace.log_if_long()
+            raise FitError(pod, failed)
+        if self.extenders:
+            host = self._schedule_with_extenders(
+                pod, nt, feasible_np, scores_np.astype(np.float32))
+            trace.log_if_long()
+            return host
+        # selectHost round-robin (combine.select_hosts, host-side).
+        masked = np.where(feasible_np, scores_np, -np.inf)
+        ties = feasible_np & (masked == masked.max())
+        ix = int(self.last_node_index) % int(ties.sum())
+        choice = int(np.nonzero(ties)[0][ix])
+        self.last_node_index = np.uint32(int(self.last_node_index) + 1)
+        trace.log_if_long()
+        return nt.names[choice]
+
+    def schedule_batch_host(self, pods: list[api.Pod]) -> list[str | None]:
+        """The host fallback drain: ``schedule_batch``'s contract (node
+        names, None where unschedulable) on the NumPy sequential-greedy
+        engine.  No padding, no buckets, no device — and its output
+        still runs through the sanity gate, so both engines bind under
+        the same guarantees."""
+        if not pods:
+            return []
+        if not self.cache.nodes():
+            return [None] * len(pods)
+        if self.extenders:
+            return self._schedule_batch_via_extenders(pods)
+        metrics.SOLVE_FALLBACKS.labels(mode="host").inc()
+        self._agg_handoff = None
+        batch, hb, hc, nt = self._compile_host(pods)
+        extra_mask, score_bias = self._host_topo_planes(hc)
+        with stage("solve", pods=len(pods), mode="host"):
+            choices, counter = self.host_solver.solve_greedy(
+                hb, hc, int(self.last_node_index),
+                extra_mask=extra_mask, score_bias=score_bias)
+        choices = self.guard.checked_readback(
+            "host", choices, len(nt.names),
+            alloc=nt.alloc, requests=np.asarray(batch.request),
+            keys_fn=lambda: [p.key for p in pods])
+        self.last_node_index = np.uint32(counter)
+        names = nt.names
+        return [names[int(c)] if c >= 0 else None for c in choices]
+
     # -- batched path ----------------------------------------------------
 
     def schedule_batch(self, pods: list[api.Pod],
@@ -360,12 +494,14 @@ class GenericScheduler:
             # restore (callers re-assume through the daemon).
             return self._schedule_batch_via_extenders(pods)
         real_p = len(pods)
-        live = None
+        live = live_np = None
         if pad_to > real_p:
             pods = list(pods) + [
                 api.Pod(name=f"__pad-{i}", namespace="__pad__")
                 for i in range(pad_to - real_p)]
-        batch, db, dc, nt = self._compile(pods)
+        with self.guard.watch("oneshot" if not joint else "joint",
+                              inject=False):
+            batch, db, dc, nt = self._compile(pods)
         flags = self._pinned_flags(batch)
         if pad_to > real_p:
             live_np = np.zeros(len(pods), bool)
@@ -386,6 +522,7 @@ class GenericScheduler:
         if joint:
             with devicestats.live_path("joint"), \
                     device_trace("solve_joint"), \
+                    self.guard.watch("joint"), \
                     stage("solve", pods=len(pods), mode="joint"):
                 choices, new_last, _ = self.solver.solve_joint(
                     db, dc, jnp.uint32(self.last_node_index), flags=flags,
@@ -393,8 +530,13 @@ class GenericScheduler:
                     live=live)
                 choices.block_until_ready()
             with stage("readback", pods=len(pods)):
-                choices_np = np.asarray(choices)
+                with self.guard.watch("joint", inject=False):
+                    choices_np = np.asarray(choices)
                 devicestats.record_transfer("readback", choices_np.nbytes)
+                choices_np = self.guard.checked_readback(
+                    "joint", choices_np, dc.alloc.shape[0], live=live_np,
+                    alloc=nt.alloc, requests=np.asarray(batch.request),
+                    keys_fn=lambda: [pd.key for pd in pods[:real_p]])
                 rows = choices_np[:real_p].tolist()
             self.last_node_index = np.uint32(new_last)
         else:
@@ -404,6 +546,7 @@ class GenericScheduler:
             p, n = len(pods), dc.alloc.shape[0]
             with devicestats.live_path("oneshot"), \
                     device_trace("solve_sequential"), \
+                    self.guard.watch("oneshot"), \
                     stage("solve", pods=p, mode="sequential"):
                 host_dev = self.solver.solve_sequential_packed(
                     db, dc, jnp.uint32(self.last_node_index), flags,
@@ -413,9 +556,14 @@ class GenericScheduler:
                 # and readback measures only the D2H copy.
                 host_dev.block_until_ready()
             with stage("readback", pods=p):
-                host = np.asarray(host_dev)
+                with self.guard.watch("oneshot", inject=False):
+                    host = np.asarray(host_dev)
                 devicestats.record_transfer("readback", host.nbytes)
-            rows = host[:real_p].tolist()
+            choices_np = self.guard.checked_readback(
+                "oneshot", host[:p], n, live=live_np, alloc=nt.alloc,
+                requests=np.asarray(batch.request),
+                keys_fn=lambda: [pd.key for pd in pods[:real_p]])
+            rows = choices_np[:real_p].tolist()
             self.last_node_index = np.uint32(host[p])
             # Device-aggregate handoff: the scan's final requested/nonzero
             # equal the snapshot plus every in-batch placement, so
@@ -562,6 +710,16 @@ class GenericScheduler:
                                         vt.valid.copy())
         vic_keys = [list(k) for k in vt.keys]
         decisions = []
+        with devicestats.live_path("victim"), self.guard.watch("victim"):
+            self._find_preemptions_inner(
+                pods, alloc, requested, base, vic_req, vic_prio,
+                vic_valid, vic_keys, nt, decisions)
+        return decisions
+
+    def _find_preemptions_inner(self, pods, alloc, requested, base,
+                                vic_req, vic_prio, vic_valid, vic_keys,
+                                nt, decisions) -> None:
+        from kubernetes_tpu.engine.workloads import preemption as pre
         for i, pod in enumerate(pods):
             pod_req = fc.pod_resource_row(pod)
             k_min, cost, feas = pre.victim_solve(
@@ -594,7 +752,6 @@ class GenericScheduler:
                 vic_valid[n_idx] = np.concatenate(
                     [vic_valid[n_idx, k:], np.zeros(k, bool)])
                 vic_keys[n_idx] = vic_keys[n_idx][k:]
-        return decisions
 
     def schedule_batch_stream(self, pods: list[api.Pod],
                               chunk_size: int = 2048,
@@ -637,7 +794,8 @@ class GenericScheduler:
             all_pods += [api.Pod(name=f"__pad-{i}", namespace="__pad__")
                          for i in range(padded - p)]
         t_c0 = time.perf_counter()
-        batch, hb, dc, nt = self._compile(all_pods, device=False)
+        with self.guard.watch("stream", inject=False):
+            batch, hb, dc, nt = self._compile(all_pods, device=False)
         flags = self._pinned_flags(batch)
         # Spread-constraint planes, host-resident like the batch: each
         # chunk device_puts its fixed-shape row slice (pad rows carry no
@@ -672,10 +830,20 @@ class GenericScheduler:
 
         def emit(start: int, choices) -> tuple[list, list]:
             with stage("readback", chunk_at=start):
-                rows = np.asarray(choices)  # blocks only on this chunk
+                with self.guard.watch("stream", inject=False):
+                    rows = np.asarray(choices)  # blocks on this chunk
                 devicestats.record_transfer("readback", rows.nbytes)
             stop = min(start + chunk_size, p)
             chunk_pods = pods[start:stop]
+            # Post-solve sanity gate: a corrupt chunk readback requeues
+            # the chunk (DeviceFault through the commit worker) instead
+            # of binding garbage.
+            rows = self.guard.checked_readback(
+                "stream", rows, n,
+                live=live_np[start:start + chunk_size],
+                alloc=nt.alloc,
+                requests=np.asarray(hb.request)[start:start + chunk_size],
+                keys_fn=lambda: [pd.key for pd in chunk_pods])
             placements = [nt.names[int(c)] if c >= 0 else None
                           for c in rows[: stop - start]]
             return chunk_pods, placements
@@ -700,6 +868,7 @@ class GenericScheduler:
             # overlapped — this stage measures dispatch only.
             with devicestats.live_path("stream"), \
                     device_trace("solve_stream_chunk"), \
+                    self.guard.watch("stream"), \
                     stage("solve", chunk_at=start, mode="stream"):
                 choices_k, counter, carry = self.solver._solve_scan(
                     db_k, dc, counter, sb_k, flags, carry, live, em_k)

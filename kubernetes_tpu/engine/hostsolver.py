@@ -1,0 +1,422 @@
+"""The host fallback engine: sequential-greedy scheduling in pure NumPy.
+
+When the device guard's circuit breaker opens (``engine/guard.py``), the
+control plane must KEEP SCHEDULING — the whole premise of trusting a TPU
+with scheduling decisions is that losing the TPU degrades throughput,
+not availability.  ``HostSolver`` is that degraded mode: a sequential-
+greedy solver grown from ``oracle.py``'s reference semantics, but
+consuming the SAME host tensor trees the device solver does
+(``solver.host_batch`` + ``solver._host_cluster``), behind the same
+masks/evaluate/solve surface — so the daemon's commit path, gang
+reduction, and flight recorder run unchanged on its output.
+
+Semantics relative to the device scan:
+
+* **Exact** for the families the greedy loop tracks in-batch: resources
+  (requested/nonzero/pod-count), host ports, volume conflicts, node
+  selector/affinity-required, taints, memory/disk pressure, host
+  pinning, node-label policy predicates, and the LeastRequested /
+  MostRequested / BalancedResourceAllocation dynamic priorities —
+  byte-for-byte ports of the formulas in ``ops/predicates.py`` and
+  ``ops/priorities.py`` (incl. the reference's int-truncation
+  arithmetic), pinned by the oracle-parity tests in
+  tests/test_device_faults.py.
+* **Batch-start** for the remaining planes (inter-pod affinity, PD
+  volume counts, selector spread, service anti-affinity, and the
+  topology-spread hard/soft planes, which the engine feeds in through
+  ``topology.spread_planes_host`` exactly as the device one-shot path
+  feeds ``spread_planes``): their masks and scores are computed once
+  against the pre-batch cluster state and held fixed through the
+  batch, like the device scan does for batches whose flags show no
+  such content.  This can cost placement QUALITY mid-batch, never
+  drop a hard constraint that held at batch start — and there is no
+  resource overcommit, no port conflict, no out-of-range index; every
+  host placement passes the post-solve sanity gate.
+
+The solver is O(P·N·vocab) NumPy per drain — orders of magnitude slower
+than the device scan at density scale, and always available.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubernetes_tpu.features.compiler import RES_CPU, RES_MEM, RES_PODS
+
+_MIN_IMG_KIB = 23 * 1024
+_MAX_IMG_KIB = 1000 * 1024
+
+
+def _trunc(x: np.ndarray) -> np.ndarray:
+    """Go's int(float) truncation with the same epsilon guard the device
+    kernels use (ops/priorities._trunc)."""
+    return np.trunc(np.asarray(x, np.float64) + 1e-5)
+
+
+def _overlap(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """[P,C] x [N,C] bool -> [P,N] any-shared-member."""
+    if a.size == 0 or b.size == 0:
+        return np.zeros((a.shape[0], b.shape[0]), bool)
+    return (a.astype(np.float32) @ b.astype(np.float32).T) > 0.0
+
+
+def _unused_score(requested, capacity):
+    safe = np.maximum(capacity, 1)
+    score = ((capacity - requested) * 10) // safe
+    return np.where((capacity == 0) | (requested > capacity), 0, score)
+
+
+def _used_score(requested, capacity):
+    safe = np.maximum(capacity, 1)
+    score = (requested * 10) // safe
+    return np.where((capacity == 0) | (requested > capacity), 0, score)
+
+
+class HostSolver:
+    """NumPy mirror of ``solver.Solver`` for the policy's predicate and
+    priority lists (shared with the device Solver instance so the two
+    engines can never schedule by different policies)."""
+
+    # Predicates whose masks the greedy loop recomputes per placement.
+    TRACKED_PREDICATES = ("PodFitsResources", "PodFitsHostPorts",
+                          "PodFitsPorts", "NoDiskConflict")
+    TRACKED_PRIORITIES = ("LeastRequestedPriority", "MostRequestedPriority",
+                          "BalancedResourceAllocation")
+
+    def __init__(self, solver):
+        self.solver = solver  # the compiled-policy Solver (names/weights)
+
+    # -- predicate masks (batch-start state) -------------------------------
+
+    def _predicate_mask(self, name: str, b, c, n: int) -> np.ndarray:
+        p = b.request.shape[0]
+        vs, a = b.volsvc, b.aff
+        if name in ("PodFitsHost", "HostName"):
+            ids = np.arange(n, dtype=np.int64)[None, :]
+            hi = np.asarray(b.host_idx)[:, None]
+            return (hi == -1) | (hi == ids)
+        if name == "MatchNodeSelector":
+            return np.asarray(b.sel_required)[np.asarray(b.sel_group)]
+        if name == "PodToleratesNodeTaints":
+            matched = ~_overlap(~np.asarray(b.tol_nosched),
+                                np.asarray(c.taints_nosched))
+            ok = np.asarray(b.has_tolerations)[:, None] & matched
+            return ~np.asarray(c.has_taints)[None, :] | ok
+        if name == "CheckNodeMemoryPressure":
+            return ~(np.asarray(b.best_effort)[:, None] &
+                     np.asarray(c.mem_pressure)[None, :])
+        if name == "CheckNodeDiskPressure":
+            return np.broadcast_to(~np.asarray(c.disk_pressure)[None, :],
+                                   (p, n))
+        if name == "NewNodeLabelPredicate":
+            return np.broadcast_to(np.asarray(vs.nl_pred_row)[None, :],
+                                   (p, n))
+        if name == "NoVolumeZoneConflict":
+            return np.asarray(vs.vz_mask)[np.asarray(vs.vz_group)]
+        if name == "ServiceAffinity":
+            return np.asarray(vs.sa_mask)[np.asarray(vs.sa_group)]
+        if name == "PodFitsResources":
+            return self._fits_resources(
+                np.asarray(b.request), np.asarray(b.zero_request),
+                np.asarray(c.alloc), np.asarray(c.requested))
+        if name in ("PodFitsHostPorts", "PodFitsPorts"):
+            return ~_overlap(np.asarray(b.ports), np.asarray(c.ports_used))
+        if name == "NoDiskConflict":
+            return ~(_overlap(np.asarray(b.vol_rw), np.asarray(c.vol_any)) |
+                     _overlap(np.asarray(b.vol_ro), np.asarray(c.vol_rw)))
+        if name in ("MaxEBSVolumeCount", "MaxGCEPDVolumeCount"):
+            fam = "ebs" if name == "MaxEBSVolumeCount" else "gce"
+            return self._max_pd(
+                np.asarray(getattr(vs, f"pd_pod_{fam}")),
+                np.asarray(getattr(vs, f"pd_extra_{fam}")),
+                np.asarray(getattr(vs, f"pd_node_{fam}")),
+                np.asarray(getattr(vs, f"pd_node_extra_{fam}")),
+                np.asarray(getattr(vs, f"pd_node_err_{fam}")),
+                self.solver.extra[f"max_{fam}"])
+        if name == "MatchInterPodAffinity":
+            reach = np.asarray(a.match_cnt) > 0.0            # [Sm,N]
+            live = np.asarray(a.aff_need) & ~(
+                np.asarray(a.aff_self) &
+                (np.asarray(a.match_total) == 0.0)[None, :])
+            f32 = np.float32
+            violate = (live.astype(f32) @ (~reach).astype(f32) +
+                       np.asarray(a.anti_need).astype(f32) @
+                       reach.astype(f32) +
+                       np.asarray(a.decl_match).astype(f32) @
+                       np.asarray(a.decl_reach).astype(f32)) > 0
+            return ~violate
+        return np.ones((p, n), bool)  # unknown/passthrough: never block
+
+    @staticmethod
+    def _fits_resources(request, zero_request, alloc, requested):
+        fits_pods = (requested[:, RES_PODS] + 1) <= alloc[:, RES_PODS]
+        free = alloc[None, :, :3] - requested[None, :, :3]
+        fits_res = np.all(request[:, None, :3] <= free, axis=-1)
+        return fits_pods[None, :] & (zero_request[:, None] | fits_res)
+
+    @staticmethod
+    def _max_pd(pod_pd, pod_extra, node_pd, node_extra, node_err,
+                max_volumes):
+        f32 = np.float32
+        if pod_pd.shape[1] == 0:
+            overlap = np.zeros((pod_pd.shape[0], node_pd.shape[0]), f32)
+        else:
+            overlap = pod_pd.astype(f32) @ node_pd.astype(f32).T
+        existing = node_pd.astype(f32).sum(1) + node_extra.astype(f32)
+        new = pod_pd.astype(f32).sum(1) + pod_extra.astype(f32)
+        total = existing[None, :] + new[:, None] - overlap
+        ok = (total <= f32(max_volumes)) & ~node_err[None, :]
+        return (new[:, None] == 0) | ok
+
+    def masks(self, b, c) -> dict[str, np.ndarray]:
+        """Per-predicate [P,N] masks against batch-start state (the
+        FitError / failure-detail surface, mirroring Solver.masks)."""
+        n = int(np.asarray(c.alloc).shape[0])
+        return {name: self._predicate_mask(name, b, c, n)
+                for name in self.solver.predicate_names}
+
+    # -- priority planes ----------------------------------------------------
+
+    def _priority_plane(self, name: str, b, c, n: int, aux: int,
+                        requested=None, nonzero=None) -> np.ndarray:
+        """One [P,N] score plane.  ``requested``/``nonzero`` override the
+        cluster aggregates for the tracked dynamic priorities."""
+        p = b.request.shape[0]
+        vs, a = b.volsvc, b.aff
+        alloc = np.asarray(c.alloc)
+        sched = np.asarray(c.schedulable)
+        nz = nonzero if nonzero is not None else np.asarray(c.nonzero)
+        if name in ("LeastRequestedPriority", "MostRequestedPriority"):
+            total = np.asarray(b.nonzero)[:, None, :] + nz[None, :, :]
+            fn = _unused_score if name == "LeastRequestedPriority" \
+                else _used_score
+            cpu = fn(total[..., 0], alloc[None, :, RES_CPU])
+            mem = fn(total[..., 1], alloc[None, :, RES_MEM])
+            return ((cpu + mem) // 2).astype(np.float64)
+        if name == "BalancedResourceAllocation":
+            total = (np.asarray(b.nonzero)[:, None, :] +
+                     nz[None, :, :]).astype(np.float64)
+            cap_c = alloc[None, :, RES_CPU].astype(np.float64)
+            cap_m = alloc[None, :, RES_MEM].astype(np.float64)
+            cf = np.where(cap_c == 0, 1.0,
+                          total[..., 0] / np.maximum(cap_c, 1))
+            mf = np.where(cap_m == 0, 1.0,
+                          total[..., 1] / np.maximum(cap_m, 1))
+            score = _trunc(10.0 - np.abs(cf - mf) * 10.0)
+            return np.where((cf >= 1.0) | (mf >= 1.0), 0.0, score)
+        if name == "NodeAffinityPriority":
+            counts = np.asarray(b.sel_pref_counts)[
+                np.asarray(b.sel_group)].astype(np.float64)
+            mx = np.max(np.where(sched[None, :], counts, 0.0), axis=1,
+                        keepdims=True)
+            score = _trunc(10.0 * counts / np.maximum(mx, 1e-9))
+            return np.where(mx > 0, score, 0.0)
+        if name == "TaintTolerationPriority":
+            counts = (~np.asarray(b.tol_prefer)).astype(np.float32) @ \
+                np.asarray(c.taints_prefer).astype(np.float32).T
+            mx = np.max(np.where(sched[None, :], counts, 0.0), axis=1,
+                        keepdims=True)
+            score = _trunc((1.0 - counts / np.maximum(mx, 1e-9)) * 10.0)
+            return np.where(mx > 0, score, 10.0)
+        if name == "ImageLocalityPriority":
+            sums = (np.asarray(b.images).astype(np.float32) @
+                    np.asarray(c.image_kib).astype(np.float32).T
+                    ).astype(np.int64)
+            clamped = np.minimum(sums, _MAX_IMG_KIB)
+            mid = (10 * (clamped - _MIN_IMG_KIB)) // \
+                (_MAX_IMG_KIB - _MIN_IMG_KIB) + 1
+            return np.where(sums < _MIN_IMG_KIB, 0,
+                            np.where(sums >= _MAX_IMG_KIB, 10, mid)
+                            ).astype(np.float64)
+        if name == "NodePreferAvoidPodsPriority":
+            return np.where(np.asarray(b.avoid_rows)[
+                np.asarray(b.avoid_group)], 0.0, 10.0)
+        if name in ("SelectorSpreadPriority", "ServiceSpreadingPriority"):
+            counts = np.asarray(b.spread_node_counts)[
+                np.asarray(b.spread_group)].astype(np.float64)
+            mx = np.max(np.where(sched[None, :], counts, 0.0), axis=1,
+                        keepdims=True)
+            f = np.where(mx > 0,
+                         10.0 * (mx - counts) / np.maximum(mx, 1e-9),
+                         10.0)
+            zc = np.asarray(b.spread_zone_counts)[
+                np.asarray(b.spread_group)].astype(np.float64)
+            has_zones = np.asarray(b.spread_has_zones)[
+                np.asarray(b.spread_group)][:, None]
+            zid = np.asarray(b.node_zone_id)
+            node_has_zone = zid >= 0
+            zcounts = np.take_along_axis(
+                zc, np.clip(zid, 0, None)[None, :].repeat(zc.shape[0], 0),
+                axis=1)
+            zcounts = np.where(node_has_zone[None, :], zcounts, 0.0)
+            mz = np.max(zc, axis=1, keepdims=True)
+            zscore = 10.0 * (mz - zcounts) / np.maximum(mz, 1e-9)
+            blended = f / 3.0 + (2.0 / 3.0) * zscore
+            f = np.where(has_zones & node_has_zone[None, :] & (mz > 0),
+                         blended, f)
+            return _trunc(f)
+        if name == "InterPodAffinityPriority":
+            f32 = np.float32
+            own = np.asarray(a.pref_w).astype(f32) @ \
+                np.asarray(a.match_cnt).astype(f32)
+            sym = (np.asarray(a.sym_match).astype(f32) *
+                   np.asarray(a.sym_w).astype(f32)[None, :]) @ \
+                np.asarray(a.sym_cnt).astype(f32)
+            counts = (own + sym).astype(np.float64)
+            neg, pos = -np.inf, np.inf
+            mx = np.maximum(np.max(np.where(sched[None, :], counts, neg),
+                                   axis=1), 0.0)
+            mn = np.minimum(np.min(np.where(sched[None, :], counts, pos),
+                                   axis=1), 0.0)
+            denom = (mx - mn)[:, None]
+            score = _trunc(10.0 * (counts - mn[:, None]) /
+                           np.maximum(denom, 1e-9))
+            return np.where(denom > 0, score, 0.0)
+        if name == "NodeLabelPriority":
+            row = np.asarray(vs.nl_prio_rows)[aux]
+            return np.broadcast_to(np.where(row, 10.0, 0.0)[None, :],
+                                   (p, n)).copy()
+        if name == "ServiceAntiAffinityPriority":
+            cnt = np.asarray(vs.saa_cnt)[aux][
+                np.asarray(vs.saa_group)].astype(np.float64)     # [P,D]
+            num = np.asarray(vs.saa_num)[
+                np.asarray(vs.saa_group)].astype(np.float64)[:, None]
+            dom = np.asarray(vs.saa_dom)[aux]                    # [N]
+            labeled = np.asarray(vs.saa_labeled)[aux]            # [N]
+            per = np.take(cnt, np.clip(dom, 0, None), axis=1)
+            score = np.where(num > 0.0,
+                             _trunc(10.0 * (num - per) /
+                                    np.maximum(num, 1.0)),
+                             10.0)
+            return np.where(labeled[None, :], score, 0.0)
+        if name == "EqualPriority":
+            return np.ones((p, n), np.float64)
+        return np.zeros((p, n), np.float64)  # unknown: contribute nothing
+
+    # -- the evaluate / solve surface ---------------------------------------
+
+    def evaluate(self, b, c) -> tuple[np.ndarray, np.ndarray]:
+        """(feasible [P,N], scores [P,N]) against batch-start state —
+        the host mirror of Solver.evaluate."""
+        n = int(np.asarray(c.alloc).shape[0])
+        p = b.request.shape[0]
+        feasible = np.broadcast_to(np.asarray(c.schedulable)[None, :],
+                                   (p, n)).copy()
+        for name in self.solver.predicate_names:
+            feasible &= self._predicate_mask(name, b, c, n)
+        scores = np.zeros((p, n), np.float64)
+        for name, weight, aux in self.solver.priority_specs:
+            scores += float(weight) * self._priority_plane(name, b, c, n,
+                                                           aux)
+        return feasible, scores
+
+    @staticmethod
+    def _tracked_score(name: str, pod_nz: np.ndarray, nonzero: np.ndarray,
+                       alloc: np.ndarray) -> np.ndarray:
+        """One pod's [N] row of a tracked dynamic priority against the
+        CURRENT (in-batch) aggregates — the per-step recompute the
+        device scan does inside lax.scan."""
+        total = pod_nz[None, :] + nonzero                     # [N,2]
+        if name in ("LeastRequestedPriority", "MostRequestedPriority"):
+            fn = _unused_score if name == "LeastRequestedPriority" \
+                else _used_score
+            cpu = fn(total[:, 0], alloc[:, RES_CPU])
+            mem = fn(total[:, 1], alloc[:, RES_MEM])
+            return ((cpu + mem) // 2).astype(np.float64)
+        # BalancedResourceAllocation
+        totalf = total.astype(np.float64)
+        cap_c = alloc[:, RES_CPU].astype(np.float64)
+        cap_m = alloc[:, RES_MEM].astype(np.float64)
+        cf = np.where(cap_c == 0, 1.0, totalf[:, 0] / np.maximum(cap_c, 1))
+        mf = np.where(cap_m == 0, 1.0, totalf[:, 1] / np.maximum(cap_m, 1))
+        score = _trunc(10.0 - np.abs(cf - mf) * 10.0)
+        return np.where((cf >= 1.0) | (mf >= 1.0), 0.0, score)
+
+    def solve_greedy(self, b, c, last_node_index: int,
+                     live=None, extra_mask=None, score_bias=None
+                     ) -> tuple[np.ndarray, int]:
+        """Sequential greedy placement with in-batch visibility for the
+        tracked families — the host mirror of ``Solver._solve_scan``'s
+        contract: (choices [P] int32 or -1, advanced tie counter)."""
+        n = int(np.asarray(c.alloc).shape[0])
+        p = b.request.shape[0]
+        request = np.asarray(b.request)
+        zero_request = np.asarray(b.zero_request)
+        b_nonzero = np.asarray(b.nonzero)
+        ports = np.asarray(b.ports)
+        vol_ro, vol_rw = np.asarray(b.vol_ro), np.asarray(b.vol_rw)
+        alloc = np.asarray(c.alloc)
+        # Tracked dynamic state (copied: the caller's arrays are the
+        # cache's snapshot views).
+        requested = np.asarray(c.requested).copy()
+        nonzero = np.asarray(c.nonzero).copy()
+        ports_used = np.asarray(c.ports_used).copy()
+        vol_any = np.asarray(c.vol_any).copy()
+        c_vol_rw = np.asarray(c.vol_rw).copy()
+        # Static plane: every predicate EXCEPT the tracked ones, plus
+        # the batch-start score of every untracked priority.
+        static_mask = np.broadcast_to(np.asarray(c.schedulable)[None, :],
+                                      (p, n)).copy()
+        for name in self.solver.predicate_names:
+            if name not in self.TRACKED_PREDICATES:
+                static_mask &= self._predicate_mask(name, b, c, n)
+        if live is not None:
+            static_mask &= np.asarray(live, bool)[:, None]
+        if extra_mask is not None:
+            static_mask &= np.asarray(extra_mask, bool)
+        static_score = np.zeros((p, n), np.float64)
+        if score_bias is not None:
+            static_score += np.asarray(score_bias, np.float64)
+        dynamic_prios = []
+        for name, weight, aux in self.solver.priority_specs:
+            if name in self.TRACKED_PRIORITIES:
+                dynamic_prios.append((name, weight, aux))
+            else:
+                static_score += float(weight) * self._priority_plane(
+                    name, b, c, n, aux)
+        use_resources = "PodFitsResources" in self.solver.predicate_names
+        use_ports = any(nm in self.solver.predicate_names for nm in
+                        ("PodFitsHostPorts", "PodFitsPorts")) and \
+            bool(ports.size)
+        use_volumes = "NoDiskConflict" in self.solver.predicate_names \
+            and bool(vol_ro.size or vol_rw.size)
+        choices = np.full(p, -1, np.int32)
+        counter = int(last_node_index) & 0xFFFFFFFF
+        for i in range(p):
+            feasible = static_mask[i].copy()
+            if use_resources:
+                fits_pods = (requested[:, RES_PODS] + 1) <= \
+                    alloc[:, RES_PODS]
+                fits = np.all(request[i, :3][None, :] <=
+                              (alloc[:, :3] - requested[:, :3]), axis=1)
+                feasible &= fits_pods & (bool(zero_request[i]) | fits)
+            if use_ports and ports[i].any():
+                feasible &= ~(ports_used[:, ports[i]].any(axis=1))
+            if use_volumes and (vol_rw[i].any() or vol_ro[i].any()):
+                conflict = vol_any[:, vol_rw[i]].any(axis=1) | \
+                    c_vol_rw[:, vol_ro[i]].any(axis=1)
+                feasible &= ~conflict
+            if not feasible.any():
+                continue
+            score = static_score[i].copy()
+            for name, weight, _aux in dynamic_prios:
+                score += float(weight) * self._tracked_score(
+                    name, b_nonzero[i], nonzero, alloc)
+            # selectHost: round-robin among max-score feasible nodes;
+            # the counter bumps only on success (combine.select_hosts).
+            masked = np.where(feasible, score, -np.inf)
+            ties = feasible & (masked == masked.max())
+            n_ties = int(ties.sum())
+            ix = counter % n_ties
+            choice = int(np.nonzero(ties)[0][ix])
+            choices[i] = choice
+            counter = (counter + 1) & 0xFFFFFFFF
+            # Commit: the batched AssumePod.
+            requested[choice] += request[i]
+            nonzero[choice] += b_nonzero[i]
+            if use_ports:
+                ports_used[choice] |= ports[i]
+            if use_volumes:
+                vol_any[choice] |= vol_rw[i] | vol_ro[i]
+                c_vol_rw[choice] |= vol_rw[i]
+        return choices, counter

@@ -183,3 +183,33 @@ def spread_planes(terms: SpreadTerms, topo_dom: jnp.ndarray
         jnp.asarray(terms.valid), jnp.asarray(terms.src), topo_dom)
     return (mask if terms.any_hard else None,
             score if terms.any_soft else None)
+
+
+def spread_planes_host(terms: SpreadTerms, topo_dom
+                       ) -> tuple[Optional["np.ndarray"],
+                                  Optional["np.ndarray"]]:
+    """``spread_planes`` in pure NumPy — the host fallback engine
+    (engine/hostsolver.py) must honor hard DoNotSchedule terms with the
+    device gone, so this mirrors ``_planes_kernel`` line for line on
+    host arrays."""
+    if terms is None:
+        return None, None
+    f32 = np.float32
+    topo_dom = np.asarray(topo_dom)
+    dom_tn = topo_dom[:, terms.key_col].T                   # [T, N]
+    cnt_tn = np.take_along_axis(terms.counts,
+                                np.clip(dom_tn, 0, None), axis=1)
+    big = f32(1e9)
+    min_t = np.min(np.where(terms.valid, terms.counts, big), axis=1)
+    min_t = np.where(min_t >= big, 0.0, min_t)
+    has = dom_tn >= 0
+    ok = (cnt_tn + 1.0 - min_t[:, None]) <= terms.max_skew[:, None]
+    viol_tn = ((~has) | ~ok).astype(f32)
+    hard_viol = viol_tn * terms.hard.astype(f32)[:, None]
+    srcf = terms.src.astype(f32)                            # [P, T]
+    mask = (srcf @ hard_viol) < 0.5
+    soft_tn = np.where((~terms.hard)[:, None] & has,
+                       -(cnt_tn - min_t[:, None]), 0.0)
+    score = srcf @ soft_tn
+    return (mask if terms.any_hard else None,
+            score if terms.any_soft else None)

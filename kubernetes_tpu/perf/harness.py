@@ -161,6 +161,14 @@ def density(num_nodes: int, num_pods: int, profile: str = "uniform",
         device = _steady_state_device_window(daemon, wave_pods, wave_n,
                                              quiet=quiet)
     device["post_prewarm_compiles"] = compiles()
+    # Device fault-tolerance columns: a density run must end on the
+    # device engine with zero sanity-gate-rejected binds — either
+    # failing means the run benched the fallback path, not the product
+    # (tools/check_bench.check_device fails tier-1 on both).
+    from kubernetes_tpu.utils import metrics as metrics_mod
+    device["engine_mode_final"] = daemon.config.algorithm.guard.mode
+    device["sanity_rejected_binds"] = \
+        int(metrics_mod.GATE_REJECTED_BINDS.value)
     stages = stage_breakdown(stages_before, _stage_snapshot())
     scheduled = daemon.config.binder.count() - device.pop("_steady_bound")
     if not quiet:

@@ -48,11 +48,25 @@ import numpy as np
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.apiserver.memstore import MemStore
-from kubernetes_tpu.chaos import (ChaosProxy, bind_conflict_storm,
-                                  heartbeat_drop, watch_cut_on_relist)
+from kubernetes_tpu.chaos import (ChaosProxy, DeviceChaos, DeviceRule,
+                                  bind_conflict_storm, heartbeat_drop,
+                                  watch_cut_on_relist)
+from kubernetes_tpu.chaos import device as chaos_device
 from kubernetes_tpu.client.http import APIClient
 from kubernetes_tpu.scheduler.backoff import PodBackoff
 from kubernetes_tpu.utils import metrics
+
+
+def _labeled_snapshot(counter) -> dict[str, int]:
+    """{label: value} for a single-label counter family."""
+    return {key[0]: int(child.value)
+            for key, child in counter.children().items()}
+
+
+def _labeled_delta(counter, before: dict[str, int]) -> dict[str, int]:
+    now = _labeled_snapshot(counter)
+    out = {k: v - before.get(k, 0) for k, v in now.items()}
+    return {k: v for k, v in out.items() if v}
 
 # The fleet bench this soak is scaled against (perf/harness.fleet_metrics:
 # 500 hollow nodes drive 2,000 replicas to Running once).
@@ -200,6 +214,7 @@ def run_soak(n_nodes: int = 2000, duration_s: float = 60.0,
              rolling_waves: int = 4, wave_size: int = 1000,
              drain_nodes: int = 40, kill_burst: int = 3000,
              restart: bool = True, chaos: bool = True,
+             device_chaos: bool = True, device_oom_nth: int = 6,
              high_watermark: int = 3000, stream_chunk: int = 4096,
              heartbeat_period: float = 1.0, verify_period: float = 2.0,
              settle_timeout: float = 300.0, parity_samples: int = 50,
@@ -237,10 +252,19 @@ def run_soak(n_nodes: int = 2000, duration_s: float = 60.0,
     sampler = _QueueSampler()
     saved_env = {k: os.environ.get(k)
                  for k in ("KT_PREWARM", "KT_VERIFY_PERIOD",
-                           "KT_RECOVERY")}
+                           "KT_RECOVERY", "KT_GUARD_PROBE_S")}
     os.environ["KT_PREWARM"] = "1"
     os.environ["KT_VERIFY_PERIOD"] = str(verify_period)
     os.environ["KT_RECOVERY"] = "1"
+    # Fast device probes: the device-lost wave must demonstrate the
+    # full breaker arc (host fallback -> probe -> re-promotion) inside
+    # the scenario window.
+    os.environ["KT_GUARD_PROBE_S"] = "1.0"
+    device_chaos = device_chaos and chaos
+    dev_faults_before = _labeled_snapshot(metrics.DEVICE_FAULTS)
+    fallbacks_before = _labeled_snapshot(metrics.SOLVE_FALLBACKS)
+    gate_rejects_before = metrics.GATE_REJECTS.value
+    rejected_binds_before = metrics.GATE_REJECTED_BINDS.value
     factory = None
     pod_seq = [0]
     created_total = [0]
@@ -338,12 +362,24 @@ def run_soak(n_nodes: int = 2000, duration_s: float = 60.0,
 
         # Phase 2: scale-up storm — crosses the high watermark, so the
         # daemon must shed load (largest-bucket drains) instead of
-        # building one storm-sized batch.
+        # building one storm-sized batch.  With device chaos on, the
+        # storm doubles as the OOM burst: every Nth device solve throws
+        # RESOURCE_EXHAUSTED mid-storm, and the guard must bisect down
+        # the pre-warmed ladder (or ride the host engine) while the
+        # bind-409 storm rages — without a single dropped pod.
+        if device_chaos:
+            chaos_device.install(DeviceChaos([DeviceRule(
+                fault="oom", every_nth=device_oom_nth)]))
+            report["chaos"]["device_oom_every_nth"] = device_oom_nth
+            log(f"device chaos ON: OOM every {device_oom_nth}th solve")
         create_pods(storm_pods, "storm")
         log(f"storm of {storm_pods} pods injected "
             f"(watermark {high_watermark})")
         if wait_settled(settle_timeout) < 0:
             raise RuntimeError("storm never settled")
+        if device_chaos:
+            chaos_device.install(None)
+            log("device chaos OFF (OOM burst survived)")
 
         # Phase 3: rolling updates — delete/recreate in waves.
         items, _ = store.list("pods")
@@ -436,6 +472,39 @@ def run_soak(n_nodes: int = 2000, duration_s: float = 60.0,
                 f"{time.monotonic() - t_re:.1f}s "
                 f"(recovery: {factory.last_recovery})")
 
+        # Phase 5.5: device-lost wave — the breaker arc end to end.
+        # One DEVICE_LOST trips the (possibly freshly restarted)
+        # scheduler into host-fallback mode; the wave must still
+        # schedule fully there, and the probe loop must re-promote the
+        # engine to the device before the soak ends.
+        if device_chaos:
+            guard = factory.algorithm.guard
+            chaos_device.install(DeviceChaos([DeviceRule(
+                fault="lost", every_nth=1, count=1)]))
+            create_pods(min(wave_size, 500), "devlost")
+            if wait_settled(settle_timeout) < 0:
+                raise RuntimeError("device-lost wave never settled")
+            chaos_device.install(None)
+            host_spell_s = guard.host_mode_seconds()
+            log(f"device-lost wave settled (mode {guard.mode}, "
+                f"{host_spell_s:.1f}s on host so far)")
+            # The device answers again: the next drains probe and
+            # re-promote.  Drive small waves until the breaker closes.
+            deadline = time.monotonic() + 30
+            w_probe = 0
+            while guard.mode != "device" and time.monotonic() < deadline:
+                create_pods(50, f"probe{w_probe}")
+                w_probe += 1
+                if wait_settled(settle_timeout) < 0:
+                    raise RuntimeError("probe wave never settled")
+                time.sleep(0.3)
+            report["device_lost_wave"] = {
+                "tripped_to_host": host_spell_s > 0 or
+                guard.mode == "host",
+                "repromoted": guard.mode == "device",
+            }
+            log(f"breaker arc complete: engine mode {guard.mode}")
+
         # Sustain small churn waves until the duration floor.
         w = 0
         while time.monotonic() - t_start < duration_s:
@@ -473,6 +542,22 @@ def run_soak(n_nodes: int = 2000, duration_s: float = 60.0,
             report.get("restart", {}).get("peak_before_kill", 0))
         report["degraded_drains"] = \
             metrics.DEGRADED_DRAINS.value - degraded_before
+        # Device-fault plane columns (ratcheted by check_bench.check_soak:
+        # any rejected bind, or a run that ends stuck in host mode, fails
+        # tier-1).
+        guard = factory.algorithm.guard
+        report["device_faults"] = _labeled_delta(metrics.DEVICE_FAULTS,
+                                                 dev_faults_before)
+        report["solve_fallbacks"] = _labeled_delta(
+            metrics.SOLVE_FALLBACKS, fallbacks_before)
+        report["host_mode_seconds"] = round(guard.host_mode_seconds(), 2)
+        report["engine_mode_final"] = guard.mode
+        report["sanity_gate"] = {
+            "rejects": int(metrics.GATE_REJECTS.value -
+                           gate_rejects_before),
+            "rejected_binds": int(metrics.GATE_REJECTED_BINDS.value -
+                                  rejected_binds_before),
+        }
         report["stages"] = stage_breakdown(stages_before,
                                            _stage_snapshot())
         report["chaos"]["injected"] = proxy.stats()["injected"]
@@ -488,6 +573,7 @@ def run_soak(n_nodes: int = 2000, duration_s: float = 60.0,
             f"{report['reconciliation']}")
         return report
     finally:
+        chaos_device.install(None)
         hb_stop.set()
         sampler.stop()
         monitor.stop()
@@ -606,10 +692,12 @@ def main() -> None:
     ap.add_argument("--nodes", type=int, default=2000)
     ap.add_argument("--duration", type=float, default=60.0)
     ap.add_argument("--no-chaos", action="store_true")
+    ap.add_argument("--no-device-chaos", action="store_true")
     ap.add_argument("--no-restart", action="store_true")
     opts = ap.parse_args()
     rec = run_soak(n_nodes=opts.nodes, duration_s=opts.duration,
                    chaos=not opts.no_chaos,
+                   device_chaos=not opts.no_device_chaos,
                    restart=not opts.no_restart)
     with open(opts.out, "w") as f:
         json.dump(rec, f, indent=1)

@@ -255,6 +255,10 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
                     # The SLO plane: live burn rates + budget left
                     # (scheduler/slo.py) and the device-side watchdog.
                     "slo": factory.slo.report(),
+                    # The device-fault plane: engine mode (device/host),
+                    # last classified fault, bisect cap, gate rejects
+                    # (engine/guard.py).
+                    "engine": factory.algorithm.guard.report(),
                     "postPrewarmCompiles": POST_PREWARM_COMPILES.value,
                     "invariantViolations":
                         CACHE_INVARIANT_VIOLATIONS.value,

@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Optional
 
+from kubernetes_tpu.engine import guard as guard_mod
+from kubernetes_tpu.engine.guard import DeviceFault
 from kubernetes_tpu.scheduler.batchformer import BatchFormer, FormedBatch
 from kubernetes_tpu.utils import trace as trace_mod
 from kubernetes_tpu.utils.logging import get_logger
@@ -62,6 +64,12 @@ class DrainPipeline:
         # The overlapped commit worker (one thread: chunks commit in
         # solve order); created lazily on the first windowed drain.
         self._commit_pool = None
+        # The device guard bisects OOM'd batches down the daemon's
+        # pre-warmed bucket ladder — it must read the SAME ladder the
+        # prewarm traces, or recovery would mint unwarmed shapes.
+        guard = getattr(daemon.config.algorithm, "guard", None)
+        if guard is not None:
+            guard.ladder_fn = daemon.effective_ladder
 
     # -- the single drain entry path -------------------------------------
 
@@ -121,14 +129,83 @@ class DrainPipeline:
             # as a span with the step breakdown.
             tr.log_if_long()
 
-    # -- mode routing -----------------------------------------------------
+    # -- mode routing + the device-fault recovery ladder -------------------
 
     def _solve(self, batch: FormedBatch, tr: Optional[Trace] = None,
                trace_id: str = "") -> int:
+        """Route the batch to a solve mode under the device guard's
+        recovery ladder: a classified ``DeviceFault`` re-dispatches the
+        still-uncommitted pods per the guard's decision — unchanged
+        (retry), chunked at the next smaller pre-warmed bucket (bisect),
+        or on the host fallback engine (breaker open) — for at most
+        ``max_rounds`` rounds; exhaustion surfaces to ``drain()``'s
+        crash handler, which requeues rather than drops.  Chunks that
+        committed before the fault stay committed (the cache knows
+        them), so progress is monotone across rounds."""
+        daemon = self.daemon
+        pods = batch.pods
+        guard = getattr(daemon.config.algorithm, "guard", None)
+        if guard is None or not guard.enabled:
+            return self._dispatch(pods, tr, trace_id)
+        total = len(pods)
+        remaining = pods
+        cache = daemon.config.algorithm.cache
+        fault: Optional[DeviceFault] = None
+        for _ in range(max(guard.max_rounds, 1)):
+            mode = guard.solve_mode()
+            try:
+                if mode == "host":
+                    self._dispatch(remaining, tr, trace_id, host=True)
+                else:
+                    self._dispatch(remaining, tr, trace_id)
+                    guard.note_success(probe=(mode == "probe"))
+                return total
+            except DeviceFault as f:
+                fault = f
+                # Re-dispatch ONLY the stranded remainder: pods a
+                # completed chunk already assumed (or the watch
+                # confirmed) are in the cache, and pods a completed
+                # chunk already FAILED are in the backoff heap / back on
+                # the queue — re-solving those would schedule the same
+                # pod twice (once here, once when its requeue pops).
+                with daemon._requeue_cv:
+                    handled = {p.key for _, _, p in daemon._requeue_heap}
+                remaining = [p for p in remaining
+                             if not cache.contains(p.key)
+                             and p.key not in handled
+                             and p.key not in daemon.queue]
+                if not remaining:
+                    return total
+                action = guard.recover(
+                    f, can_bisect=self._can_bisect(remaining))
+                log.warning("device fault [%s] on %s path: %d pod(s) "
+                            "re-dispatched via %s", f.kind, f.path,
+                            len(remaining), action)
+        raise fault  # ladder exhausted: crash handler requeues
+
+    def _can_bisect(self, pods: list) -> bool:
+        """OOM bisection re-solves the remainder as stream chunks at a
+        smaller warmed bucket — available only where chunking is legal:
+        no gang (one assignment vector), no joint (prices couple the
+        queue), no extenders, and a non-empty pre-warmed ladder."""
         from kubernetes_tpu.engine.workloads import gang as gang_mod
         from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATE
         daemon = self.daemon
-        pods = batch.pods
+        if daemon.config.algorithm.extenders:
+            return False
+        if not DEFAULT_FEATURE_GATE.enabled("StreamingDrain") or \
+                DEFAULT_FEATURE_GATE.enabled("JointSolver"):
+            return False
+        if DEFAULT_FEATURE_GATE.enabled("GangScheduling") and \
+                gang_mod.batch_has_gangs(pods):
+            return False
+        return bool(daemon.effective_ladder())
+
+    def _dispatch(self, pods: list, tr: Optional[Trace] = None,
+                  trace_id: str = "", host: bool = False) -> int:
+        from kubernetes_tpu.engine.workloads import gang as gang_mod
+        from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATE
+        daemon = self.daemon
         joint = DEFAULT_FEATURE_GATE.enabled("JointSolver")
         # Gangs must be admitted all-or-nothing over ONE assignment
         # vector — a chunked stream could split a gang across chunk
@@ -136,11 +213,27 @@ class DrainPipeline:
         # a warm bucket below).
         gangs = DEFAULT_FEATURE_GATE.enabled("GangScheduling") and \
             gang_mod.batch_has_gangs(pods)
+        if host:
+            # Breaker open: the whole batch decides on the host engine
+            # (sequential NumPy — chunking and buckets are meaningless
+            # there; gang reduction still applies to its output).
+            return self._solve_oneshot(pods, joint=False, gangs=gangs,
+                                       tr=tr, trace_id=trace_id,
+                                       host=True)
         # The joint solve needs the whole queue at once (prices couple
         # every pod); it supersedes the streaming split.
         streaming = DEFAULT_FEATURE_GATE.enabled("StreamingDrain") \
             and not joint and not gangs \
             and not daemon.config.algorithm.extenders
+        guard = getattr(daemon.config.algorithm, "guard", None)
+        cap = guard.bucket_cap() \
+            if guard is not None and guard.enabled else None
+        if streaming and cap is not None:
+            # Bisected (or HBM-watermark-capped) regime: every
+            # streamable drain chunks at the cap — a pre-warmed ladder
+            # bucket, never a fresh shape.
+            return self._solve_stream(pods, chunk_size=cap,
+                                      trace_id=trace_id)
         if streaming and len(pods) >= daemon.STREAM_THRESHOLD:
             return self._solve_stream(pods, trace_id=trace_id)
         if streaming and len(pods) < daemon._PAD_LIMIT:
@@ -155,24 +248,29 @@ class DrainPipeline:
         return self._solve_oneshot(pods, joint=joint, gangs=gangs,
                                    tr=tr, trace_id=trace_id)
 
-    # -- one-shot / joint / gang solve ------------------------------------
+    # -- one-shot / joint / gang / host solve ------------------------------
 
     def _solve_oneshot(self, pods: list, joint: bool, gangs: bool,
-                       tr: Optional[Trace], trace_id: str) -> int:
+                       tr: Optional[Trace], trace_id: str,
+                       host: bool = False) -> int:
         from kubernetes_tpu.engine.workloads import gang as gang_mod
         from kubernetes_tpu.utils import metrics as metrics_mod
         daemon = self.daemon
         start = time.perf_counter()
-        # Workload-constrained one-shot drains pad to the same bucket
-        # ladder the stream path compiles at, so gang/joint solves hit
-        # pre-warmed shapes instead of minting one per queue length.
-        pad_to = 0
-        if (gangs or joint) and len(pods) < daemon._PAD_LIMIT and \
-                not daemon.config.algorithm.extenders:
-            pad_to = max(1 << (len(pods) - 1).bit_length(),
-                         daemon.stream_min_bucket)
-        placements = daemon.config.algorithm.schedule_batch(
-            pods, joint=joint, pad_to=pad_to)
+        if host:
+            placements = daemon.config.algorithm.schedule_batch_host(pods)
+        else:
+            # Workload-constrained one-shot drains pad to the same
+            # bucket ladder the stream path compiles at, so gang/joint
+            # solves hit pre-warmed shapes instead of minting one per
+            # queue length.
+            pad_to = 0
+            if (gangs or joint) and len(pods) < daemon._PAD_LIMIT and \
+                    not daemon.config.algorithm.extenders:
+                pad_to = max(1 << (len(pods) - 1).bit_length(),
+                             daemon.stream_min_bucket)
+            placements = daemon.config.algorithm.schedule_batch(
+                pods, joint=joint, pad_to=pad_to)
         failure_info: dict[str, tuple[str, str]] = {}
         if gangs:
             placements, rejected = gang_mod.reduce_all_or_nothing(

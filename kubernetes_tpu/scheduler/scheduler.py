@@ -324,6 +324,21 @@ class Scheduler:
         failure_info = failure_info or {}
         placed = [(pod, dest) for pod, dest in zip(pods, placements)
                   if dest is not None]
+        # Sanity-gate backstop (engine/guard.py): a pod whose last solve
+        # was gate-rejected and never cleanly re-solved must not bind.
+        # Structurally unreachable (the gate raises before placements
+        # exist), so the check costs one bool when the rejected set is
+        # empty — but a future refactor that swallows DeviceFault would
+        # trip the ratcheted scheduler_sanity_rejected_binds_total here
+        # instead of binding garbage.
+        gd = getattr(self.config.algorithm, "guard", None)
+        if gd is not None and gd.enabled and gd.has_rejections():
+            placed, refused = gd.filter_rejected(placed)
+            for pod, _ in refused:
+                self._handle_failure(
+                    pod, "SchedulingError",
+                    "placement from a sanity-gate-rejected solve refused",
+                    result="error")
         with stage("assume", pods=len(placed)):
             skipped = set(self.config.algorithm.cache.assume_pods(
                 placed, strict=False,
@@ -520,65 +535,74 @@ class Scheduler:
         # Prewarm compiles are never "post-prewarm": disarm for the
         # duration so a fresh rig warming up in an already-armed process
         # (the serving bench builds three in a row) doesn't count its
-        # own ladder traces as live-path stalls.
+        # own ladder traces as live-path stalls.  Chaos injection is
+        # suppressed the same way: the ladder traces run the live solve
+        # sites, but there is no recovery ladder above prewarm — a
+        # KT_CHAOS_DEVICE cadence firing here would fail startup
+        # instead of exercising recovery (guard.suppressed re-enables
+        # on exit even if a trace raises).
         devicestats.disarm()
-        ladder = self.effective_ladder()
-        timings: dict[int, float] = {}
-        # Warm-start audit: per-bucket persistent-compile-cache traffic.
-        # A bucket whose trace shows misses on a supposedly-warm start is
-        # a signature dodging the cache — exactly the 3-4 s "warm" tail
-        # ROADMAP item 3 chases.  (The counters ride JAX monitoring
-        # events, engine/compile_cache; zero/zero means the executable
-        # was already live in process memory.)
-        cache_stats: dict = {}
+        import contextlib as _contextlib
+        _suppress = alg.guard.suppressed() if alg.guard.enabled \
+            else _contextlib.nullcontext()
+        with _suppress:
+            ladder = self.effective_ladder()
+            timings: dict[int, float] = {}
+            # Warm-start audit: per-bucket persistent-compile-cache traffic.
+            # A bucket whose trace shows misses on a supposedly-warm start is
+            # a signature dodging the cache — exactly the 3-4 s "warm" tail
+            # ROADMAP item 3 chases.  (The counters ride JAX monitoring
+            # events, engine/compile_cache; zero/zero means the executable
+            # was already live in process memory.)
+            cache_stats: dict = {}
 
-        def audited(key, fn):
-            h0 = metrics_mod.COMPILE_CACHE_HITS.value
-            m0 = metrics_mod.COMPILE_CACHE_MISSES.value
-            t0 = time.perf_counter()
-            fn()
-            dt = time.perf_counter() - t0
-            cache_stats[key] = {
-                "hits": metrics_mod.COMPILE_CACHE_HITS.value - h0,
-                "misses": metrics_mod.COMPILE_CACHE_MISSES.value - m0,
-                "seconds": round(dt, 3)}
-            return dt
+            def audited(key, fn):
+                h0 = metrics_mod.COMPILE_CACHE_HITS.value
+                m0 = metrics_mod.COMPILE_CACHE_MISSES.value
+                t0 = time.perf_counter()
+                fn()
+                dt = time.perf_counter() - t0
+                cache_stats[key] = {
+                    "hits": metrics_mod.COMPILE_CACHE_HITS.value - h0,
+                    "misses": metrics_mod.COMPILE_CACHE_MISSES.value - m0,
+                    "seconds": round(dt, 3)}
+                return dt
 
-        for bucket in ladder:
-            want = 2 * bucket  # both scan signatures (no-carry + carry)
-            if sample_pods:
-                pods = list(sample_pods[:want])
-            else:
-                pods = []
-            pods += [api.Pod(name=f"__warm-{i}", namespace="__warm__")
-                     for i in range(want - len(pods))]
+            for bucket in ladder:
+                want = 2 * bucket  # both scan signatures (no-carry + carry)
+                if sample_pods:
+                    pods = list(sample_pods[:want])
+                else:
+                    pods = []
+                pods += [api.Pod(name=f"__warm-{i}", namespace="__warm__")
+                         for i in range(want - len(pods))]
 
-            def run_bucket(pods=pods, bucket=bucket):
-                for _ in alg.schedule_batch_stream(pods,
-                                                   chunk_size=bucket):
+                def run_bucket(pods=pods, bucket=bucket):
+                    for _ in alg.schedule_batch_stream(pods,
+                                                       chunk_size=bucket):
+                        pass
+
+                timings[bucket] = audited(bucket, run_bucket)
+            # The single-pod decision path (schedule_one / the recovery
+            # parity probes): evaluate/masks/select_hosts at P=1 are NOT the
+            # scan's signatures, so without this trace the first interactive
+            # decision after every start paid ~30 compiles on the clock —
+            # a measured 0.3-0.7 s warm-start tail the ladder never covered.
+            def run_single():
+                try:
+                    alg.schedule(api.Pod(name="__warm-one",
+                                         namespace="__warm__"))
+                except Exception:  # noqa: BLE001 — FitError etc. still traced
                     pass
 
-            timings[bucket] = audited(bucket, run_bucket)
-        # The single-pod decision path (schedule_one / the recovery
-        # parity probes): evaluate/masks/select_hosts at P=1 are NOT the
-        # scan's signatures, so without this trace the first interactive
-        # decision after every start paid ~30 compiles on the clock —
-        # a measured 0.3-0.7 s warm-start tail the ladder never covered.
-        def run_single():
-            try:
-                alg.schedule(api.Pod(name="__warm-one",
-                                     namespace="__warm__"))
-            except Exception:  # noqa: BLE001 — FitError etc. still traced
-                pass
-
-        audited("single_pod", run_single)
-        # The dirty-row scatter kernel compiles per pow2 dirty-row count;
-        # untraced, the first drain after any assume paid it mid-drain.
-        audited("scatter", lambda: alg.resident.prewarm_scatter())
-        # Workload-subsystem signatures warm separately (string-keyed on
-        # the daemon, not in the int-keyed bucket dict callers inspect).
-        self.workloads_prewarm_s = self._prewarm_workloads(ladder)
-        self.prewarm_cache_stats = cache_stats
+            audited("single_pod", run_single)
+            # The dirty-row scatter kernel compiles per pow2 dirty-row count;
+            # untraced, the first drain after any assume paid it mid-drain.
+            audited("scatter", lambda: alg.resident.prewarm_scatter())
+            # Workload-subsystem signatures warm separately (string-keyed on
+            # the daemon, not in the int-keyed bucket dict callers inspect).
+            self.workloads_prewarm_s = self._prewarm_workloads(ladder)
+            self.prewarm_cache_stats = cache_stats
         # Recompile watchdog: from here on, ANY XLA compile on a live
         # path is a stall the ladder should have traced — counted in
         # scheduler_post_prewarm_compiles_total{path=}, recorded as a

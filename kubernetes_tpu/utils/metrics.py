@@ -581,6 +581,45 @@ POST_PREWARM_COMPILES = register(Counter(
     "serving clock that the bucket-ladder prewarm should have traced "
     "(the bench ratchet fails on any in the density run)",
     labelnames=("path",)))
+# Device fault-tolerance plane (engine/guard.py): the guarded-execution
+# layer's taxonomy, recovery ladder, and sanity gate.  A control plane
+# that trusts a TPU with its decisions must keep scheduling when the TPU
+# misbehaves — these count every step of that story.
+DEVICE_FAULTS = register(Counter(
+    "scheduler_device_faults_total",
+    "Classified accelerator faults at the guarded solve sites, by kind: "
+    "oom (HBM RESOURCE_EXHAUSTED), compile (XLA compilation failure), "
+    "lost (device in an error state / runtime gone), corrupt (readback "
+    "rejected by the post-solve sanity gate)",
+    labelnames=("kind",)))
+SOLVE_FALLBACKS = register(Counter(
+    "scheduler_solve_fallback_total",
+    "Recovery-ladder fallbacks: bisect (batch re-solved in chunks at "
+    "the next smaller pre-warmed bucket after OOM + resident-array "
+    "eviction) or host (circuit breaker open; drain ran on the NumPy "
+    "host fallback engine)",
+    labelnames=("mode",)))
+ENGINE_MODE = register(Gauge(
+    "scheduler_engine_mode",
+    "Which solver the drain pipeline routes to: 0 = device (the TPU "
+    "scan), 1 = host (breaker open, NumPy fallback engine; probe solves "
+    "re-promote to 0 when the device answers again)"))
+HBM_WATERMARK_TRIPS = register(Counter(
+    "scheduler_hbm_watermark_trips_total",
+    "Times live HBM crossed KT_HBM_WATERMARK and bucket growth was "
+    "proactively capped at the ladder floor (resident arrays evicted) "
+    "BEFORE the allocator could throw"))
+GATE_REJECTS = register(Counter(
+    "scheduler_sanity_gate_rejects_total",
+    "Solve readbacks rejected by the post-solve sanity gate (NaN/inf, "
+    "out-of-range or non-integral assignment indices, padded rows "
+    "placed, or a sampled placement exceeding the node's allocatable); "
+    "each rejection requeues the batch instead of binding garbage"))
+GATE_REJECTED_BINDS = register(Counter(
+    "scheduler_sanity_rejected_binds_total",
+    "Pods that reached the bind path from a sanity-gate-rejected batch "
+    "and were refused there — structurally unreachable defense in "
+    "depth; the bench ratchet fails tier-1 on any nonzero value"))
 # SLO burn plane (scheduler/slo.py): multi-window error-budget burn
 # computed from the decision-latency histogram above.
 SLO_BURN_RATE = register(Gauge(

@@ -232,6 +232,7 @@ const GROUPS = [
  ["Stage latency (mean per tick)", /^scheduler_batch_stage_latency_microseconds_mean_us/],
  ["SLO burn", /^scheduler_slo_/],
  ["Device HBM", /^scheduler_device_hbm_/],
+ ["Device faults & fallback", /^scheduler_(device_faults|solve_fallback|engine_mode|hbm_watermark|sanity_)/],
  ["Device transfers", /^scheduler_(device_transfer|post_prewarm_compiles)/],
  ["Decisions & binds", /^scheduler_(pod_scheduling_attempts|e2e_decision|bind_|batch_formation|batch_deadline)/],
  ["Everything else", /./],

@@ -201,6 +201,58 @@ def test_soak_green_artifact_passes_alone():
     assert cb.check_soak([("SOAK_r07.json", _soak())]) == []
 
 
+# -- device fault-tolerance invariants (ISSUE 10) ----------------------------
+
+def test_soak_sanity_rejected_bind_fails():
+    art = _soak()
+    art["sanity_gate"] = {"rejects": 3, "rejected_binds": 1}
+    problems = cb.check_soak([("SOAK_r10.json", art)])
+    assert len(problems) == 1 and "sanity-gate" in problems[0]
+    # Gate rejects alone (with zero rejected binds) are healthy chaos.
+    art["sanity_gate"] = {"rejects": 3, "rejected_binds": 0}
+    assert cb.check_soak([("SOAK_r10.json", art)]) == []
+
+
+def test_soak_stuck_in_host_mode_fails():
+    art = _soak()
+    art["engine_mode_final"] = "host"
+    problems = cb.check_soak([("SOAK_r10.json", art)])
+    assert len(problems) == 1 and "host" in problems[0]
+    art["engine_mode_final"] = "device"
+    assert cb.check_soak([("SOAK_r10.json", art)]) == []
+
+
+def test_soak_device_lost_wave_must_repromote():
+    art = _soak()
+    art["engine_mode_final"] = "device"
+    art["device_lost_wave"] = {"tripped_to_host": True,
+                               "repromoted": False}
+    problems = cb.check_soak([("SOAK_r10.json", art)])
+    assert len(problems) == 1 and "re-promoted" in problems[0]
+    art["device_lost_wave"]["repromoted"] = True
+    assert cb.check_soak([("SOAK_r10.json", art)]) == []
+
+
+def test_density_run_stuck_in_host_mode_fails():
+    dev = _device()
+    dev["engine_mode_final"] = "host"
+    problems = cb.check_device([("BENCH_r10.json", _parsed(
+        p50=1.0, device=dev))])
+    assert len(problems) == 1 and "host fallback" in problems[0]
+
+
+def test_density_sanity_rejected_bind_fails():
+    dev = _device()
+    dev["engine_mode_final"] = "device"
+    dev["sanity_rejected_binds"] = 2
+    problems = cb.check_device([("BENCH_r10.json", _parsed(
+        p50=1.0, device=dev))])
+    assert len(problems) == 1 and "sanity-gate" in problems[0]
+    dev["sanity_rejected_binds"] = 0
+    assert cb.check_device([("BENCH_r10.json", _parsed(
+        p50=1.0, device=dev))]) == []
+
+
 # -- SERVING artifact ratchet (ISSUE 8) --------------------------------------
 
 def _serving(trickle_p99=150.0, trickle_att=99.8, trickle_floor=99.0,

@@ -502,6 +502,46 @@ def test_infeasible_gang_never_partially_binds_under_chaos(rig_factory):
     assert "scheduler_gang_admissions_total" in exposed
 
 
+def test_oom_solves_during_bind_conflict_storm_converge(rig_factory):
+    """ISSUE 10 e2e: the accelerator throws RESOURCE_EXHAUSTED on every
+    Nth solve WHILE the apiserver 409s every Nth bind — the guard's
+    bisect/retry ladder and the bind forget+requeue path compose, the
+    batch converges fully, and the bind monitor sees zero double-binds."""
+    from kubernetes_tpu.chaos import device as chaos_device
+    from kubernetes_tpu.perf.soak import _BindMonitor
+    chaos_device._reset_for_tests()
+    rig = rig_factory(rules=[dict(fault="error", method="POST",
+                                  path=r"/bindings", status=409,
+                                  every_nth=3)],
+                      nodes=8)
+    daemon = rig.factory.daemon
+    daemon.STREAM_THRESHOLD = 8
+    daemon.stream_chunk = 8
+    daemon.stream_min_bucket = 4
+    monitor = _BindMonitor(rig.store)
+    faults_before = {k[0]: v.value
+                     for k, v in metrics.DEVICE_FAULTS.children().items()}
+    conflicts_before = metrics.BIND_CONFLICTS.value
+    chaos_device.install(chaos_device.DeviceChaos([
+        chaos_device.DeviceRule(fault="oom", every_nth=3)]))
+    try:
+        names = rig.create_pods(24, prefix="oomstorm")
+        bound = rig.wait_bound(names, timeout=60)
+        assert set(bound) == set(names)
+        time.sleep(0.3)  # let the monitor drain its watch queue
+        assert monitor.double_binds == 0
+        faults_after = {
+            k[0]: v.value
+            for k, v in metrics.DEVICE_FAULTS.children().items()}
+        assert faults_after.get("oom", 0) > faults_before.get("oom", 0), \
+            "the OOM cadence never fired — the scenario tested nothing"
+        assert metrics.BIND_CONFLICTS.value > conflicts_before
+        rig.assert_daemon_alive()
+    finally:
+        chaos_device.install(None)
+        monitor.stop()
+
+
 def test_serving_bursts_converge_during_bind_conflict_storm(rig_factory):
     """ISSUE 8 satellite: arrival BURSTS land while every Nth bind 409s,
     with deadline micro-batching on (the batch former lingering up to

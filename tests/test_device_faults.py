@@ -1,0 +1,540 @@
+"""Device-fault tolerance (ISSUE 10): the guarded execution layer.
+
+Pins the full ladder: fault classification, the chaos injector's
+deterministic cadence, OOM bisection landing ONLY on pre-warmed
+buckets, the circuit breaker's trip/probe/re-promote arc, the
+post-solve sanity gate rejecting NaN and out-of-range assignments
+(requeue, never bind), host-engine decision parity vs the pure-Python
+oracle on randomized batches, and the proactive HBM watermark."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu import oracle
+from kubernetes_tpu.chaos import device as chaos_device
+from kubernetes_tpu.chaos.device import (DeviceChaos, DeviceRule,
+                                         SimulatedDeviceError, parse_spec)
+from kubernetes_tpu.engine import guard as guard_mod
+from kubernetes_tpu.engine.generic_scheduler import GenericScheduler
+from kubernetes_tpu.engine.guard import DeviceFault, DeviceGuard, classify
+from kubernetes_tpu.scheduler.binder import InMemoryBinder
+from kubernetes_tpu.scheduler.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.utils import metrics
+
+from tests.helpers import make_node, make_pod
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos_device._reset_for_tests()
+    yield
+    chaos_device._reset_for_tests()
+
+
+def _rig(n_nodes: int = 12, milli_cpu: int = 4000, floor: int = 4,
+         chunk: int = 8, **daemon_kw):
+    algo = GenericScheduler()
+    for i in range(n_nodes):
+        algo.cache.add_node(make_node(f"gn{i}", milli_cpu=milli_cpu))
+    daemon = Scheduler(SchedulerConfig(algorithm=algo,
+                                       binder=InMemoryBinder(),
+                                       async_bind=False))
+    daemon.STREAM_THRESHOLD = chunk
+    daemon.stream_chunk = chunk
+    daemon.stream_min_bucket = floor
+    for k, v in daemon_kw.items():
+        setattr(daemon, k, v)
+    return daemon
+
+
+def _drain_all(daemon, n: int, prefix: str, rounds: int = 40) -> None:
+    """Enqueue n pods and drain (re-draining backoff requeues) until
+    every one is bound or the round budget runs out."""
+    import time
+    from kubernetes_tpu.scheduler.backoff import PodBackoff
+    daemon.backoff = PodBackoff(default_duration=0.01, max_duration=0.05)
+    before = daemon.config.binder.count()
+    for i in range(n):
+        daemon.enqueue(make_pod(f"{prefix}{i}", cpu="50m"))
+    for _ in range(rounds):
+        daemon.schedule_pending(wait_first=False, timeout=0.02)
+        daemon.wait_for_binds()
+        if daemon.config.binder.count() - before >= n:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"only {daemon.config.binder.count() - before}/{n} pods bound")
+
+
+# -- classification -----------------------------------------------------------
+
+
+class TestClassification:
+    def test_xla_status_strings_classify(self):
+        cases = [
+            ("RESOURCE_EXHAUSTED: Out of memory while trying to "
+             "allocate 12 bytes.", "oom"),
+            ("INTERNAL: during context [pre-optimization]: XLA "
+             "compilation failed", "compile"),
+            ("INTERNAL: DEVICE_LOST: TPU device is in an unrecoverable "
+             "error state", "lost"),
+            ("FAILED_PRECONDITION: device handle invalid", "lost"),
+        ]
+        for msg, want in cases:
+            assert classify(SimulatedDeviceError(msg)) == want, msg
+
+    def test_non_device_exceptions_pass_through(self):
+        assert classify(ValueError("RESOURCE_EXHAUSTED-ish")) is None
+        assert classify(RuntimeError("some python bug")) is None
+        assert classify(KeyError("x")) is None
+
+    def test_unknown_device_status_is_conservatively_lost(self):
+        assert classify(SimulatedDeviceError("UNKNOWN: gremlins")) == \
+            "lost"
+
+    def test_device_fault_keeps_its_kind(self):
+        f = DeviceFault("oom", "stream", RuntimeError("x"))
+        assert classify(f) == "oom"
+
+    def test_watch_reraises_classified_as_device_fault(self):
+        g = DeviceGuard()
+        with pytest.raises(DeviceFault) as ei:
+            with g.watch("oneshot"):
+                raise SimulatedDeviceError(
+                    "RESOURCE_EXHAUSTED: Out of memory")
+        assert ei.value.kind == "oom" and ei.value.path == "oneshot"
+
+    def test_watch_leaves_real_bugs_alone(self):
+        g = DeviceGuard()
+        with pytest.raises(ZeroDivisionError):
+            with g.watch("oneshot"):
+                1 / 0
+
+
+# -- the chaos injector -------------------------------------------------------
+
+
+class TestDeviceChaos:
+    def test_parse_spec(self):
+        rules = parse_spec("oom@7,lost@50:1,corrupt@9/stream")
+        assert [(r.fault, r.every_nth, r.count, r.path) for r in rules] \
+            == [("oom", 7, -1, ""), ("lost", 50, 1, ""),
+                ("corrupt", 9, -1, "stream")]
+
+    def test_every_nth_cadence_is_deterministic(self):
+        chaos = DeviceChaos([DeviceRule(fault="oom", every_nth=3)])
+        fired = []
+        for i in range(9):
+            try:
+                chaos.maybe_fail("stream")
+                fired.append(False)
+            except SimulatedDeviceError:
+                fired.append(True)
+        assert fired == [False, False, True] * 3
+
+    def test_count_bounds_fires(self):
+        chaos = DeviceChaos([DeviceRule(fault="lost", every_nth=1,
+                                        count=2)])
+        hits = 0
+        for _ in range(5):
+            try:
+                chaos.maybe_fail("oneshot")
+            except SimulatedDeviceError:
+                hits += 1
+        assert hits == 2
+
+    def test_path_filter(self):
+        chaos = DeviceChaos([DeviceRule(fault="oom", every_nth=1,
+                                        path="stream")])
+        chaos.maybe_fail("oneshot")  # no raise
+        with pytest.raises(SimulatedDeviceError):
+            chaos.maybe_fail("stream")
+
+    def test_corrupt_poisons_readback(self):
+        chaos = DeviceChaos([DeviceRule(fault="corrupt", every_nth=1)])
+        rows = np.arange(8, dtype=np.int32)
+        bad = chaos.maybe_corrupt("stream", rows)
+        assert bad.dtype.kind == "f"
+        assert np.isnan(bad).any()
+        assert (bad[np.isfinite(bad)] >= 2 ** 30).any()
+
+    def test_corrupt_and_launch_cadences_are_separate(self):
+        chaos = DeviceChaos([DeviceRule(fault="corrupt", every_nth=1),
+                             DeviceRule(fault="oom", every_nth=2)])
+        chaos.maybe_fail("s")          # oom seen=1: no fire
+        out = chaos.maybe_corrupt("s", np.zeros(2, np.int32))
+        assert np.isnan(out).any()     # corrupt fires on ITS first look
+
+
+# -- guard policy (unit) ------------------------------------------------------
+
+
+class TestGuardPolicy:
+    def _guard(self, ladder, **env):
+        g = DeviceGuard()
+        g.ladder_fn = lambda: ladder
+        evictions = []
+        g.evict_fn = lambda: evictions.append(1)
+        g._evictions = evictions
+        return g
+
+    def test_oom_evicts_and_walks_the_ladder_down(self):
+        g = self._guard([4, 8, 16])
+        f = DeviceFault("oom", "stream")
+        assert g.recover(f) == guard_mod.ACT_BISECT
+        assert g.bucket_cap() == 8 and g._evictions == [1]
+        assert g.recover(f) == guard_mod.ACT_BISECT
+        assert g.bucket_cap() == 4
+        # At the floor: nothing smaller to bisect onto -> evict+retry
+        # (the third same-kind fault trips the breaker instead).
+        g.breaker_threshold = 99
+        assert g.recover(f) == guard_mod.ACT_RETRY
+        assert g.bucket_cap() == 4
+        # The cap is always a ladder member.
+        assert all(c in [4, 8, 16] for c in [8, 4])
+
+    def test_repeated_faults_trip_breaker_to_host(self):
+        g = self._guard([4, 8])
+        g.breaker_threshold = 3
+        f = DeviceFault("compile", "stream")
+        assert g.recover(f) == guard_mod.ACT_RETRY
+        assert g.recover(f) == guard_mod.ACT_RETRY
+        assert g.recover(f) == guard_mod.ACT_HOST
+        assert g.mode == "host"
+
+    def test_device_lost_trips_immediately(self):
+        g = self._guard([4])
+        assert g.recover(DeviceFault("lost", "oneshot")) == \
+            guard_mod.ACT_HOST
+        assert g.mode == "host"
+
+    def test_probe_cycle_repromotes(self):
+        g = self._guard([4])
+        g.probe_period_s = 0.0
+        g.recover(DeviceFault("lost", "stream"))
+        assert g.mode == "host"
+        assert g.solve_mode() == "probe"
+        g.note_success(probe=True)
+        assert g.mode == "device"
+        assert g.solve_mode() == "device"
+
+    def test_failed_probe_stays_host_without_reescalating(self):
+        g = self._guard([4])
+        g.probe_period_s = 1e9
+        g.recover(DeviceFault("lost", "stream"))
+        g._last_probe = -1e9  # force a probe due
+        assert g.solve_mode() == "probe"
+        assert g.recover(DeviceFault("lost", "stream")) == \
+            guard_mod.ACT_HOST
+        assert g.solve_mode() == "host"  # probe clock was reset
+
+    def test_bucket_cap_lifts_after_healthy_streak(self):
+        g = self._guard([4, 8])
+        g.cap_reset_streak = 2
+        g.recover(DeviceFault("oom", "stream"))
+        assert g.bucket_cap() == 4
+        g.note_success()
+        assert g.bucket_cap() == 4
+        g.note_success()
+        assert g.bucket_cap() is None
+
+    def test_disabled_guard_passes_everything_through(self, monkeypatch):
+        monkeypatch.setenv("KT_GUARD", "0")
+        g = DeviceGuard()
+        assert not g.enabled
+        chaos_device.install(DeviceChaos([DeviceRule(fault="oom",
+                                                     every_nth=1)]))
+        with g.watch("stream"):
+            pass  # no injection, no classification
+
+
+# -- the post-solve sanity gate ----------------------------------------------
+
+
+class TestSanityGate:
+    def _guard(self):
+        g = DeviceGuard()
+        g.ladder_fn = lambda: [4]
+        return g
+
+    def test_nan_rejected(self):
+        g = self._guard()
+        rows = np.array([0.0, np.nan, 1.0])
+        with pytest.raises(DeviceFault) as ei:
+            g.checked_readback("stream", rows, 4)
+        assert ei.value.kind == "corrupt"
+
+    def test_out_of_range_rejected(self):
+        g = self._guard()
+        with pytest.raises(DeviceFault):
+            g.checked_readback("stream", np.array([0, 7], np.int32), 4)
+        with pytest.raises(DeviceFault):
+            g.checked_readback("stream", np.array([0, -3], np.int32), 4)
+
+    def test_dead_row_placement_rejected(self):
+        g = self._guard()
+        live = np.array([True, False])
+        with pytest.raises(DeviceFault):
+            g.checked_readback("stream", np.array([0, 2], np.int32), 4,
+                               live=live)
+        out = g.checked_readback("stream", np.array([0, -1], np.int32),
+                                 4, live=live)
+        assert out.tolist() == [0, -1]
+
+    def test_capacity_spot_check_rejected(self):
+        g = self._guard()
+        alloc = np.array([[1000, 2 ** 30, 0, 110]], np.int64)
+        req = np.array([[4000, 0, 0, 1]], np.int64)  # 4 CPUs onto 1
+        with pytest.raises(DeviceFault):
+            g.checked_readback("oneshot", np.array([0], np.int32), 1,
+                               alloc=alloc, requests=req)
+
+    def test_valid_readback_passes_as_int32(self):
+        g = self._guard()
+        alloc = np.array([[4000, 2 ** 30, 0, 110]] * 3, np.int64)
+        req = np.array([[100, 0, 0, 1]] * 2, np.int64)
+        out = g.checked_readback("oneshot",
+                                 np.array([2, -1], np.int32), 3,
+                                 alloc=alloc, requests=req)
+        assert out.dtype == np.int32 and out.tolist() == [2, -1]
+
+    def test_rejected_keys_remembered_until_clean_solve(self):
+        g = self._guard()
+        keys = ["default/a", "default/b"]
+        with pytest.raises(DeviceFault):
+            g.checked_readback("stream", np.array([np.nan]), 4,
+                               keys_fn=lambda: keys)
+        assert g.has_rejections()
+
+        class P:
+            def __init__(self, key):
+                self.key = key
+        placed = [(P("default/a"), "n1"), (P("default/c"), "n2")]
+        before = metrics.GATE_REJECTED_BINDS.value
+        clean, refused = g.filter_rejected(placed)
+        assert [p.key for p, _ in refused] == ["default/a"]
+        assert [p.key for p, _ in clean] == ["default/c"]
+        assert metrics.GATE_REJECTED_BINDS.value == before + 1
+        # A clean re-solve of the same pods clears the memory.
+        g.checked_readback("stream", np.array([0, 1], np.int32), 4,
+                           keys_fn=lambda: keys)
+        assert not g.has_rejections()
+
+
+# -- the recovery ladder end-to-end -------------------------------------------
+
+
+class TestRecoveryLadder:
+    def test_oom_bisects_onto_warmed_buckets_only(self):
+        daemon = _rig(floor=4, chunk=8)
+        algo = daemon.config.algorithm
+        ladder = set(daemon.effective_ladder())
+        assert len(ladder) >= 2  # a rung to bisect onto
+        chunk_sizes: list[int] = []
+        real_stream = algo.schedule_batch_stream
+
+        def spying_stream(pods, chunk_size=2048, **kw):
+            chunk_sizes.append(chunk_size)
+            return real_stream(pods, chunk_size=chunk_size, **kw)
+
+        algo.schedule_batch_stream = spying_stream
+        chaos_device.install(DeviceChaos([DeviceRule(fault="oom",
+                                                     every_nth=2,
+                                                     count=2)]))
+        _drain_all(daemon, 24, "ob")
+        assert chunk_sizes and set(chunk_sizes) <= ladder, chunk_sizes
+        # The bisected re-dispatch actually used a smaller rung.
+        assert min(chunk_sizes) < max(chunk_sizes)
+        assert algo.guard.mode == "device"
+        daemon.stop()
+
+    def test_device_lost_trips_to_host_then_probe_repromotes(self):
+        daemon = _rig()
+        algo = daemon.config.algorithm
+        algo.guard.probe_period_s = 1e9  # no probe during the fault wave
+        chaos_device.install(DeviceChaos([DeviceRule(fault="lost",
+                                                     every_nth=1,
+                                                     count=1)]))
+        before = {k[0]: v.value
+                  for k, v in metrics.SOLVE_FALLBACKS.children().items()}
+        _drain_all(daemon, 10, "dl")
+        assert algo.guard.mode == "host"
+        after = {k[0]: v.value
+                 for k, v in metrics.SOLVE_FALLBACKS.children().items()}
+        assert after.get("host", 0) > before.get("host", 0)
+        # Device answers again: the next drain probes and re-promotes.
+        chaos_device.install(None)
+        algo.guard.probe_period_s = 0.0
+        _drain_all(daemon, 5, "dp")
+        assert algo.guard.mode == "device"
+        daemon.stop()
+
+    def test_permanent_device_loss_schedules_everything_on_host(self):
+        """The hard-kill acceptance bar: with the device path dead
+        FOREVER, every pod still schedules via the host engine, with
+        decision sanity (gate passes, valid nodes, no overcommit of
+        pod count)."""
+        daemon = _rig(n_nodes=6)
+        algo = daemon.config.algorithm
+        algo.guard.probe_period_s = 1e9
+        chaos_device.install(DeviceChaos([DeviceRule(fault="lost",
+                                                     every_nth=1)]))
+        _drain_all(daemon, 30, "pk")
+        assert algo.guard.mode == "host"
+        assert algo.guard.gate_rejects == 0
+        bound = daemon.config.binder._bound
+        names = {f"gn{i}" for i in range(6)}
+        assert all(node in names for node in bound.values())
+        daemon.stop()
+
+    def test_corrupt_readback_requeues_then_converges(self):
+        daemon = _rig()
+        algo = daemon.config.algorithm
+        rejects_before = metrics.GATE_REJECTS.value
+        chaos_device.install(DeviceChaos([DeviceRule(fault="corrupt",
+                                                     every_nth=1,
+                                                     count=1)]))
+        _drain_all(daemon, 12, "cr")
+        assert metrics.GATE_REJECTS.value > rejects_before
+        assert algo.guard.gate_rejects >= 1
+        # Nothing from the rejected solve bound: every binding names a
+        # real node (the garbage index 2**31-7 never reached a binder).
+        names = {f"gn{i}" for i in range(12)}
+        assert all(n in names
+                   for n in daemon.config.binder._bound.values())
+        daemon.stop()
+
+    def test_single_pod_path_falls_back_to_host(self):
+        daemon = _rig()
+        algo = daemon.config.algorithm
+        chaos_device.install(DeviceChaos([DeviceRule(
+            fault="compile", every_nth=1, count=1, path="single_pod")]))
+        daemon.enqueue(make_pod("sp0", cpu="50m"))
+        assert daemon.schedule_one(timeout=0.1)
+        daemon.wait_for_binds()
+        assert daemon.config.binder.count() == 1
+        faults = {k[0]: v.value
+                  for k, v in metrics.DEVICE_FAULTS.children().items()}
+        assert faults.get("compile", 0) >= 1
+        daemon.stop()
+
+
+# -- host-engine parity vs the oracle -----------------------------------------
+
+
+class TestHostEngineParity:
+    def test_randomized_batches_match_oracle_argmax_sets(self):
+        rng = np.random.RandomState(11)
+        algo = GenericScheduler()
+        nodes = []
+        for i in range(8):
+            n = make_node(f"pn{i}",
+                          milli_cpu=int(rng.choice([2000, 4000, 8000])),
+                          memory=int(rng.choice([8, 16, 32])) * 1024 ** 3)
+            nodes.append(n)
+            algo.cache.add_node(n)
+        cluster = oracle.ClusterState(nodes=nodes, pods=[])
+        pods = [make_pod(f"pp{i}",
+                         cpu=f"{int(rng.choice([100, 250, 500, 900]))}m",
+                         memory=f"{int(rng.choice([128, 256, 512]))}Mi")
+                for i in range(40)]
+        batch, hb, hc, nt = algo._compile_host(pods)
+        choices, _ = algo.host_solver.solve_greedy(hb, hc, 0)
+        for i, pod in enumerate(pods):
+            allowed = oracle.schedule(pod, cluster)
+            got = nt.names[choices[i]] if choices[i] >= 0 else None
+            if got is None:
+                assert not allowed, f"pod {i}: host failed, oracle fits"
+            else:
+                assert got in allowed, \
+                    f"pod {i}: host chose {got}, oracle allows " \
+                    f"{sorted(allowed)}"
+                pod.node_name = got
+                cluster.pods.append(pod)
+
+    def test_host_engine_respects_ports_and_selectors(self):
+        algo = GenericScheduler()
+        for i in range(4):
+            algo.cache.add_node(make_node(f"sn{i}", milli_cpu=4000,
+                                          labels={"zone": f"z{i % 2}"}))
+        # hostPort pods: at most one per node.
+        port_pods = [make_pod(f"hp{i}", cpu="50m", host_ports=[8080])
+                     for i in range(6)]
+        batch, hb, hc, nt = algo._compile_host(port_pods)
+        choices, _ = algo.host_solver.solve_greedy(hb, hc, 0)
+        placed = [c for c in choices if c >= 0]
+        assert len(placed) == 4 and len(set(placed)) == 4
+        # Unsatisfiable selector: nothing places.
+        sel_pods = [make_pod("sel0", cpu="50m",
+                             node_selector={"zone": "nowhere"})]
+        batch, hb, hc, nt = algo._compile_host(sel_pods)
+        choices, _ = algo.host_solver.solve_greedy(hb, hc, 0)
+        assert choices.tolist() == [-1]
+
+    def test_host_engine_honors_hard_topology_spread(self):
+        """The fallback must not drop hard DoNotSchedule spread terms:
+        with z0 already at max skew, both the host batch path and the
+        host single-pod path must place in z1 (the device semantics,
+        via topology.spread_planes_host)."""
+        import json
+        from kubernetes_tpu.api import types as api
+        algo = GenericScheduler()
+        for i in range(4):
+            algo.cache.add_node(make_node(
+                f"tn{i}", labels={api.ZONE_LABEL: f"z{i % 2}"}))
+        for i, node in enumerate(["tn0", "tn2"]):
+            algo.cache.add_pod(make_pod(f"tpre{i}", labels={"app": "x"},
+                                        node_name=node))
+        def spread_pod(name):
+            p = make_pod(name, labels={"app": "x"})
+            p.annotations[api.TOPOLOGY_SPREAD_ANNOTATION_KEY] = \
+                json.dumps([{"maxSkew": 1, "topologyKey": api.ZONE_LABEL,
+                             "whenUnsatisfiable": "DoNotSchedule",
+                             "labelSelector": {
+                                 "matchLabels": {"app": "x"}}}])
+            return p
+        placements = algo.schedule_batch_host([spread_pod("ts0")])
+        assert placements == ["tn1"] or placements == ["tn3"]
+        assert algo._schedule_host(spread_pod("ts1")) in ("tn1", "tn3")
+
+    def test_host_batch_drain_tracks_resources_in_batch(self):
+        """Sequential visibility: 2-CPU nodes, 1.5-CPU pods — the host
+        greedy must spread one pod per node, not stack by batch-start
+        scores."""
+        algo = GenericScheduler()
+        for i in range(3):
+            algo.cache.add_node(make_node(f"rn{i}", milli_cpu=2000))
+        pods = [make_pod(f"rp{i}", cpu="1500m") for i in range(5)]
+        placements = algo.schedule_batch_host(pods)
+        placed = [p for p in placements if p is not None]
+        assert len(placed) == 3 and len(set(placed)) == 3
+        assert placements.count(None) == 2
+
+
+# -- the HBM watermark --------------------------------------------------------
+
+
+class TestWatermark:
+    def test_watermark_caps_buckets_at_the_floor(self, monkeypatch):
+        monkeypatch.setenv("KT_HBM_WATERMARK", "1")  # 1 byte: always over
+        trips_before = metrics.HBM_WATERMARK_TRIPS.value
+        daemon = _rig(floor=4, chunk=8)
+        algo = daemon.config.algorithm
+        assert algo.guard.hbm_watermark == 1
+        assert algo.guard.bucket_cap() == min(daemon.effective_ladder())
+        assert metrics.HBM_WATERMARK_TRIPS.value == trips_before + 1
+        # Trips count transitions, not every consult.
+        algo.guard.bucket_cap()
+        assert metrics.HBM_WATERMARK_TRIPS.value == trips_before + 1
+        # Drains still converge, chunked at the floor bucket.
+        _drain_all(daemon, 12, "wm")
+        daemon.stop()
+
+    def test_watermark_releases_when_hbm_drops(self, monkeypatch):
+        daemon = _rig(floor=4, chunk=8)
+        algo = daemon.config.algorithm
+        algo.guard.hbm_watermark = 10 ** 18  # far above anything real
+        assert algo.guard.bucket_cap() is None
+        daemon.stop()

@@ -361,6 +361,94 @@ class TestOverlappedPipeline:
         daemon.stop()
 
 
+class TestDeferredReadbackFaults:
+    """ISSUE 10 satellite: a device fault raised inside the deferred
+    readback (``resolve()`` under ``defer_readback=True``, i.e. on the
+    commit worker) must requeue the chunk's pods — never drop them, and
+    never wedge the KT_PIPELINE_WINDOW semaphore."""
+
+    def _fault_second_resolve(self, algo):
+        """Wrap schedule_batch_stream so chunk 2's resolve() raises a
+        classified device fault at readback time."""
+        from kubernetes_tpu.engine.guard import DeviceFault
+        real_stream = algo.schedule_batch_stream
+        chunk_no = [0]
+
+        def faulting_stream(pods, chunk_size=2048, defer_readback=False):
+            for chunk_pods, resolve in real_stream(
+                    pods, chunk_size=chunk_size, defer_readback=True):
+                chunk_no[0] += 1
+                if chunk_no[0] == 2:
+                    def bad_resolve(_resolve=resolve):
+                        raise DeviceFault(
+                            "oom", "stream",
+                            RuntimeError("RESOURCE_EXHAUSTED: injected "
+                                         "at readback"))
+                    yield chunk_pods, bad_resolve
+                else:
+                    yield chunk_pods, resolve
+
+        algo.schedule_batch_stream = faulting_stream
+
+    def test_guard_off_fault_in_resolve_requeues_chunk(self, monkeypatch):
+        """Legacy path (KT_GUARD=0): the fault surfaces through the
+        commit future to drain()'s crash handler, which requeues exactly
+        the chunk's pods through backoff; the semaphore is released and
+        the next drain binds them."""
+        monkeypatch.setenv("KT_GUARD", "0")
+        daemon = _rig(n_nodes=12, stream_chunk=4)
+        daemon.STREAM_THRESHOLD = 1
+        daemon.stream_min_bucket = 4
+        daemon.pipeline_window = 1
+        from kubernetes_tpu.scheduler.backoff import PodBackoff
+        daemon.backoff = PodBackoff(default_duration=0.01,
+                                    max_duration=0.05)
+        algo = daemon.config.algorithm
+        assert not algo.guard.enabled
+        self._fault_second_resolve(algo)
+        for i in range(12):
+            daemon.enqueue(make_pod(f"rb{i}", cpu="50m"))
+        assert daemon.schedule_pending(wait_first=False) == 12
+        daemon.wait_for_binds()
+        # Chunk 2 (4 pods) was requeued, not dropped or double-bound.
+        assert daemon.config.binder.count() == 8
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                daemon.config.binder.count() < 12:
+            daemon.schedule_pending(wait_first=False, timeout=0.05)
+            daemon.wait_for_binds()
+            time.sleep(0.02)
+        assert daemon.config.binder.count() == 12
+        # The window semaphore is not wedged: a further windowed drain
+        # completes.
+        for i in range(8):
+            daemon.enqueue(make_pod(f"rb2-{i}", cpu="50m"))
+        assert daemon.schedule_pending(wait_first=False) == 8
+        daemon.wait_for_binds()
+        assert daemon.config.binder.count() == 20
+        daemon.stop()
+
+    def test_guard_on_fault_in_resolve_recovers_in_one_drain(self):
+        """With the guard enabled, the same fault is caught by the
+        pipeline's recovery ladder inside ONE schedule_pending call:
+        committed chunks stay committed, the stranded remainder
+        re-dispatches, and every pod binds without waiting out a
+        backoff."""
+        daemon = _rig(n_nodes=12, stream_chunk=4)
+        daemon.STREAM_THRESHOLD = 1
+        daemon.stream_min_bucket = 4
+        daemon.pipeline_window = 1
+        algo = daemon.config.algorithm
+        assert algo.guard.enabled
+        self._fault_second_resolve(algo)
+        for i in range(12):
+            daemon.enqueue(make_pod(f"rg{i}", cpu="50m"))
+        assert daemon.schedule_pending(wait_first=False) == 12
+        daemon.wait_for_binds()
+        assert daemon.config.binder.count() == 12
+        daemon.stop()
+
+
 class TestCompileCache:
     def test_configure_is_idempotent_and_env_gated(self, monkeypatch,
                                                    tmp_path):

@@ -205,6 +205,24 @@ def check_soak(artifacts: list[tuple[str, dict]] | None = None,
             f"{new_name}: post-restart decision parity "
             f"{parity['decision_parity_pct']}% < 100% — recovery "
             f"corrupted the rebuilt scheduling state")
+    # Device fault-tolerance invariants (artifacts predating the guard
+    # carry none of these keys and ratchet nothing).
+    gate = new.get("sanity_gate") or {}
+    if gate.get("rejected_binds"):
+        problems.append(
+            f"{new_name}: {gate['rejected_binds']} pod(s) bound from a "
+            f"sanity-gate-rejected solve — the gate's requeue contract "
+            f"broke")
+    if new.get("engine_mode_final") == "host":
+        problems.append(
+            f"{new_name}: the soak ended with the engine stuck in host "
+            f"fallback mode — the probe loop never re-promoted to the "
+            f"device")
+    lost_wave = new.get("device_lost_wave") or {}
+    if lost_wave and not lost_wave.get("repromoted", True):
+        problems.append(
+            f"{new_name}: the device-lost wave never re-promoted the "
+            f"engine back to device mode")
     if len(artifacts) >= 2:
         (prev_name, prev) = artifacts[-2]
         prev_settle, new_settle = prev.get("settle_s"), \
@@ -306,6 +324,15 @@ def check_device(artifacts: list[tuple[str, dict]],
             f"{new_name}: {compiles} post-prewarm XLA compile(s) in the "
             f"density run — a live-path shape the prewarm ladder never "
             f"traced")
+    if dev.get("sanity_rejected_binds"):
+        problems.append(
+            f"{new_name}: {dev['sanity_rejected_binds']} pod(s) bound "
+            f"from a sanity-gate-rejected solve in the density run")
+    if dev.get("engine_mode_final") == "host":
+        problems.append(
+            f"{new_name}: the density run ended stuck in host fallback "
+            f"mode — the bench measured the NumPy engine, not the "
+            f"device")
     if len(artifacts) < 2:
         return problems
     prev_dev = (artifacts[-2][1].get("device") or {})
