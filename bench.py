@@ -139,8 +139,19 @@ def main(argv=None) -> None:
                 print(f"wire phase failed: {err}", file=sys.stderr)
                 break
             wire_all.append(r)
-            if wire is None or r.pods_per_second > wire.pods_per_second:
-                wire = r
+        if wire_all:
+            # Report the MEDIAN run, not the best: on a contended rig a
+            # single run can produce a nonsense outlier in either
+            # direction (a stalled daemon binding nothing, or a
+            # cross-phase artifact binding "instantly"), and the best-of
+            # rule would enshrine exactly those.
+            wire = sorted(wire_all,
+                          key=lambda r: r.pods_per_second)[len(wire_all)
+                                                           // 2]
+            rates = [round(r.pods_per_second, 1) for r in wire_all]
+            if min(rates) < max(rates) / 2:
+                print(f"wire runs disagree >2x: {rates}; reporting the "
+                      f"median run", file=sys.stderr)
 
     # The wire daemons' prewarm armed the recompile watchdog process-
     # wide; the remaining phases build FRESH rigs whose first compiles
@@ -297,10 +308,15 @@ def main(argv=None) -> None:
             print(f"fleet phase failed: {err}", file=sys.stderr)
 
     baseline = 8.0  # test/e2e/density.go:48 MinPodsPerSecondThroughput
+    import jax
     out = {
         "metric": f"scheduler throughput, {n_pods} pods onto {n_nodes} nodes "
                   f"(default policy, full daemon: queue->batched device "
                   f"solve->assume->bind)",
+        # Accelerator backend the wall-clock rows were measured on: the
+        # ratchet (tools/check_bench.py) re-baselines rather than
+        # comparing p50 seconds across different devices.
+        "backend": jax.default_backend(),
         "value": round(result.pods_per_second, 1),
         "unit": "pods/s",
         "vs_baseline": round(result.pods_per_second / baseline, 1),

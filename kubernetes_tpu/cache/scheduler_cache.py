@@ -248,6 +248,23 @@ class SchedulerCache:
         del self._pod_states[key]
 
     @_locked
+    def forget_pods_matching(self, pred) -> list[str]:
+        """Forget every ASSUMED pod whose object matches ``pred`` — the
+        shard-handoff release (scheduler/shards.py): an incarnation that
+        lost a shard's lease drops its optimistic assumes there in one
+        locked pass, so the shard's new owner can re-solve those pods
+        without racing phantom capacity.  Confirmed (bound) pods are
+        untouched — they are apiserver truth, not our speculation, and
+        every incarnation's cache must keep charging their capacity.
+        Returns the forgotten keys."""
+        victims = [key for key, st in self._pod_states.items()
+                   if st.assumed and pred(st.pod)]
+        for key in victims:
+            self._detach(self._pod_states[key].pod)
+            del self._pod_states[key]
+        return victims
+
+    @_locked
     def add_pod(self, pod: api.Pod) -> None:
         """AddPod (cache.go:160-186): confirm an assumed pod (clearing its
         TTL) or ingest an already-bound pod seen via watch."""
@@ -301,6 +318,18 @@ class SchedulerCache:
             self._detach(self._pod_states[k].pod)
             del self._pod_states[k]
         return expired
+
+    @_locked
+    def assumed_age(self, key: str) -> Optional[float]:
+        """Seconds since ``key`` was assumed (None when not tracked or
+        not assumed) — derived from the TTL deadline stamped at assume
+        time.  The shard ownership sweep uses this to tell a LIVE
+        in-flight bind (young assume: leave it alone) from a leaked one
+        (old assume whose bind result was lost: forget + requeue)."""
+        st = self._pod_states.get(key)
+        if st is None or not st.assumed or st.deadline is None:
+            return None
+        return self.ttl - (st.deadline - self._now())
 
     @_locked
     def is_assumed(self, key: str) -> bool:

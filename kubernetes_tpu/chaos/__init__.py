@@ -7,6 +7,7 @@ and forced 410 Gone.  Rules are configurable programmatically and over a
 ``/chaos/rules`` admin endpoint so multiprocess e2e rigs can drive it.
 """
 
+from kubernetes_tpu.chaos.bindmonitor import BindMonitor
 from kubernetes_tpu.chaos.device import (DeviceChaos, DeviceRule,
                                          SimulatedDeviceError)
 from kubernetes_tpu.chaos.proxy import (FAULT_CUT_STREAM, FAULT_ERROR,
@@ -19,4 +20,5 @@ from kubernetes_tpu.chaos.proxy import (FAULT_CUT_STREAM, FAULT_ERROR,
 __all__ = ["ChaosProxy", "Rule", "FAULT_ERROR", "FAULT_RESET",
            "FAULT_LATENCY", "FAULT_CUT_STREAM", "heartbeat_drop",
            "node_flap", "watch_cut_on_relist", "bind_conflict_storm",
-           "DeviceChaos", "DeviceRule", "SimulatedDeviceError"]
+           "DeviceChaos", "DeviceRule", "SimulatedDeviceError",
+           "BindMonitor"]

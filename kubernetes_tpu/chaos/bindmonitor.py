@@ -1,0 +1,67 @@
+"""Double-bind referee: a store-watch monitor classifying nodeName
+transitions.
+
+Born as the soak harness's post-run reconciliation detector and promoted
+to a reusable helper: every chaos/e2e rig that races binds (409 storms,
+mid-drain kills, multiple active-active incarnations) wants the same
+referee.  A BIND is ``"" -> node``; a DOUBLE BIND — the invariant a kill
+between solve and bind, or two incarnations racing one shard, must never
+break — is ``node -> different node`` on the same live pod object.
+Delivery is synchronous under the store lock into an unbounded queue, so
+no event is ever missed; a DELETED pod's slate is wiped (rolling updates
+recreate names, which is a fresh bind, not a double one).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class BindMonitor:
+    """Watch ``store``'s pod stream in-process and count binds and
+    double-binds.  ``store`` is a MemStore (the watch rides the store
+    lock, so the count is exact, not sampled)."""
+
+    def __init__(self, store):
+        self.binds = 0
+        self.double_binds = 0
+        # pod key -> node of the offending transition, for assertion
+        # messages that name the actual victim.
+        self.double_bind_keys: list[tuple[str, str, str]] = []
+        self._nodes: dict[str, str] = {}
+        self._stopped = threading.Event()
+        # Watch from the CURRENT rv: fleet registration that ran before
+        # this monitor can exceed the server's replay window, and no pod
+        # events predate it anyway.
+        self._watcher = store.watch(["pods"],
+                                    from_rv=store.list("pods")[1])
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name="bind-monitor")
+        self._thread.start()
+
+    def _pump(self) -> None:
+        while not self._stopped.is_set():
+            ev = self._watcher.next(timeout=0.5)
+            if ev is None:
+                continue  # timeout (or the stop sentinel; flag decides)
+            if ev.type == "DELETED":
+                self._nodes.pop(ev.key, None)
+                continue
+            node = (ev.object.get("spec") or {}).get("nodeName") or ""
+            prev = self._nodes.get(ev.key, "")
+            if node and not prev:
+                self.binds += 1
+            elif node and prev and node != prev:
+                self.double_binds += 1
+                self.double_bind_keys.append((ev.key, prev, node))
+            self._nodes[ev.key] = node
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._watcher.stop()
+
+    def assert_clean(self) -> None:
+        """Raise with the offending transitions if any double bind was
+        seen — the one-line acceptance check for e2e scenarios."""
+        assert self.double_binds == 0, \
+            f"double binds detected: {self.double_bind_keys}"

@@ -30,6 +30,13 @@ reconciliation failure, monotonically growing steady-state queue depth,
 or a settle-time regression >15 % vs the previous committed artifact
 fails tier-1.
 
+The ACTIVE-ACTIVE HA WAVE (:func:`run_ha_wave`, the artifact's ``ha``
+section) follows the single-scheduler soak: three sharded incarnations
+(scheduler/shards.py) over one apiserver under a bind-409 + watch-cut
+storm, one SIGKILLed mid-drain — survivors must steal its shard leases
+in under a second, reconcile, and drain them with ZERO double-binds at
+an aggregate rate at or above the single-scheduler number.
+
 Run: ``python -m kubernetes_tpu.perf.soak --out SOAK_r07.json``
 (committed-artifact scale: >= 60 s, >= 10x the fleet bench's 2,000
 replicas).  The tier-1 suite runs a seconds-long smoke at toy scale.
@@ -48,9 +55,9 @@ import numpy as np
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.apiserver.memstore import MemStore
-from kubernetes_tpu.chaos import (ChaosProxy, DeviceChaos, DeviceRule,
-                                  bind_conflict_storm, heartbeat_drop,
-                                  watch_cut_on_relist)
+from kubernetes_tpu.chaos import (BindMonitor, ChaosProxy, DeviceChaos,
+                                  DeviceRule, bind_conflict_storm,
+                                  heartbeat_drop, watch_cut_on_relist)
 from kubernetes_tpu.chaos import device as chaos_device
 from kubernetes_tpu.client.http import APIClient
 from kubernetes_tpu.scheduler.backoff import PodBackoff
@@ -94,47 +101,10 @@ def _pod_json(name: str, cpu: str = "50m") -> dict:
                     "cpu": cpu, "memory": "64Mi"}}}]}}
 
 
-class _BindMonitor:
-    """Watches the store's pod stream in-process and classifies nodeName
-    transitions — the post-soak reconciliation's double-bind detector.
-    A bind is "" -> node; a DOUBLE bind (the invariant a kill between
-    solve and bind must never break) is node -> different node on the
-    same pod object.  Delivery is synchronous under the store lock into
-    an unbounded queue, so no event is ever missed."""
-
-    def __init__(self, store: MemStore):
-        self.binds = 0
-        self.double_binds = 0
-        self._nodes: dict[str, str] = {}
-        self._stopped = threading.Event()
-        # Watch from the CURRENT rv: the fleet registration that ran
-        # before this monitor can exceed the server's replay window, and
-        # no pod events predate it anyway.
-        self._watcher = store.watch(["pods"],
-                                    from_rv=store.list("pods")[1])
-        self._thread = threading.Thread(target=self._pump, daemon=True,
-                                        name="soak-bind-monitor")
-        self._thread.start()
-
-    def _pump(self) -> None:
-        while not self._stopped.is_set():
-            ev = self._watcher.next(timeout=0.5)
-            if ev is None:
-                continue  # timeout (or the stop sentinel; flag decides)
-            if ev.type == "DELETED":
-                self._nodes.pop(ev.key, None)
-                continue
-            node = (ev.object.get("spec") or {}).get("nodeName") or ""
-            prev = self._nodes.get(ev.key, "")
-            if node and not prev:
-                self.binds += 1
-            elif node and prev and node != prev:
-                self.double_binds += 1
-            self._nodes[ev.key] = node
-
-    def stop(self) -> None:
-        self._stopped.set()
-        self._watcher.stop()
+# The double-bind referee, extracted to chaos/bindmonitor.py so the
+# chaos e2e suites share one implementation; the old private name stays
+# importable for rigs written against it.
+_BindMonitor = BindMonitor
 
 
 class _QueueSampler:
@@ -324,11 +294,15 @@ def run_soak(n_nodes: int = 2000, duration_s: float = 60.0,
     hb_thread = threading.Thread(target=heartbeat_loop, daemon=True,
                                  name="soak-heartbeats")
 
+    import jax
     report: dict = {
         "harness": "kubernetes_tpu/perf/soak.py (churn soak: rolling "
                    "updates + node drain/fail/re-add + scale-up storm + "
                    "mid-drain scheduler kill, over HTTP through the "
                    "chaos proxy)",
+        # Wall-clock rows (settle_s) only ratchet against artifacts
+        # measured on the same accelerator backend (check_bench).
+        "backend": jax.default_backend(),
         "scale": {"n_nodes": n_nodes},
         "chaos": {"enabled": chaos},
     }
@@ -591,6 +565,476 @@ def run_soak(n_nodes: int = 2000, duration_s: float = 60.0,
                 os.environ[k] = v
 
 
+def run_ha_wave(n_nodes: int = 800, n_shards: int = 8,
+                n_incarnations: int = 3, n_namespaces: int = 12,
+                seed_pods: int = 3000, storm_waves: int = 5,
+                wave_pods: int = 1500, kill_wave_pods: int = 3000,
+                lease_s: float = 0.45, chaos: bool = True,
+                stream_chunk: int = 2048, settle_timeout: float = 240.0,
+                processes: bool = True, quiet: bool = False) -> dict:
+    """The active-active HA wave (scheduler/shards.py): scheduler
+    incarnations over ONE apiserver, sharded by namespace hash with
+    lease-based ownership, under a bind-409 + watch-cut chaos storm.
+    One incarnation is SIGKILLed mid-drain; the survivors must steal
+    its shards in under a second, reconcile and drain them, and the
+    wave must end with zero double-binds and an aggregate steady-state
+    rate at or above the wave's own single-scheduler baseline — phase 0
+    runs the SAME storm (same rig, same chaos, same scale) against one
+    incarnation holding every shard, so the comparison isolates exactly
+    the variable under test: the number of schedulers.
+
+    ``processes=True`` (the artifact mode) runs each incarnation as a
+    REAL ``python -m kubernetes_tpu.scheduler`` process — true
+    parallelism (three interpreters, three GILs) and a true ``kill
+    -9``; the driver observes ownership through the shard LEASE
+    RECORDS themselves and scrapes each survivor's /metrics.
+    ``processes=False`` is the in-process variant the tier-1 smoke
+    uses (seconds, no subprocess JAX start-ups).
+
+    Returns the ``ha`` section of the SOAK artifact;
+    ``tools/check_bench.py check_ha`` ratchets it."""
+    import signal
+    import socket
+    import subprocess
+
+    t_start = time.monotonic()
+    store = MemStore()
+    from kubernetes_tpu.apiserver.server import serve
+    api_srv = serve(store)
+    api_url = f"http://127.0.0.1:{api_srv.server_address[1]}"
+    proxy = ChaosProxy(api_url).start()
+    # Generous driver timeout: bulk creates can sit behind seconds of
+    # server-side fan-out while every incarnation drains.
+    direct = APIClient(api_url, qps=0, timeout=60.0)
+
+    def log(msg: str) -> None:
+        if not quiet:
+            print(f"ha[{time.monotonic() - t_start:6.1f}s] {msg}",
+                  file=sys.stderr)
+
+    ha_env = {
+        "KT_PREWARM": "1", "KT_RECOVERY": "1",
+        "KT_HA_SHARDS": str(n_shards),
+        "KT_HA_LEASE_S": str(lease_s),
+        "KT_HA_RENEW_S": str(lease_s * 0.75),
+        "KT_HA_RETRY_S": str(lease_s / 8),
+        # The ownership sweep is the convergence backstop under the
+        # chaos storm (a takeover relist the proxy kills must not
+        # strand a shard) — compressed to scenario time, but not so
+        # far the sweeps become their own load source.
+        "KT_HA_SWEEP_S": "8",
+        # Deadline micro-batching + compressed failure backoff: each
+        # incarnation sees its shards' slice of every wave as a watch
+        # trickle and must amortize per-drain fixed costs over real
+        # batches; a 409-storm victim must retry in scenario time.
+        "KT_BATCH_DEADLINE_MS": "100",
+        "KT_POD_BACKOFF_S": "0.1", "KT_POD_BACKOFF_MAX_S": "2",
+        "KT_STREAM_CHUNK": str(stream_chunk),
+    }
+    conflicts_before = metrics.CROSS_SHARD_CONFLICTS.value
+    handoffs_before = metrics.SHARD_LEASE_HANDOFFS.value
+    violations_before = metrics.CACHE_INVARIANT_VIOLATIONS.value
+
+    for i in range(0, n_nodes, 1000):
+        direct.create_list("nodes", [
+            _node_json(f"ha-{j:05d}")
+            for j in range(i, min(i + 1000, n_nodes))])
+    monitor = BindMonitor(store)
+    namespaces = [f"ha-ns-{i}" for i in range(n_namespaces)]
+    pod_seq = [0]
+    created = [0]
+
+    def create_pods(n: int, prefix: str) -> None:
+        objs = []
+        for k in range(n):
+            pod_seq[0] += 1
+            obj = _pod_json(f"{prefix}-{pod_seq[0]:06d}")
+            obj["metadata"]["namespace"] = \
+                namespaces[k % len(namespaces)]
+            objs.append(obj)
+        # Modest chunks: one huge POST fans out thousands of watch
+        # deliveries under the store lock while every incarnation
+        # drains — smaller bulks keep the server responsive.
+        for i in range(0, n, 250):
+            direct.create_list("pods", objs[i:i + 250])
+        created[0] += n
+
+    def wait_settled(timeout: float) -> float:
+        # Settle by the monitor's bind count, not a store relist: the
+        # driver polling a full deepcopied pod list every 100 ms is
+        # GIL/CPU time stolen from the daemons it is measuring (no pod
+        # is ever deleted in this wave, so created == bound is exact;
+        # the final stranded check below does one real list).
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if monitor.binds >= created[0]:
+                return time.monotonic() - t0
+            time.sleep(0.1)
+        return -1.0
+
+    # -- ownership, observed through the lease records themselves ------
+    from kubernetes_tpu.scheduler.shards import shard_lock_name
+    from kubernetes_tpu.utils.leaderelection import (
+        LEADER_ANNOTATION_KEY, LeaderElectionRecord)
+
+    def shard_holders() -> dict[int, str]:
+        """shard -> holder identity, straight off the CAS'd lease
+        records (works identically for in-process and subprocess
+        incarnations — the records ARE the coordination)."""
+        out: dict[int, str] = {}
+        for s in range(n_shards):
+            obj = store.get("endpoints",
+                            f"kube-system/{shard_lock_name(s)}")
+            ann = ((obj or {}).get("metadata") or {}) \
+                .get("annotations") or {}
+            raw = ann.get(LEADER_ANNOTATION_KEY)
+            if not raw:
+                out[s] = ""
+                continue
+            rec = LeaderElectionRecord.from_json(raw)
+            # A zeroed (released) record is nobody's.
+            out[s] = rec.holder_identity \
+                if rec.lease_duration_seconds > 0 else ""
+        return out
+
+    incarnations = [f"inc-{i}" for i in range(n_incarnations)]
+
+    def coverage(idents: set[str]) -> bool:
+        holders = shard_holders()
+        return all(h in idents for h in holders.values()) and \
+            len(holders) == n_shards
+
+    def balanced(idents: set[str]) -> bool:
+        holders = shard_holders()
+        per = {i: 0 for i in idents}
+        for h in holders.values():
+            if h not in per:
+                return False
+            per[h] += 1
+        return all(v > 0 for v in per.values())
+
+    def _scrape(port: int, path: str) -> str:
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.read().decode()
+
+    def _metric_sum(text: str, name: str) -> float:
+        total = 0.0
+        for line in text.splitlines():
+            if line.startswith(name) and not line.startswith("#"):
+                try:
+                    total += float(line.rsplit(None, 1)[-1])
+                except ValueError:
+                    pass
+        return total
+
+    def _free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    report: dict = {"n_shards": n_shards,
+                    "n_incarnations": n_incarnations,
+                    "n_namespaces": n_namespaces,
+                    "n_nodes": n_nodes,
+                    "lease_duration_s": lease_s,
+                    "chaos": chaos,
+                    "processes": processes,
+                    # The scale-out inequality (aggregate >= the phase-0
+                    # single-scheduler baseline) is only physically
+                    # reachable when the rig can actually run the
+                    # incarnations concurrently; check_ha arms it off
+                    # this column (cpus > n_incarnations) and falls back
+                    # to the committed-predecessor ratchet on a
+                    # serialized rig, where N schedulers timesharing one
+                    # core pay N× the watch fan-out for 1× the compute.
+                    "cpus": os.cpu_count()}
+    factories: list = []
+    children: list = []   # (name, Popen, status_port, log_path)
+    saved_env: dict = {}
+
+    def start_incarnations(names: list[str]) -> None:
+        if processes:
+            started = []
+            for name in names:
+                port = _free_port()
+                log_path = f"/tmp/kt_ha_{name}.log"
+                env = dict(os.environ)
+                env.update(ha_env)
+                env["KT_INCARNATION"] = name
+                log_f = open(log_path, "w")
+                try:
+                    child = subprocess.Popen(
+                        [sys.executable, "-m",
+                         "kubernetes_tpu.scheduler",
+                         "--api-server", proxy.base_url,
+                         "--port", str(port),
+                         "--kube-api-qps", "5000",
+                         "--kube-api-burst", "5000"],
+                        env=env, stdout=log_f,
+                        stderr=subprocess.STDOUT)
+                finally:
+                    # The child holds its own dup of the fd; ours would
+                    # otherwise leak one handle per incarnation per wave.
+                    log_f.close()
+                rec = [name, child, port, log_path]
+                children.append(rec)
+                started.append(rec)
+            # Readiness: the status mux answers once factory.run()
+            # (reflector sync + prewarm + recovery) completed.
+            deadline = time.monotonic() + 300
+            for name, child, port, log_path in started:
+                while time.monotonic() < deadline:
+                    if child.poll() is not None:
+                        raise RuntimeError(
+                            f"incarnation {name} died at startup; see "
+                            f"{log_path}")
+                    try:
+                        _scrape(port, "/healthz")
+                        break
+                    except Exception:  # noqa: BLE001 — not up yet
+                        time.sleep(0.25)
+                else:
+                    raise RuntimeError(f"{name} never became ready")
+            log(f"scheduler processes up: {names} (pids "
+                f"{[c[1].pid for c in started]})")
+        else:
+            from kubernetes_tpu.scheduler.factory import ConfigFactory
+            for name in names:
+                f = ConfigFactory(proxy.base_url, qps=5000, burst=5000,
+                                  ha_shards=n_shards, incarnation=name)
+                f.daemon.STREAM_THRESHOLD = stream_chunk
+                f.daemon.stream_chunk = stream_chunk
+                factories.append(f)
+                f.run()
+
+    def storm(waves: int, prefix: str) -> tuple[float, float]:
+        """Sustained multi-namespace waves; returns (pods/s, window s)."""
+        t0 = time.monotonic()
+        binds0 = monitor.binds
+        for w in range(waves):
+            create_pods(wave_pods, f"{prefix}{w}")
+            if wait_settled(settle_timeout) < 0:
+                raise RuntimeError(
+                    f"HA {prefix} wave {w} never settled")
+        window = time.monotonic() - t0
+        return ((monitor.binds - binds0) / max(window, 1e-9), window)
+
+    try:
+        if not processes:
+            saved_env = {k: os.environ.get(k) for k in ha_env}
+            os.environ.update(ha_env)
+
+        # -- Phase 0: ONE incarnation, the whole keyspace — the same-
+        # rig, same-chaos single-scheduler control that the aggregate
+        # rate is ratcheted against (a cross-artifact comparison would
+        # confound machine + scale; this one holds everything constant
+        # except the number of schedulers).
+        start_incarnations(incarnations[:1])
+        solo = {incarnations[0]}
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if coverage(solo):
+                break
+            time.sleep(0.05)
+        assert coverage(solo), \
+            f"solo incarnation never took every shard: {shard_holders()}"
+        create_pods(seed_pods, "seed")
+        settle_s = wait_settled(settle_timeout)
+        if settle_s < 0:
+            raise RuntimeError("HA seed wave never settled")
+        report["seed_settle_s"] = round(settle_s, 2)
+        log(f"seeded {seed_pods} pods across {n_namespaces} "
+            f"namespaces, settle {settle_s:.1f}s "
+            f"(solo {incarnations[0]})")
+        if chaos:
+            rules = (bind_conflict_storm(every_nth=7) +
+                     watch_cut_on_relist("pods", every_nth=3, count=8))
+            proxy.add_rules(rules)
+            report["chaos_rules"] = [r.to_json() for r in rules]
+        base_rate, base_window = storm(max(2, storm_waves // 2),
+                                       "base")
+        report["single_scheduler_pods_per_s"] = round(base_rate, 1)
+        report["baseline_window_s"] = round(base_window, 1)
+        log(f"single-scheduler baseline: {base_rate:.1f} pods/s over "
+            f"{base_window:.1f}s under chaos")
+
+        # -- Phase 1: the late joiners arrive live.  All shards must
+        # keep an owner — and every incarnation must end up holding at
+        # least one (the first starter holds everything until presence-
+        # driven rebalancing feeds the joiners) — before the aggregate
+        # storm begins.
+        start_incarnations(incarnations[1:])
+        deadline = time.monotonic() + 120
+        idents = set(incarnations)
+        while time.monotonic() < deadline:
+            if coverage(idents) and balanced(idents):
+                break
+            time.sleep(0.05)
+        shard_map: dict[str, list[int]] = {i: [] for i in incarnations}
+        for s, h in shard_holders().items():
+            if h in shard_map:
+                shard_map[h].append(s)
+        report["initial_shard_map"] = {k: sorted(v)
+                                       for k, v in shard_map.items()}
+        assert coverage(idents), \
+            f"shards unowned at start: {shard_holders()}"
+        assert all(shard_map[i] for i in incarnations), \
+            f"an incarnation never got a shard: {shard_map}"
+        log(f"shard map after rebalance {report['initial_shard_map']}")
+
+        # -- Phase 2: steady-state storm, every incarnation draining
+        # its shards concurrently.
+        agg_rate, storm_s = storm(storm_waves, "storm")
+        report["aggregate_steady_pods_per_s"] = round(agg_rate, 1)
+        report["storm_window_s"] = round(storm_s, 1)
+        log(f"storm: {agg_rate:.1f} pods/s aggregate over "
+            f"{storm_s:.1f}s (baseline {base_rate:.1f})")
+
+        # SIGKILL one incarnation mid-drain: inject a wave, wait until
+        # its queue is demonstrably busy, kill -9 (leases NOT released
+        # — they expire; the survivors' takeover clock starts here).
+        victim_name = incarnations[0]
+        victim_shards = sorted(
+            s for s, h in shard_holders().items() if h == victim_name)
+        create_pods(kill_wave_pods, "kill")
+        queue_at_kill = -1
+        deadline = time.monotonic() + 30
+        if processes:
+            vname, vchild, vport, _vlog = children[0]
+            while time.monotonic() < deadline:
+                try:
+                    import json as _json
+                    depth = _json.loads(
+                        _scrape(vport, "/debug/vars"))["queueDepth"]
+                except Exception:  # noqa: BLE001 — busy; try again
+                    depth = 0
+                if depth > 0:
+                    queue_at_kill = depth
+                    break
+                time.sleep(0.01)
+            t_kill = time.monotonic()
+            vchild.send_signal(signal.SIGKILL)
+            vchild.wait(timeout=10)
+        else:
+            victim = factories[0]
+            while time.monotonic() < deadline and \
+                    len(victim.daemon.queue) == 0:
+                time.sleep(0.005)
+            queue_at_kill = len(victim.daemon.queue)
+            t_kill = time.monotonic()
+            victim.abandon()
+        log(f"KILLED {victim_name} mid-drain (held shards "
+            f"{victim_shards}, queue {queue_at_kill})")
+
+        survivors = set(incarnations) - {victim_name}
+        while not coverage(survivors) and \
+                time.monotonic() - t_kill < 30:
+            time.sleep(0.005)
+        takeover_settle_s = time.monotonic() - t_kill
+        report["takeover"] = {
+            "victim": victim_name,
+            "victim_shards": victim_shards,
+            "queue_at_kill": queue_at_kill,
+            "takeover_settle_s": round(takeover_settle_s, 3),
+            "survivor_shard_map": {},
+        }
+        for s, h in shard_holders().items():
+            report["takeover"]["survivor_shard_map"] \
+                .setdefault(h, []).append(s)
+        log(f"survivors own all {n_shards} shards "
+            f"{takeover_settle_s * 1e3:.0f}ms after the kill")
+        kill_drain_s = wait_settled(settle_timeout)
+        if kill_drain_s < 0:
+            raise RuntimeError("post-kill backlog never drained")
+        report["takeover"]["kill_wave_drain_s"] = round(
+            time.monotonic() - t_kill, 2)
+        log(f"kill wave fully drained "
+            f"{time.monotonic() - t_kill:.1f}s after the kill")
+
+        # One more storm wave on the survivors, then reconcile.
+        create_pods(wave_pods, "post")
+        if wait_settled(settle_timeout) < 0:
+            raise RuntimeError("post-kill wave never settled")
+        time.sleep(max(lease_s, 0.5))  # confirms + late 409s drain
+        items, _ = store.list("pods")
+        stranded = sum(1 for o in items
+                       if not (o.get("spec") or {}).get("nodeName"))
+        if processes:
+            conflicts = handoffs = violations = 0.0
+            recoveries = []
+            for name, child, port, _lp in children[1:]:
+                try:
+                    import json as _json
+                    text = _scrape(port, "/metrics")
+                    conflicts += _metric_sum(
+                        text, "scheduler_cross_shard_bind_conflicts_"
+                              "total")
+                    handoffs += _metric_sum(
+                        text, "scheduler_shard_lease_handoffs_total")
+                    violations += _metric_sum(
+                        text, "scheduler_cache_invariant_violations_"
+                              "total")
+                    dv = _json.loads(_scrape(port, "/debug/vars"))
+                    recoveries += [r for r in
+                                   dv.get("shardRecoveries") or []
+                                   if r.get("handoff")]
+                except Exception:  # noqa: BLE001 — stats best-effort
+                    pass
+            report["takeover"]["shard_recoveries"] = recoveries[-12:]
+        else:
+            conflicts = metrics.CROSS_SHARD_CONFLICTS.value - \
+                conflicts_before
+            handoffs = metrics.SHARD_LEASE_HANDOFFS.value - \
+                handoffs_before
+            violations = metrics.CACHE_INVARIANT_VIOLATIONS.value - \
+                violations_before
+            report["takeover"]["shard_recoveries"] = [
+                r for f in factories[1:] for r in f.shard_recoveries
+                if r.get("handoff")][-12:]
+        report.update({
+            "pods_created": created[0],
+            "pods_bound": monitor.binds,
+            "double_binds": monitor.double_binds,
+            "stranded_pending": stranded,
+            "cross_shard_conflicts": int(conflicts),
+            "lease_handoffs": int(handoffs),
+            "invariant_violations": int(violations),
+            "chaos_injected": proxy.stats()["injected"],
+            "duration_s": round(time.monotonic() - t_start, 1),
+        })
+        log(f"done: {monitor.binds} binds, "
+            f"{monitor.double_binds} double binds, takeover "
+            f"{report['takeover']['takeover_settle_s']}s")
+        return report
+    finally:
+        monitor.stop()
+        for f in factories:
+            try:
+                f.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for name, child, port, _lp in children:
+            if child.poll() is None:
+                child.terminate()
+        for name, child, port, _lp in children:
+            if child.poll() is None:
+                try:
+                    child.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    child.kill()
+        proxy.stop()
+        api_srv.shutdown()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _reconcile(store: MemStore, factory, monitor: _BindMonitor) -> dict:
     """Post-soak apiserver-vs-oracle reconciliation: the acceptance
     invariants a mid-drain kill must not break."""
@@ -664,11 +1108,13 @@ def _restart_parity(store: MemStore, factory, samples: int = 50) -> dict:
                                          max(judged, 1), 2)}
 
 
-def collect(**kw) -> dict:
+def collect(ha: bool = True, **kw) -> dict:
     """bench.py's soak phase entry point, with the device-plane columns
     (per-cause transfer bytes-per-pod, HBM peak) stamped around the
     run — churn is exactly where a resident-state invalidation bug
-    turns scatters into silent full re-uploads."""
+    turns scatters into silent full re-uploads — and the active-active
+    HA wave appended as the artifact's ``ha`` section
+    (``BENCH_SOAK_HA=0`` skips it)."""
     from kubernetes_tpu.engine import devicestats
     before = devicestats.transfer_snapshot()
     rec = run_soak(**kw)
@@ -683,6 +1129,8 @@ def collect(**kw) -> dict:
         # bytes are windowed; the peak cannot be).
         "hbm_peak_bytes_process": devicestats.hbm_peak_bytes(),
     }
+    if ha and os.environ.get("BENCH_SOAK_HA", "1") != "0":
+        rec["ha"] = run_ha_wave(quiet=kw.get("quiet", False))
     return rec
 
 
@@ -694,11 +1142,15 @@ def main() -> None:
     ap.add_argument("--no-chaos", action="store_true")
     ap.add_argument("--no-device-chaos", action="store_true")
     ap.add_argument("--no-restart", action="store_true")
+    ap.add_argument("--no-ha", action="store_true",
+                    help="skip the active-active HA wave")
     opts = ap.parse_args()
     rec = run_soak(n_nodes=opts.nodes, duration_s=opts.duration,
                    chaos=not opts.no_chaos,
                    device_chaos=not opts.no_device_chaos,
                    restart=not opts.no_restart)
+    if not opts.no_ha:
+        rec["ha"] = run_ha_wave()
     with open(opts.out, "w") as f:
         json.dump(rec, f, indent=1)
         f.write("\n")

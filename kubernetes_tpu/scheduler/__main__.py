@@ -264,6 +264,15 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
                         CACHE_INVARIANT_VIOLATIONS.value,
                     "lastRecovery": getattr(factory, "last_recovery",
                                             None),
+                    # Active-active HA (scheduler/shards.py): this
+                    # incarnation's id, the shards it holds, and the
+                    # recent shard-takeover reconciles; null when
+                    # running single-scheduler (KT_HA_SHARDS=0).
+                    "ha": (factory.shards.report()
+                           if getattr(factory, "shards", None)
+                           is not None else None),
+                    "shardRecoveries": getattr(
+                        factory, "shard_recoveries", [])[-8:],
                     "cachedPods": cache.pod_count(),
                     "cachedNodes": len(cache.nodes()),
                     "cacheStats": cache.stats,

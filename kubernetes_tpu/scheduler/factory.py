@@ -115,7 +115,8 @@ class ConfigFactory:
                  scheduler_name: str = api.DEFAULT_SCHEDULER_NAME,
                  batched: bool = True,
                  qps: float = 50.0, burst: int = 100, token: str = "",
-                 tls=None):
+                 tls=None, ha_shards: Optional[int] = None,
+                 incarnation: str = ""):
         if isinstance(store, str):
             store = APIClient(store, qps=qps, burst=burst, token=token,
                               tls=tls)
@@ -155,6 +156,41 @@ class ConfigFactory:
         # by run() at KT_SLO_PERIOD cadence, reported on /debug/vars.
         from kubernetes_tpu.scheduler.slo import SLOMonitor
         self.slo = SLOMonitor()
+        # Active-active HA (scheduler/shards.py): KT_HA_SHARDS > 0 runs
+        # this incarnation as one of several over the same apiserver,
+        # scheduling only pods in shards whose lease it holds.  0 (the
+        # default) is the single-scheduler mode, byte-for-byte the old
+        # behavior.
+        import os
+        import uuid
+        if ha_shards is None:
+            ha_shards = int(os.environ.get("KT_HA_SHARDS", "0") or "0")
+        self.shards = None
+        # Bounded log of shard-takeover reconciles (served on
+        # /debug/vars next to lastRecovery).
+        self.shard_recoveries: list[dict] = []
+        if ha_shards > 0:
+            from kubernetes_tpu.scheduler.shards import ShardManager
+            incarnation = incarnation or \
+                os.environ.get("KT_INCARNATION", "") or \
+                f"scheduler-{uuid.uuid4().hex[:8]}"
+            lease_s = float(os.environ.get("KT_HA_LEASE_S", "3.0"))
+            # Lease clients must not compete with the drain loop for the
+            # main client's rate budget: a QPS-starved renew loses a
+            # healthy incarnation its shards mid-storm.
+            lease_client = store.clone(qps=0) \
+                if isinstance(store, APIClient) else store
+            self.shards = ShardManager(
+                lease_client, incarnation=incarnation,
+                n_shards=ha_shards,
+                lease_duration=lease_s,
+                renew_deadline=float(os.environ.get(
+                    "KT_HA_RENEW_S", str(lease_s * 2 / 3))),
+                retry_period=float(os.environ.get(
+                    "KT_HA_RETRY_S", str(lease_s / 6))),
+                on_acquired=self._on_shard_acquired,
+                on_lost=self._on_shard_lost)
+            self.daemon.owns_pod = self.shards.owns_pod
 
     # -- reflector handlers (factory.go:128-227) -------------------------
 
@@ -300,6 +336,97 @@ class ConfigFactory:
         except Exception:  # noqa: BLE001 — condition update is best-effort
             pass
 
+    # -- active-active HA (scheduler/shards.py) ---------------------------
+
+    def _shard_ns_test(self, shard: int):
+        from kubernetes_tpu.scheduler.shards import shard_of
+        n = self.shards.n_shards
+        return lambda ns: shard_of(ns, n) == shard
+
+    def _on_shard_acquired(self, shard: int, handoff: bool) -> None:
+        """Takeover reconcile BEFORE draining the shard: relist, adopt
+        the dead incarnation's landed binds, requeue its orphans (see
+        recovery.reconcile_shard for the safety argument).  Runs on the
+        shard manager's callback thread.  Retried on failure — a chaos
+        cut (or a flaky apiserver) killing THIS relist would otherwise
+        strand the shard's backlog until the periodic sweep; the sweep
+        is the backstop, not the plan."""
+        import time as _time
+
+        from kubernetes_tpu.scheduler import recovery
+        last_err = None
+        for attempt in range(3):
+            try:
+                report = recovery.reconcile_shard(
+                    self.daemon, self.store, shard,
+                    self._shard_ns_test(shard),
+                    scheduler_name=self.daemon.config.scheduler_name,
+                    # Assumes minted since we won this lease are the
+                    # live drain loop (the queue gate opened with the
+                    # ownership flip, before this callback ran) — only
+                    # pre-acquisition leftovers are stale.  The cutoff
+                    # and the clock it is compared under must share a
+                    # base, so both come from the shard manager.
+                    assumed_before=self.shards.acquired_at(shard),
+                    now=self.shards.now)
+                break
+            except Exception as err:  # noqa: BLE001 — retry the relist
+                last_err = err
+                _time.sleep(0.2 * (attempt + 1))
+        else:
+            log.warning("shard %d takeover reconcile failed after "
+                        "retries (%s); the periodic ownership sweep "
+                        "will converge it", shard, last_err)
+            return
+        report["handoff"] = handoff
+        self.shard_recoveries.append(report)
+        del self.shard_recoveries[:-32]
+
+    def _shard_sweep_loop(self, period: float,
+                          stale_assume_s: float) -> None:
+        """The convergence backstop: periodically re-derive every OWNED
+        shard's backlog from one relist.  Any pod a race dropped — an
+        event delivered while the shard was unowned, a takeover relist
+        lost to chaos, a backoff requeue shed mid-handoff — is picked
+        up here at the latest; the enqueue path dedupes (a pod already
+        queued, bound, or freshly assumed is skipped), so the sweep is
+        idempotent."""
+        from kubernetes_tpu.scheduler import recovery
+        while not self._stop.wait(period):
+            if self.shards is None or not self.shards.owned():
+                continue
+            try:
+                report = recovery.reconcile_shard(
+                    self.daemon, self.store, -1,
+                    self.shards.owns_namespace,
+                    scheduler_name=self.daemon.config.scheduler_name,
+                    # Shards we are actively draining: a YOUNG assume
+                    # is a live in-flight bind (leave it alone); one
+                    # older than any healthy bind round-trip is a leak
+                    # to repair (forget + requeue — the CAS keeps a
+                    # still-racing duplicate safe).
+                    min_assume_age_s=stale_assume_s)
+                if report["requeued"] or report["expired"]:
+                    log.info("ownership sweep repaired state: %s",
+                             report)
+            except Exception:  # noqa: BLE001 — next sweep retries
+                log.exception("ownership sweep failed; retrying next "
+                              "period")
+
+    def _on_shard_lost(self, shard: int) -> None:
+        """Shed a lost shard: drop its queued pods (the new owner's
+        takeover relist covers them) and forget our optimistic assumes
+        there, releasing the phantom capacity.  In-flight binds are NOT
+        chased — the apiserver CAS settles those races."""
+        in_shard = self._shard_ns_test(shard)
+        dropped = self.daemon.queue.delete_matching(
+            lambda pod: in_shard(pod.namespace))
+        forgotten = self.algorithm.cache.forget_pods_matching(
+            lambda pod: in_shard(pod.namespace))
+        if dropped or forgotten:
+            log.info("shard %d lost: dropped %d queued pod(s), forgot "
+                     "%d assume(s)", shard, dropped, len(forgotten))
+
     # -- lifecycle -------------------------------------------------------
 
     def run(self) -> "ConfigFactory":
@@ -361,6 +488,24 @@ class ConfigFactory:
                 self.algorithm.cache, resident=self.algorithm.resident,
                 truth=lambda: self.store.list("pods")[0])
             self._threads.append(self.verifier.run(period=verify_period))
+        if self.shards is not None:
+            # Shard leases start AFTER reflectors sync and the full
+            # startup reconcile: each acquisition's takeover relist then
+            # lands on a warm cache, and the drain loop below only ever
+            # sees pods in shards this incarnation actually holds.
+            self.shards.run()
+            self._threads.extend(self.shards.threads)
+            sweep_s = float(os.environ.get("KT_HA_SWEEP_S", "10")
+                            or "0")
+            stale_assume_s = float(os.environ.get(
+                "KT_HA_STALE_ASSUME_S", "3") or "3")
+            if sweep_s > 0:
+                t = threading.Thread(target=self._shard_sweep_loop,
+                                     args=(sweep_s, stale_assume_s),
+                                     daemon=True,
+                                     name="shard-ownership-sweep")
+                t.start()
+                self._threads.append(t)
         self._threads.append(self.daemon.run(batched=self.batched))
 
         def ttl_sweep():  # cleanupAssumedPods (cache.go:309-330)
@@ -374,6 +519,10 @@ class ConfigFactory:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.shards is not None:
+            # Release the leases FIRST so peers take over within a
+            # retry period instead of waiting out the lease duration.
+            self.shards.stop()
         for r in self._reflectors:
             r.stop()
         if self.verifier is not None:
@@ -393,6 +542,10 @@ class ConfigFactory:
         kill -9 would leave it.  The next incarnation's startup
         reconciliation cleans up (scheduler/recovery.py)."""
         self._stop.set()
+        if self.shards is not None:
+            # No lease release: a kill -9 leaves the shard leases to
+            # expire on their own — the survivors' takeover clock.
+            self.shards.abandon()
         for r in self._reflectors:
             r.stop()
         if self.verifier is not None:

@@ -174,6 +174,25 @@ class FIFO:
                     self._gang_deadline.pop(name, None)
             # Lazy removal: stale heap keys are skipped at pop time.
 
+    def delete_matching(self, pred) -> int:
+        """Remove every queued/held pod whose OBJECT matches ``pred`` —
+        the shard-handoff drop: an incarnation that lost a shard's lease
+        sheds that shard's pods in one pass instead of popping (and
+        half-scheduling) them.  Returns the number removed."""
+        removed = 0
+        with self._lock:
+            for key in [k for k, p in self._items.items() if pred(p)]:
+                self._items.pop(key, None)
+                removed += 1
+            for name, hold in list(self._gang_hold.items()):
+                for key in [k for k, p in hold.items() if pred(p)]:
+                    hold.pop(key, None)
+                    removed += 1
+                if not hold:
+                    self._gang_hold.pop(name, None)
+                    self._gang_deadline.pop(name, None)
+        return removed
+
     def close(self) -> None:
         with self._lock:
             self._closed = True

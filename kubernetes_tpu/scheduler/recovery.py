@@ -32,7 +32,7 @@ sync and before the drain loop starts (``KT_RECOVERY=0`` opts out).
 from __future__ import annotations
 
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.utils import metrics
@@ -115,4 +115,138 @@ def reconcile(daemon, store, scheduler_name: Optional[str] = None) -> dict:
     if any(report[a] for a in ("readopted", "requeued", "expired",
                                "removed")):
         log.info("restart reconciliation repaired state: %s", report)
+    return report
+
+
+def reconcile_shard(daemon, store, shard: int, owns,
+                    scheduler_name: Optional[str] = None,
+                    min_assume_age_s: float = 0.0,
+                    assumed_before: Optional[float] = None,
+                    now: Callable[[], float] = time.monotonic) -> dict:
+    """Shard-takeover reconciliation (active-active HA,
+    scheduler/shards.py): the survivor that just won shard ``shard``'s
+    orphaned lease re-derives that shard's backlog from one apiserver
+    relist BEFORE draining it.
+
+    The dead incarnation's in-flight window decomposes exactly like a
+    restart, restricted to the shard:
+
+    * pods it ASSUMED whose binds never landed are unbound at the
+      relist — they belong on OUR queue now (the dead daemon's assume
+      lived only in its process memory, so there is nothing to forget
+      here; our own stale assumes from a previous ownership spell ARE
+      forgotten);
+    * pods whose binds DID land show bound — our cache either confirmed
+      them from the watch already or adopts them here;
+    * a ZOMBIE bind still in the dead daemon's pipe either landed
+      before the list (adopted above) or lands after and meets the
+      apiserver's nodeName CAS: if we re-bound the pod first the zombie
+      409s into nothing; if the zombie wins first, OUR bind 409s and
+      the ordinary forget+requeue path absorbs it.  Either way the pod
+      binds exactly once — the safety argument is the CAS, the lease
+      only minimizes how often it is needed.
+
+    ``owns(namespace) -> bool`` is the membership test for the pods
+    this takeover covers — the factory passes the single-shard test
+    ``shard_of(ns) == shard`` so a takeover never re-walks shards
+    already held.  Returns the action report.
+
+    Only the PENDING set is listed (``spec.nodeName=`` server-side,
+    where the store supports field selectors): bound pods are already
+    live-synced into every incarnation's cache by its assigned-pod
+    reflector, so re-walking them here would make each takeover an
+    O(all-pods) JSON parse — measured in the HA soak, exactly the load
+    spike that starved the renew loop into a handoff death spiral.
+
+    ``assumed_before`` / ``min_assume_age_s`` distinguish the two
+    callers' stale-assume tests.  A TAKEOVER passes ``assumed_before``
+    = the shard's lease-acquisition timestamp (``time.monotonic``
+    base): an assume MINTED BEFORE we won the lease is a leftover of an
+    earlier ownership spell (losing the shard forgot our assumes, so
+    anything older than the acquisition predates the handoff) and is
+    forgotten, while one minted SINCE is our own live in-flight bind —
+    the queue gate opens the moment the tick thread flips ownership,
+    so the drain loop can legitimately assume pods in the seconds
+    before this reconcile runs, and forgetting those would free their
+    nodes' capacity while the binds land anyway (transient overcommit
+    plus a duplicate 409).  Age alone cannot make that call: a
+    pre-handoff leftover can be merely milliseconds older than a
+    post-acquisition live assume.  The periodic ownership SWEEP has no
+    acquisition edge to anchor on — it runs over shards we are
+    steadily draining — so it uses the age threshold instead: a YOUNG
+    assume is usually a live in-flight bind, but an OLD one is a leak
+    (a bind result lost to chaos) that would otherwise strand its pod
+    until the cache TTL; the sweep passes a threshold above any
+    healthy bind round-trip (KT_HA_STALE_ASSUME_S, default 3 s),
+    forgetting only assumes older than that.  The bind CAS keeps a
+    still-racing duplicate safe under either test."""
+    t0 = time.perf_counter()
+    cache = daemon.config.algorithm.cache
+    try:
+        items, _rv = store.list("pods", field_selector="spec.nodeName=")
+    except TypeError:  # raw MemStore: no field selectors; filter here
+        items, _rv = store.list("pods")
+    report = {"shard": shard, "readopted": 0, "requeued": 0,
+              "expired": 0, "confirmed": 0, "pods_in_shard": 0}
+    for obj in items:
+        key = api.key_from_json(obj)
+        if api.is_terminated_json(obj):
+            continue
+        if not owns((obj.get("metadata") or {}).get("namespace") or ""):
+            continue
+        report["pods_in_shard"] += 1
+        node = (obj.get("spec") or {}).get("nodeName") or ""
+        if node:
+            if cache.confirm_assumed(key, node):
+                report["confirmed"] += 1
+            else:
+                tracked = cache.get_pod(key)
+                if tracked is None or tracked.node_name != node:
+                    cache.add_pod(api.pod_from_json(obj))
+                    report["readopted"] += 1
+            daemon.queue.delete(key)
+        else:
+            if cache.is_assumed(key):
+                age = cache.assumed_age(key)
+                if assumed_before is not None:
+                    # ``now`` must share the cutoff's clock base (the
+                    # factory passes the shard manager's clock together
+                    # with its acquisition stamp; cache ages are
+                    # durations, transferable between bases ticking at
+                    # wall rate).
+                    birth = now() - age if age is not None else None
+                    if birth is None or birth >= assumed_before:
+                        continue  # minted under OUR ownership: live
+                elif min_assume_age_s > 0.0 and \
+                        (age is None or age < min_assume_age_s):
+                    continue  # live in-flight bind; not ours to undo
+                pod = cache.get_pod(key)
+                if pod is None:
+                    continue  # confirmed/forgotten under us: not ours
+                try:
+                    cache.forget_pod(pod)
+                except ValueError:
+                    # A live bind thread confirmed or forgot this
+                    # assume between our is_assumed read and here (the
+                    # race scheduler._forget_quietly also tolerates) —
+                    # the pod is no longer ours to expire, and one
+                    # contested pod must not abort the rest of the
+                    # pass (nor count as a phantom repair).
+                    continue
+                pod.node_name = ""
+                report["expired"] += 1
+            if key not in daemon.queue:
+                pod = api.pod_from_json(obj)
+                if scheduler_name is None or \
+                        pod.scheduler_name == scheduler_name:
+                    daemon.enqueue(pod)
+                    if key in daemon.queue:
+                        report["requeued"] += 1
+    for action in ("readopted", "requeued", "expired", "confirmed"):
+        if report[action]:
+            metrics.RESTART_RECONCILE.labels(action=action).inc(
+                report[action])
+    report["duration_s"] = round(time.perf_counter() - t0, 4)
+    if report["requeued"] or report["expired"] or report["readopted"]:
+        log.info("shard %d takeover reconciled: %s", shard, report)
     return report

@@ -81,7 +81,14 @@ class Scheduler:
     def __init__(self, config: SchedulerConfig):
         self.config = config
         self.queue = FIFO()
-        self.backoff = PodBackoff()
+        # Failure-requeue backoff, env-tunable (the reference's
+        # --pod-backoff knobs): chaos/soak rigs and latency-sensitive
+        # fleets compress it; the defaults are the reference's 1s -> 60s.
+        self.backoff = PodBackoff(
+            default_duration=float(os.environ.get(
+                "KT_POD_BACKOFF_S", "1") or "1"),
+            max_duration=float(os.environ.get(
+                "KT_POD_BACKOFF_MAX_S", "60") or "60"))
         # Stream floor, read ONCE at startup: the pre-warm pass and the
         # small-drain bucket computation must agree on the ladder for the
         # daemon's whole lifetime (a later env change would mint shapes
@@ -124,6 +131,12 @@ class Scheduler:
         # leftovers (pods deleted while pending) are pruned when the
         # registry outgrows its bound.
         self._first_seen: dict[str, float] = {}
+        # Active-active HA hook (scheduler/shards.py): when set, this
+        # incarnation enqueues only pods whose namespace shard it holds
+        # — the queue feed, the backoff requeue worker, and the
+        # cross-shard 409 counter all consult it.  None = own everything
+        # (the single-scheduler default).
+        self.owns_pod: Optional[Callable[[api.Pod], bool]] = None
         self._stop = threading.Event()
         self._bind_threads: list[threading.Thread] = []
         # Single requeue worker over a timer heap (a thread per failed pod
@@ -164,6 +177,12 @@ class Scheduler:
         return pod.scheduler_name == self.config.scheduler_name
 
     def enqueue(self, pod: api.Pod) -> None:
+        if self.owns_pod is not None and not self.owns_pod(pod):
+            # Sharded HA: another incarnation holds this namespace's
+            # shard lease; its owner schedules it.  Takeover relists
+            # (recovery.reconcile_shard) re-deliver anything dropped
+            # here if the shard later becomes ours.
+            return
         if self.responsible_for(pod) and not pod.node_name:
             # Admission timestamp for the e2e decision-latency SLO
             # (first-seen -> bind ack): the registry keeps the EARLIEST
@@ -723,6 +742,27 @@ class Scheduler:
 
     # -- internals --------------------------------------------------------
 
+    def _record_bind_failure(self, err) -> str:
+        """The module-level classifier plus the HA plane's cross-shard
+        accounting: a CAS conflict observed while running sharded means
+        another incarnation (or a chaos rule) bound the pod first —
+        near-zero in steady state, bursty during lease handoffs."""
+        result = _record_bind_failure(err)
+        if result == "bind_conflict" and self.owns_pod is not None:
+            metrics_mod.CROSS_SHARD_CONFLICTS.inc()
+        return result
+
+    def _forget_quietly(self, pod: api.Pod) -> None:
+        """Forget a failed bind's optimistic assume; tolerates the pod
+        being gone already — a shard handoff (factory._on_shard_lost
+        forgets the lost shard's assumes wholesale) can race the bind
+        fan-out, and the loser of that race must requeue-or-drop, not
+        die on a ValueError in the bind thread."""
+        try:
+            self.config.algorithm.cache.forget_pod(pod)
+        except ValueError:
+            pass
+
     def _assume_and_bind(self, pod: api.Pod, dest: str, start: float) -> None:
         cache = self.config.algorithm.cache
         # Optimistic assume before the async bind; an assume error is logged
@@ -750,7 +790,6 @@ class Scheduler:
 
     def _bind_assumed(self, pod: api.Pod, dest: str, start: float,
                       assumed: bool = True) -> None:
-        cache = self.config.algorithm.cache
         bind_start = time.perf_counter()
         try:
             with stage("bind", pods=1):
@@ -759,9 +798,9 @@ class Scheduler:
             # ForgetPod + error handler (scheduler.go:139-148).  409 and
             # timeout alike: forget the optimistic assume, emit the event,
             # requeue behind per-pod backoff — never silently drop.
-            result = _record_bind_failure(err)
+            result = self._record_bind_failure(err)
             if assumed:
-                cache.forget_pod(pod)
+                self._forget_quietly(pod)
             self._handle_failure(pod, "FailedScheduling",
                                  f"Binding rejected: {err}",
                                  result=result)
@@ -798,7 +837,6 @@ class Scheduler:
 
     def _bind_assumed_batch_inner(self, placed: list[tuple[api.Pod, str]],
                                   start: float) -> None:
-        cache = self.config.algorithm.cache
         recorder = self.config.recorder
         bind_start = time.perf_counter()
         bind_many = getattr(self.config.binder, "bind_many", None)
@@ -809,8 +847,8 @@ class Scheduler:
             items = []
             for pod, dest in placed:
                 if pod.key in failed:
-                    result = _record_bind_failure(failed[pod.key])
-                    cache.forget_pod(pod)
+                    result = self._record_bind_failure(failed[pod.key])
+                    self._forget_quietly(pod)
                     # Surface the real error: a CAS conflict and a
                     # network failure require different operator action.
                     self._handle_failure(
@@ -829,8 +867,8 @@ class Scheduler:
                 try:
                     self.config.binder.bind(pod, dest)
                 except Exception as err:  # noqa: BLE001 — bind errors requeue
-                    result = _record_bind_failure(err)
-                    cache.forget_pod(pod)
+                    result = self._record_bind_failure(err)
+                    self._forget_quietly(pod)
                     self._handle_failure(pod, "FailedScheduling",
                                          f"Binding rejected: {err}",
                                          result=result)
@@ -904,4 +942,11 @@ class Scheduler:
                     continue
                 heapq.heappop(self._requeue_heap)
             pod.node_name = ""
+            if self.owns_pod is not None and not self.owns_pod(pod):
+                # The shard moved while this pod sat in backoff: its new
+                # owner schedules it (the takeover relist already
+                # requeued it there); re-adding here would race two
+                # incarnations on one pod as the steady state.
+                self._first_seen.pop(pod.key, None)
+                continue
             self.queue.add(pod)

@@ -13,6 +13,7 @@ a real control plane."""
 from __future__ import annotations
 
 import json
+import random
 import threading
 import time
 from dataclasses import dataclass, field
@@ -96,6 +97,21 @@ class APIResourceLock:
         # (server.go:147 uses the kube-system namespace).
         self.client = client
         self.kind = kind
+        # Probe ONCE whether the client's update takes the explicit CAS
+        # precondition kwarg (a raw MemStore does; APIClient derives the
+        # same precondition server-side from the body's
+        # resourceVersion).  A per-call try/except TypeError would both
+        # pay a raised exception on every CAS round AND mistake a
+        # TypeError escaping from INSIDE a capable client's update for
+        # "kwarg unsupported", silently retrying as a blind non-CAS
+        # overwrite — the exact two-winners split-brain this
+        # precondition exists to close.
+        try:
+            import inspect
+            self._cas_kwarg = "expected_rv" in \
+                inspect.signature(client.update).parameters
+        except (TypeError, ValueError):  # uninspectable callable
+            self._cas_kwarg = False
         self.name = name
         self.namespace = namespace
 
@@ -124,12 +140,23 @@ class APIResourceLock:
         return ann, int(meta.get("resourceVersion", "0") or "0")
 
     def update(self, value: str, expected_version: int) -> bool:
+        obj = {"metadata": {"name": self.name,
+                            "namespace": self.namespace,
+                            "resourceVersion": str(expected_version),
+                            "annotations": {LEADER_ANNOTATION_KEY: value}}}
         try:
-            self.client.update(self.kind, {
-                "metadata": {"name": self.name,
-                             "namespace": self.namespace,
-                             "resourceVersion": str(expected_version),
-                             "annotations": {LEADER_ANNOTATION_KEY: value}}})
+            # A raw MemStore only CASes when the precondition is passed
+            # EXPLICITLY (its ``expected_rv`` kwarg); without it two
+            # racing acquirers both "win" the same version and both
+            # believe they lead.  Over HTTP the PUT handler derives the
+            # same precondition from the body's resourceVersion, so the
+            # plain call stays a CAS.  Capability probed once at
+            # construction (see __init__).
+            if self._cas_kwarg:
+                self.client.update(self.kind, obj,
+                                   expected_rv=str(expected_version))
+            else:
+                self.client.update(self.kind, obj)
             return True
         except Exception:  # noqa: BLE001 — CAS conflict or apiserver error
             return False
@@ -146,6 +173,11 @@ class LeaderElector:
     lease_duration: float = DEFAULT_LEASE_DURATION
     renew_deadline: float = DEFAULT_RENEW_DEADLINE
     retry_period: float = DEFAULT_RETRY_PERIOD
+    # Fractional jitter on every retry/renew sleep (0.2 = up to +20 %):
+    # N electors renewing N leases against one apiserver must not phase-
+    # lock into a thundering herd of simultaneous CAS rounds — the
+    # multi-lease shard manager runs one elector per shard.
+    jitter: float = 0.0
     on_started_leading: Optional[Callable[[], None]] = None
     on_stopped_leading: Optional[Callable[[], None]] = None
     now: Callable[[], float] = time.monotonic
@@ -156,6 +188,35 @@ class LeaderElector:
     def is_leader(self) -> bool:
         return self._observed is not None and \
             self._observed.holder_identity == self.identity
+
+    def observed_holder(self) -> str:
+        """Identity of the last observed lease holder ("" when the lease
+        has never been observed held)."""
+        return self._observed.holder_identity if self._observed else ""
+
+    def lease_dead(self) -> bool:
+        """True when the last observed record's lease has expired by
+        this elector's clock (or no record was ever observed) — the
+        precondition under which ``try_acquire_or_renew`` would attempt
+        a steal rather than bounce off a live holder."""
+        return self.lease_remaining() <= 0.0
+
+    def lease_remaining(self) -> float:
+        """Seconds until the last observed record's lease expires by
+        this elector's clock (<= 0 = expired; -inf when nothing was
+        ever observed).  Observers use this to tighten their probe
+        cadence as a foreign lease nears death, so a crashed holder is
+        noticed ~one retry period after expiry, not one renew deadline."""
+        if self._observed is None:
+            return float("-inf")
+        return self._observed_at + \
+            self._observed.lease_duration_seconds - self.now()
+
+    def _sleep(self) -> float:
+        """The jittered retry period (never less than retry_period)."""
+        if self.jitter <= 0.0:
+            return self.retry_period
+        return self.retry_period * (1.0 + self.jitter * random.random())
 
     def try_acquire_or_renew(self) -> bool:
         """One CAS round (leaderelection.go:244-330)."""
@@ -181,6 +242,24 @@ class LeaderElector:
                                 if old and old.holder_identity != self.identity
                                 else (old.leader_transitions if old else 0)))
         if not self.lock.update(record.to_json(), version):
+            # Lost the CAS.  Re-observe IMMEDIATELY: without this, a
+            # holder whose lease was stolen between its get and its
+            # update keeps ``_observed`` pointing at its own old record
+            # and ``is_leader()`` stays True until the next round —
+            # exactly the split-brain belief window the 409 exists to
+            # close.  A transient conflict that re-reads our own record
+            # (an unrelated rv bump) changes nothing, so the reference's
+            # keep-leading-until-renew-deadline behavior is preserved.
+            try:
+                raw2, _ = self.lock.get()
+            except Exception:  # noqa: BLE001 — observe is best-effort
+                return False
+            new = LeaderElectionRecord.from_json(raw2) if raw2 else None
+            if new is not None and (
+                    self._observed is None or
+                    self._observed.to_json() != new.to_json()):
+                self._observed = new
+                self._observed_at = self.now()
             return False
         self._observed = record
         self._observed_at = now
@@ -193,7 +272,7 @@ class LeaderElector:
                 # Acquire phase.
                 while not self._stop.is_set() and \
                         not self.try_acquire_or_renew():
-                    self._stop.wait(self.retry_period)
+                    self._stop.wait(self._sleep())
                 if self._stop.is_set():
                     return
                 if self.on_started_leading is not None:
@@ -206,10 +285,10 @@ class LeaderElector:
                         if self.try_acquire_or_renew():
                             renewed = True
                             break
-                        self._stop.wait(self.retry_period)
+                        self._stop.wait(self._sleep())
                     if not renewed:
                         break
-                    self._stop.wait(self.retry_period)
+                    self._stop.wait(self._sleep())
                 if self.on_stopped_leading is not None:
                     self.on_stopped_leading()
         t = threading.Thread(target=loop, daemon=True, name="leader-elector")

@@ -632,6 +632,32 @@ SLO_BUDGET_REMAINING = register(Gauge(
     "scheduler_slo_budget_remaining",
     "Fraction of the decision-latency error budget left over the "
     "longest burn window (1.0 = untouched, 0.0 = exhausted)"))
+# Active-active HA plane (scheduler/shards.py): several scheduler
+# incarnations share one apiserver, sharded by namespace hash with
+# lease-based shard ownership; the bind CAS is the cross-shard safety
+# net while leases hand off.
+INCARNATION_INFO = register(Gauge(
+    "scheduler_incarnation_info",
+    "Info gauge (value always 1) naming this process's scheduler "
+    "incarnation id — the lease holder identity the shard locks carry",
+    labelnames=("incarnation",)))
+SHARDS_OWNED = register(Gauge(
+    "scheduler_shards_owned",
+    "Namespace-hash shards whose lease this incarnation currently "
+    "holds (it schedules only pods in owned shards)",
+    labelnames=("incarnation",)))
+SHARD_LEASE_HANDOFFS = register(Counter(
+    "scheduler_shard_lease_handoffs_total",
+    "Shard leases this incarnation acquired from a DIFFERENT previous "
+    "holder (a takeover after a peer died or released), as opposed to "
+    "first-ever acquisitions of a virgin lease",
+    labelnames=("incarnation",)))
+CROSS_SHARD_CONFLICTS = register(Counter(
+    "scheduler_cross_shard_bind_conflicts_total",
+    "Bind CAS conflicts observed while running sharded (KT_HA_SHARDS "
+    "> 0): another incarnation (or a chaos rule) bound the pod first — "
+    "the steady state should keep this near zero; bursts mark lease "
+    "handoff windows where two incarnations briefly race one shard"))
 # Bind path (scheduler/scheduler.py).
 BIND_CONFLICTS = register(Counter(
     "scheduler_bind_conflicts_total",

@@ -301,3 +301,171 @@ def test_serving_p99_regression_beyond_tolerance_fails():
 def test_serving_green_artifact_passes_alone():
     assert cb.check_serving([("SERVING_r08.json", _serving())]) == []
     assert cb.check_serving([]) == []
+
+
+# -- backend re-baselining (ISSUE 11 satellite) ------------------------------
+
+def test_backend_change_rebaselines_wall_clock_rows():
+    """A p50 measured on a different accelerator backend is a new
+    baseline, not a regression: 23 s of CPU scan vs 1.3 s of TPU scan
+    says nothing about the code between the artifacts."""
+    arts = [("BENCH_r05.json", _parsed(p50=1.3)),
+            ("BENCH_r11.json", dict(_parsed(p50=23.0), backend="cpu"))]
+    assert cb.check(arts) == []
+    # Same backend on both sides: the comparison is live again.
+    arts = [("BENCH_r11.json", dict(_parsed(p50=23.0), backend="cpu")),
+            ("BENCH_r12.json", dict(_parsed(p50=30.0), backend="cpu"))]
+    problems = cb.check(arts)
+    assert len(problems) == 1 and "regressed" in problems[0]
+
+
+def test_backend_change_keeps_invariant_rows():
+    """Re-baselining covers WALL-CLOCK rows only: a dropped stage or a
+    post-prewarm compile still fails across a backend change."""
+    stages = {"solve": {"seconds": 0.4}, "bind": {"seconds": 0.2}}
+    arts = [("BENCH_r05.json", _parsed(p50=1.3, stages=stages)),
+            ("BENCH_r11.json",
+             dict(_parsed(p50=23.0, stages={"solve": {"seconds": 20.0}},
+                          device=_device(compiles=2)), backend="cpu"))]
+    problems = cb.check(arts)
+    assert any("disappeared" in p for p in problems)
+    assert any("post-prewarm" in p for p in problems)
+
+
+def test_soak_settle_rebaselines_across_backend_change():
+    arts = [("SOAK_r10.json", _soak(settle=1.7)),
+            ("SOAK_r11.json", dict(_soak(settle=4.0), backend="cpu"))]
+    assert cb.check_soak(arts) == []
+
+
+def test_soak_settle_scans_back_past_foreign_backend_artifacts():
+    """A mixed-backend history must not retire the wall-clock ratchet:
+    the settle row compares against the LAST same-backend artifact,
+    not just the immediate predecessor."""
+    arts = [("SOAK_r10.json", dict(_soak(settle=1.0), backend="cpu")),
+            ("SOAK_r11.json", _soak(settle=1.7)),  # tpu interlude
+            ("SOAK_r12.json", dict(_soak(settle=9.0), backend="cpu"))]
+    problems = cb.check_soak(arts)
+    assert len(problems) == 1 and "settle regressed" in problems[0] \
+        and "SOAK_r10" in problems[0]
+    ok = [arts[0], arts[1],
+          ("SOAK_r12.json", dict(_soak(settle=1.05), backend="cpu"))]
+    assert cb.check_soak(ok) == []
+
+
+# -- active-active HA ratchet (ISSUE 11) -------------------------------------
+
+def _ha(double_binds=0, stranded=0, violations=0, takeover=0.6,
+        agg=500.0, baseline=450.0, cpus=8):
+    return {"double_binds": double_binds,
+            "stranded_pending": stranded,
+            "invariant_violations": violations,
+            "takeover": {"takeover_settle_s": takeover,
+                         "victim": "inc-0",
+                         "queue_at_kill": 900},
+            "aggregate_steady_pods_per_s": agg,
+            "single_scheduler_pods_per_s": baseline,
+            "n_incarnations": 3,
+            "cpus": cpus,
+            "lease_handoffs": 3,
+            "cross_shard_conflicts": 12}
+
+
+def test_repo_ha_artifacts_pass_the_ratchet():
+    problems = cb.check_ha()
+    assert problems == [], problems
+
+
+def test_ha_artifacts_predating_the_wave_ratchet_nothing():
+    assert cb.check_ha([("SOAK_r10.json", _soak())]) == []
+    assert cb.check_ha([]) == []
+
+
+def test_ha_double_bind_fails():
+    problems = cb.check_ha(
+        [("SOAK_r11.json", dict(_soak(), ha=_ha(double_binds=1)))])
+    assert len(problems) == 1 and "double-bind" in problems[0]
+
+
+def test_ha_stranded_pod_fails():
+    problems = cb.check_ha(
+        [("SOAK_r11.json", dict(_soak(), ha=_ha(stranded=4)))])
+    assert len(problems) == 1 and "stranded" in problems[0]
+
+
+def test_ha_slow_takeover_fails():
+    problems = cb.check_ha(
+        [("SOAK_r11.json", dict(_soak(), ha=_ha(takeover=1.4)))])
+    assert len(problems) == 1 and "takeover" in problems[0]
+    assert cb.check_ha(
+        [("SOAK_r11.json", dict(_soak(), ha=_ha(takeover=0.99)))]) == []
+
+
+def test_ha_missing_takeover_or_rate_fails():
+    ha = _ha()
+    del ha["takeover"]
+    problems = cb.check_ha([("SOAK_r11.json", dict(_soak(), ha=ha))])
+    assert len(problems) == 1 and "takeover_settle_s" in problems[0]
+    ha = _ha()
+    ha["aggregate_steady_pods_per_s"] = 0
+    problems = cb.check_ha([("SOAK_r11.json", dict(_soak(), ha=ha))])
+    assert len(problems) == 1 and "aggregate" in problems[0]
+
+
+def test_ha_aggregate_below_single_scheduler_baseline_fails():
+    """The controlled scale-out bar: the aggregate must not fall below
+    the wave's OWN phase-0 single-scheduler baseline (same storm, same
+    rig, same chaos, one incarnation holding every shard — the only
+    variable is the scheduler count)."""
+    art = dict(_soak(), ha=_ha(agg=300.0, baseline=352.5))
+    problems = cb.check_ha([("SOAK_r11.json", art)])
+    assert len(problems) == 1 and "below" in problems[0]
+    good = dict(_soak(), ha=_ha(agg=400.0, baseline=352.5))
+    assert cb.check_ha([("SOAK_r11.json", good)]) == []
+    # A hair's-width miss is measurement noise (both sides are single
+    # noisy storm measurements), not a regression: the rate rows carry
+    # a tolerance like every other wall-clock ratchet.
+    near = dict(_soak(), ha=_ha(agg=340.0, baseline=352.5))
+    assert cb.check_ha([("SOAK_r11.json", near)]) == []
+
+
+def test_ha_missing_single_scheduler_baseline_fails():
+    ha = _ha()
+    del ha["single_scheduler_pods_per_s"]
+    problems = cb.check_ha([("SOAK_r11.json", dict(_soak(), ha=ha))])
+    assert len(problems) == 1 and "baseline" in problems[0]
+
+
+def test_ha_scale_out_bar_disarmed_on_serialized_rig():
+    """On a rig that cannot run the incarnations concurrently (cpus <=
+    n_incarnations) the aggregate-vs-baseline inequality is physically
+    unreachable — N CPU-bound schedulers timeshare one core — so the
+    aggregate is pinned by the predecessor ratchet instead."""
+    art = dict(_soak(), ha=_ha(agg=180.0, baseline=900.0, cpus=1))
+    assert cb.check_ha([("SOAK_r11.json", art)]) == []
+    # Same numbers on a parallel rig: the bar arms and fails.
+    art = dict(_soak(), ha=_ha(agg=180.0, baseline=900.0, cpus=8))
+    problems = cb.check_ha([("SOAK_r11.json", art)])
+    assert len(problems) == 1 and "below" in problems[0]
+
+
+def test_ha_aggregate_ratchets_against_predecessors_ha_wave():
+    """Artifact-over-artifact, the bar is the predecessor's own HA
+    aggregate — but only within one backend (wall-clock rows
+    re-baseline on a device change, like density p50)."""
+    prev = dict(_soak(), backend="cpu", ha=_ha(agg=800.0))
+    arts = [("SOAK_r11.json", prev),
+            ("SOAK_r12.json", dict(_soak(), backend="cpu",
+                                   ha=_ha(agg=700.0)))]
+    problems = cb.check_ha(arts)
+    assert len(problems) == 1 and "HA aggregate" in problems[0]
+    # Within tolerance of the predecessor: noise, not a regression.
+    arts = [("SOAK_r11.json", prev),
+            ("SOAK_r12.json", dict(_soak(), backend="cpu",
+                                   ha=_ha(agg=770.0)))]
+    assert cb.check_ha(arts) == []
+    # Different backend: re-baselined, no problem.
+    arts = [("SOAK_r11.json", prev),
+            ("SOAK_r12.json", dict(_soak(), backend="tpu",
+                                   ha=_ha(agg=700.0)))]
+    assert cb.check_ha(arts) == []

@@ -19,7 +19,7 @@ import pytest
 
 from kubernetes_tpu.apiserver.memstore import MemStore
 from kubernetes_tpu.apiserver.server import serve
-from kubernetes_tpu.chaos import ChaosProxy
+from kubernetes_tpu.chaos import BindMonitor, ChaosProxy
 from kubernetes_tpu.client.http import APIClient
 from kubernetes_tpu.scheduler.backoff import PodBackoff
 from kubernetes_tpu.scheduler.factory import ConfigFactory
@@ -54,6 +54,10 @@ class Rig:
         self.direct = APIClient(self.api_url, qps=0)
         for i in range(nodes):
             self.direct.create("nodes", _node_json(f"node-{i}"))
+        # Every scenario gets the double-bind referee for free
+        # (chaos/bindmonitor.py): any fault class that races binds —
+        # 409 storms, resets mid-bind, watch cuts — must end clean.
+        self.monitor = BindMonitor(self.store)
         self.factory = ConfigFactory(self.proxy.base_url,
                                      qps=5000, burst=5000)
         # Compressed requeue backoff: convergence-under-fault in test time.
@@ -90,6 +94,7 @@ class Rig:
         assert not dead, f"daemon threads died: {dead}"
 
     def stop(self) -> None:
+        self.monitor.stop()
         self.factory.stop()
         self.proxy.stop()
         self.api_srv.shutdown()
@@ -146,6 +151,8 @@ def test_409_conflict_storm_on_bindings(rig_factory):
     rig.wait_bound(names)
     rig.assert_daemon_alive()
     assert metrics.BIND_CONFLICTS.value > before
+    time.sleep(0.2)  # let the monitor drain its watch queue
+    rig.monitor.assert_clean()
 
 
 def test_connection_resets(rig_factory):
@@ -156,6 +163,8 @@ def test_connection_resets(rig_factory):
     names = rig.create_pods(8)
     rig.wait_bound(names)
     rig.assert_daemon_alive()
+    time.sleep(0.2)
+    rig.monitor.assert_clean()
 
 
 def test_watch_stream_cut_mid_event(rig_factory):
@@ -305,6 +314,8 @@ def test_409_every_nth_bind_requeues_only_victims(rig_factory):
         injected = [r for r in rig.proxy.rules() if r.status == 409]
         assert injected and injected[0].fired >= 1
         assert metrics.BIND_CONFLICTS.value >= before + injected[0].fired
+        time.sleep(0.2)
+        rig.monitor.assert_clean()
     finally:
         featuregate.set_default(old_gate)
 
@@ -508,7 +519,6 @@ def test_oom_solves_during_bind_conflict_storm_converge(rig_factory):
     bisect/retry ladder and the bind forget+requeue path compose, the
     batch converges fully, and the bind monitor sees zero double-binds."""
     from kubernetes_tpu.chaos import device as chaos_device
-    from kubernetes_tpu.perf.soak import _BindMonitor
     chaos_device._reset_for_tests()
     rig = rig_factory(rules=[dict(fault="error", method="POST",
                                   path=r"/bindings", status=409,
@@ -518,7 +528,7 @@ def test_oom_solves_during_bind_conflict_storm_converge(rig_factory):
     daemon.STREAM_THRESHOLD = 8
     daemon.stream_chunk = 8
     daemon.stream_min_bucket = 4
-    monitor = _BindMonitor(rig.store)
+    monitor = rig.monitor  # the rig's shared double-bind referee
     faults_before = {k[0]: v.value
                      for k, v in metrics.DEVICE_FAULTS.children().items()}
     conflicts_before = metrics.BIND_CONFLICTS.value
@@ -538,8 +548,7 @@ def test_oom_solves_during_bind_conflict_storm_converge(rig_factory):
         assert metrics.BIND_CONFLICTS.value > conflicts_before
         rig.assert_daemon_alive()
     finally:
-        chaos_device.install(None)
-        monitor.stop()
+        chaos_device.install(None)  # rig.stop() stops the monitor
 
 
 def test_serving_bursts_converge_during_bind_conflict_storm(rig_factory):

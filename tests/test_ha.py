@@ -1,0 +1,676 @@
+"""Active-active HA (ISSUE 11): sharded scheduler incarnations over one
+shared apiserver — shard map determinism, lease-based ownership with
+polite takeover, the daemon-side ownership gates, and the kill-tolerant
+handoff edge cases:
+
+* an incarnation dying while holding an ASSUME-BUT-NOT-BOUND pod: the
+  survivor must forget stale assumes and requeue, never double-bind;
+* a stale incarnation that lost its lease firing a LATE bind: the
+  apiserver's nodeName CAS must reject it, and the loser's
+  forget+requeue must NOT resurrect the pod onto the loser's queue.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.apiserver.server import serve
+from kubernetes_tpu.chaos import BindMonitor
+from kubernetes_tpu.client.http import APIClient
+from kubernetes_tpu.scheduler.backoff import PodBackoff
+from kubernetes_tpu.scheduler.factory import ConfigFactory
+from kubernetes_tpu.scheduler.queue import FIFO
+from kubernetes_tpu.scheduler.shards import (ShardManager, shard_of,
+                                             shard_lock_name)
+from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils.leaderelection import InMemoryLock
+
+
+def _pod(name: str, namespace: str = "default", cpu: str = "10m") -> api.Pod:
+    return api.Pod(name=name, namespace=namespace,
+                   containers=[api.Container(
+                       name="c", requests={"cpu": cpu,
+                                           "memory": "16Mi"})])
+
+
+def _node_json(name: str) -> dict:
+    return {"metadata": {"name": name,
+                         "labels": {api.HOSTNAME_LABEL: name}},
+            "status": {"allocatable": {"cpu": "32", "memory": "64Gi",
+                                       "pods": "110"},
+                       "conditions": [{"type": "Ready",
+                                       "status": "True"}]}}
+
+
+def _pod_json(name: str, namespace: str) -> dict:
+    return {"metadata": {"name": name, "namespace": namespace},
+            "spec": {"containers": [{
+                "name": "c",
+                "resources": {"requests": {"cpu": "10m"}}}]}}
+
+
+# -- shard map ---------------------------------------------------------------
+
+
+class TestShardMap:
+    def test_deterministic_across_calls(self):
+        for ns in ("default", "kube-system", "tenant-42", ""):
+            assert shard_of(ns, 8) == shard_of(ns, 8)
+
+    def test_cross_process_stable_values(self):
+        """Pinned crc32 values: a new interpreter (hash() is salted per
+        process) MUST map namespaces identically, or two incarnations
+        would disagree about ownership — both scheduling a namespace,
+        or neither."""
+        import zlib
+        for ns in ("default", "ha-ns-0", "kube-system"):
+            assert shard_of(ns, 8) == zlib.crc32(ns.encode()) % 8
+
+    def test_spread_over_shards(self):
+        hits = {shard_of(f"ns-{i}", 8) for i in range(64)}
+        assert len(hits) == 8, f"64 namespaces hit only shards {hits}"
+
+    def test_single_shard_owns_everything(self):
+        assert shard_of("anything", 1) == 0
+        assert shard_of("else", 0) == 0
+
+    def test_lock_names_are_per_shard(self):
+        assert shard_lock_name(3) == "kube-scheduler-shard-3"
+        assert shard_lock_name(0) != shard_lock_name(1)
+
+
+# -- the shard manager, clock-injected --------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _lock_factory(n_shards: int):
+    """Shared in-memory locks for N shards plus the presence object
+    (index -1)."""
+    locks = [InMemoryLock() for _ in range(n_shards)]
+    presence = InMemoryLock()
+    return lambda i: presence if i < 0 else locks[i]
+
+
+def _managers(n_shards: int, idents: list[str], clock: FakeClock,
+              lease: float = 2.0, factory=None, **kw) \
+        -> list[ShardManager]:
+    factory = factory or _lock_factory(n_shards)
+    out = []
+    for ident in idents:
+        out.append(ShardManager(
+            None, incarnation=ident, n_shards=n_shards,
+            lease_duration=lease, renew_deadline=lease * 2 / 3,
+            retry_period=lease / 8, jitter=0.0, now=clock,
+            lock_factory=factory, **kw))
+    return out
+
+
+def _settle(managers: list[ShardManager], clock: FakeClock,
+            rounds: int = 64, step: float = 0.3) -> None:
+    for _ in range(rounds):
+        for m in managers:
+            m.tick()
+        clock.advance(step)
+
+
+class TestShardManager:
+    def test_lone_manager_acquires_every_shard(self):
+        clock = FakeClock()
+        (m,) = _managers(4, ["solo"], clock)
+        _settle([m], clock, rounds=16)
+        assert m.owned() == frozenset({0, 1, 2, 3})
+        assert m.owns_namespace("default")
+        assert m.handoffs == 0, "virgin leases are not handoffs"
+
+    def test_two_managers_split_disjointly(self):
+        clock = FakeClock()
+        a, b = _managers(6, ["a", "b"], clock)
+        _settle([a, b], clock)
+        assert a.owned() | b.owned() == frozenset(range(6))
+        assert not (a.owned() & b.owned()), \
+            f"shared shards: {a.owned() & b.owned()}"
+        # Politeness spread the map: neither candidate starved.
+        assert a.owned() and b.owned()
+
+    def test_exactly_one_owner_per_namespace(self):
+        clock = FakeClock()
+        a, b, c = _managers(8, ["a", "b", "c"], clock)
+        _settle([a, b, c], clock)
+        for i in range(32):
+            ns = f"tenant-{i}"
+            owners = [m.incarnation for m in (a, b, c)
+                      if m.owns_namespace(ns)]
+            assert len(owners) == 1, f"{ns} owned by {owners}"
+
+    def test_survivor_steals_dead_peers_shards(self):
+        clock = FakeClock()
+        a, b = _managers(4, ["a", "b"], clock, lease=2.0)
+        _settle([a, b], clock)
+        dead_shards = a.owned()
+        assert dead_shards
+        a.abandon()  # leases NOT released; they must expire
+        # Within one lease duration + a few retries, b covers all.
+        _settle([b], clock, rounds=24, step=0.25)
+        assert b.owned() == frozenset(range(4))
+        assert b.handoffs >= len(dead_shards), \
+            "takeovers of a dead peer's leases must count as handoffs"
+
+    def test_graceful_release_hands_over_without_waiting_expiry(self):
+        clock = FakeClock()
+        a, b = _managers(2, ["a", "b"], clock, lease=1000.0)
+        _settle([a, b], clock, rounds=16, step=200.0)
+        assert a.owned() | b.owned() == frozenset({0, 1})
+        a.stop()  # graceful: zeroes the records
+        # Two probe periods (one GET per renew deadline ~667 s), a
+        # blink against the 1000 s lease the standby would otherwise
+        # wait out.
+        _settle([b], clock, rounds=16, step=200.0)
+        assert b.owned() == frozenset({0, 1})
+
+    def test_rebalance_feeds_a_late_joiner(self):
+        """A late joiner finds every lease held and renewed; presence-
+        driven rebalancing must hand it its fair share anyway."""
+        clock = FakeClock()
+        factory = _lock_factory(4)
+        (a,) = _managers(4, ["early"], clock, factory=factory)
+        _settle([a], clock, rounds=24)
+        assert a.owned() == frozenset(range(4))
+        (b,) = _managers(4, ["late"], clock, factory=factory)
+        _settle([a, b], clock, rounds=96, step=0.3)
+        assert len(b.owned()) >= 1, \
+            f"late joiner starved: a={sorted(a.owned())}"
+        assert a.owned() | b.owned() == frozenset(range(4))
+        assert not (a.owned() & b.owned())
+
+    def test_dead_peers_stale_presence_never_triggers_release(self):
+        """Liveness is observed-change: a SIGKILLed peer's presence
+        entry goes stale, so the survivor keeps (and takes over)
+        everything instead of releasing to a ghost."""
+        clock = FakeClock()
+        factory = _lock_factory(4)
+        a, b = _managers(4, ["a", "b"], clock, factory=factory)
+        _settle([a, b], clock, rounds=48)
+        b.abandon()
+        _settle([a], clock, rounds=96, step=0.3)
+        assert a.owned() == frozenset(range(4)), \
+            f"survivor released shards to a dead peer: {sorted(a.owned())}"
+
+    def test_long_dead_peers_pruned_from_presence_table(self):
+        """The shared presence object must not grow forever: identities
+        whose heartbeat counter stopped changing many lease durations
+        ago (a crash-looped boot's abandoned uuid) are garbage-
+        collected from the table and the local peer view — while a
+        peer inside the liveness window is never touched."""
+        clock = FakeClock()
+        factory = _lock_factory(4)
+        a, b = _managers(4, ["a", "b"], clock, factory=factory)
+        _settle([a, b], clock, rounds=48)
+        assert "b" in a._peers
+        b.abandon()
+        # Within the liveness window (2 leases) and well past it but
+        # under the prune horizon (10 leases): entry survives.
+        _settle([a], clock, rounds=32, step=0.3)
+        raw, _ = factory(-1).get()
+        assert "b" in json.loads(raw)
+        # Past 10 lease durations of observed silence: collected.
+        _settle([a], clock, rounds=64, step=0.3)
+        raw, _ = factory(-1).get()
+        assert "b" not in json.loads(raw), "dead identity never pruned"
+        assert "b" not in a._peers
+        assert "a" in json.loads(raw), "pruning must spare the living"
+
+    def test_acquired_and_lost_callbacks_fire(self):
+        clock = FakeClock()
+        events: list[tuple] = []
+        factory = _lock_factory(2)
+        m = ShardManager(
+            None, incarnation="cb", n_shards=2, lease_duration=2.0,
+            renew_deadline=1.2, retry_period=0.25, jitter=0.0,
+            now=clock, lock_factory=factory,
+            on_acquired=lambda s, h: events.append(("acq", s, h)),
+            on_lost=lambda s: events.append(("lost", s)))
+        _settle([m], clock, rounds=8)
+        # Drain the queued callbacks synchronously (no thread running).
+        while m._callbacks:
+            cb, args = m._callbacks.pop(0)
+            cb(*args)
+        assert ("acq", 0, False) in events and ("acq", 1, False) in events
+        # A rival steals shard 0 after expiry: the next failed renew
+        # must fire on_lost.
+        clock.advance(30.0)
+        rival = ShardManager(
+            None, incarnation="rival", n_shards=2, lease_duration=2.0,
+            renew_deadline=1.2, retry_period=0.25, jitter=0.0,
+            now=clock, lock_factory=factory)
+        rival.tick()  # first tick only OBSERVES the stale records
+        clock.advance(3.0)  # ... which then expire by rival's clock
+        rival.tick()  # steal
+        assert rival.owned(), "rival failed to steal an expired lease"
+        m.tick()
+        while m._callbacks:
+            cb, args = m._callbacks.pop(0)
+            cb(*args)
+        assert any(e[0] == "lost" for e in events), \
+            "losing a stolen lease never fired on_lost"
+
+    def test_report_shape(self):
+        clock = FakeClock()
+        (m,) = _managers(2, ["r"], clock)
+        _settle([m], clock, rounds=8)
+        rep = m.report()
+        assert rep["incarnation"] == "r"
+        assert rep["nShards"] == 2
+        assert rep["shardsOwned"] == [0, 1]
+
+
+# -- daemon-side gates -------------------------------------------------------
+
+
+class TestOwnershipGates:
+    def test_queue_delete_matching(self):
+        q = FIFO(high_watermark=0)
+        for i in range(6):
+            q.add(_pod(f"p{i}", namespace=f"ns-{i % 2}"))
+        removed = q.delete_matching(lambda p: p.namespace == "ns-0")
+        assert removed == 3
+        assert len(q) == 3
+        left = q.pop_all(wait_first=False)
+        assert {p.namespace for p in left} == {"ns-1"}
+
+    def test_queue_delete_matching_clears_gang_holds(self):
+        q = FIFO(high_watermark=0)
+        member = _pod("g1", namespace="held")
+        member.annotations = {api.GANG_ANNOTATION_KEY: "g",
+                              api.GANG_SIZE_ANNOTATION_KEY: "3"}
+        q.add(member)
+        assert len(q) == 1
+        assert q.delete_matching(lambda p: p.namespace == "held") == 1
+        assert len(q) == 0
+
+    def test_cache_forget_pods_matching_only_assumed(self):
+        from kubernetes_tpu.cache.scheduler_cache import SchedulerCache
+        cache = SchedulerCache()
+        cache.add_node(api.Node(
+            name="n1", allocatable_milli_cpu=32000,
+            allocatable_memory=64 << 30, allocatable_pods=110))
+        assumed = _pod("assumed", namespace="lost-ns")
+        cache.assume_pod(assumed, "n1")
+        bound = _pod("bound", namespace="lost-ns")
+        bound.node_name = "n1"
+        cache.add_pod(bound)
+        other = _pod("other", namespace="kept-ns")
+        cache.assume_pod(other, "n1")
+        gone = cache.forget_pods_matching(
+            lambda p: p.namespace == "lost-ns")
+        assert gone == ["lost-ns/assumed"]
+        assert not cache.contains("lost-ns/assumed")
+        # Confirmed pods are apiserver truth: never forgotten.
+        assert cache.contains("lost-ns/bound")
+        assert cache.contains("kept-ns/other")
+
+    def test_enqueue_gate_drops_unowned(self):
+        from kubernetes_tpu.engine.generic_scheduler import GenericScheduler
+        from kubernetes_tpu.scheduler.scheduler import (Scheduler,
+                                                        SchedulerConfig)
+        daemon = Scheduler(SchedulerConfig(algorithm=GenericScheduler()))
+        daemon.owns_pod = lambda p: p.namespace == "mine"
+        daemon.enqueue(_pod("yes", namespace="mine"))
+        daemon.enqueue(_pod("no", namespace="theirs"))
+        assert "mine/yes" in daemon.queue
+        assert "theirs/no" not in daemon.queue
+
+    def test_requeue_worker_drops_pods_of_lost_shards(self):
+        from kubernetes_tpu.engine.generic_scheduler import GenericScheduler
+        from kubernetes_tpu.scheduler.scheduler import (Scheduler,
+                                                        SchedulerConfig)
+        daemon = Scheduler(SchedulerConfig(algorithm=GenericScheduler()))
+        daemon.backoff = PodBackoff(default_duration=0.05,
+                                    max_duration=0.05)
+        owned = {"mine"}
+        daemon.owns_pod = lambda p: p.namespace in owned
+        keep = _pod("keep", namespace="mine")
+        drop = _pod("drop", namespace="mine")
+        daemon._handle_failure(keep, "FailedScheduling", "test")
+        daemon._handle_failure(drop, "FailedScheduling", "test")
+        # The shard moves between the failure and the backoff pop.
+        drop.namespace = "moved"
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and "mine/keep" not in \
+                daemon.queue:
+            time.sleep(0.01)
+        assert "mine/keep" in daemon.queue
+        time.sleep(0.2)
+        assert "moved/drop" not in daemon.queue
+        assert len(daemon.queue) == 1
+        daemon.stop()
+
+    def test_sweep_age_gates_assumes_takeover_forgets_them_all(self):
+        """The ownership sweep runs over shards we are ACTIVELY
+        draining: a YOUNG assumed-but-unbound pod there is a live
+        in-flight bind and must survive the sweep (forgetting it would
+        free its node's capacity for the next solve while the bind
+        lands anyway — transient overcommit plus a duplicate requeue),
+        while an OLD assume is a leak (bind result lost to chaos) the
+        sweep must repair.  A TAKEOVER reconcile of a freshly-won shard
+        forgets regardless of age: losing the shard dropped our
+        assumes, so anything still assumed is stale."""
+        from kubernetes_tpu.engine.generic_scheduler import GenericScheduler
+        from kubernetes_tpu.scheduler import recovery
+        from kubernetes_tpu.scheduler.scheduler import (Scheduler,
+                                                        SchedulerConfig)
+        daemon = Scheduler(SchedulerConfig(algorithm=GenericScheduler()))
+        cache = daemon.config.algorithm.cache
+        cache.add_node(api.Node(
+            name="n1", allocatable_milli_cpu=32000,
+            allocatable_memory=64 << 30, allocatable_pods=110))
+        store = MemStore()
+        store.create("pods", _pod_json("inflight", "ns-a"))
+        cache.assume_pod(_pod("inflight", namespace="ns-a"), "n1")
+        store.create("pods", _pod_json("leaked", "ns-a"))
+        cache.assume_pod(_pod("leaked", namespace="ns-a"), "n1")
+        # Age the leaked assume past the gate (deadline = assume + ttl).
+        cache._pod_states["ns-a/leaked"].deadline -= 10.0
+        store.create("pods", _pod_json("orphan", "ns-a"))
+        report = recovery.reconcile_shard(
+            daemon, store, -1, lambda ns: True, min_assume_age_s=3.0)
+        assert cache.is_assumed("ns-a/inflight")
+        assert "ns-a/inflight" not in daemon.queue
+        assert not cache.is_assumed("ns-a/leaked")
+        assert "ns-a/leaked" in daemon.queue
+        assert "ns-a/orphan" in daemon.queue
+        assert report["expired"] == 1 and report["requeued"] == 2
+        report = recovery.reconcile_shard(
+            daemon, store, 0, lambda ns: True)
+        assert not cache.is_assumed("ns-a/inflight")
+        assert "ns-a/inflight" in daemon.queue
+        assert report["expired"] == 1
+
+
+# -- end-to-end over HTTP ----------------------------------------------------
+
+
+class HARig:
+    """Two (or more) sharded incarnations over one HTTP apiserver."""
+
+    def __init__(self, n_incarnations: int = 2, n_shards: int = 4,
+                 nodes: int = 4, lease_s: float = 0.4):
+        self.saved = {k: os.environ.get(k)
+                      for k in ("KT_HA_LEASE_S", "KT_HA_RENEW_S",
+                                "KT_HA_RETRY_S")}
+        os.environ["KT_HA_LEASE_S"] = str(lease_s)
+        os.environ["KT_HA_RENEW_S"] = str(lease_s * 0.75)
+        os.environ["KT_HA_RETRY_S"] = str(lease_s / 8)
+        self.store = MemStore()
+        self.api_srv = serve(self.store)
+        self.url = f"http://127.0.0.1:{self.api_srv.server_address[1]}"
+        self.direct = APIClient(self.url, qps=0)
+        for i in range(nodes):
+            self.direct.create("nodes", _node_json(f"ha-n{i}"))
+        self.monitor = BindMonitor(self.store)
+        self.n_shards = n_shards
+        self.factories = []
+        for i in range(n_incarnations):
+            f = ConfigFactory(self.url, qps=0, ha_shards=n_shards,
+                              incarnation=f"inc-{i}")
+            f.daemon.backoff = PodBackoff(default_duration=0.05,
+                                          max_duration=0.5)
+            self.factories.append(f)
+
+    def run(self) -> "HARig":
+        for f in self.factories:
+            f.run()
+        # Full coverage AND balance: presence-driven rebalancing must
+        # hand every incarnation at least one shard (a sequentially-
+        # started rig's first factory initially grabs everything).
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if len(self.owned_union()) == self.n_shards and \
+                    all(f.shards.owned() for f in self.factories):
+                return self
+            time.sleep(0.02)
+        raise AssertionError(
+            f"shards never fully owned/balanced: "
+            f"{[sorted(f.shards.owned()) for f in self.factories]}")
+
+    def owned_union(self) -> set[int]:
+        out: set[int] = set()
+        for f in self.factories:
+            if f.shards is not None and not f._stop.is_set():
+                out |= set(f.shards.owned())
+        return out
+
+    def create_pods(self, n: int, namespaces: list[str],
+                    prefix: str = "pod") -> list[str]:
+        keys = []
+        for i in range(n):
+            ns = namespaces[i % len(namespaces)]
+            self.direct.create("pods", _pod_json(f"{prefix}-{i}", ns))
+            keys.append(f"{ns}/{prefix}-{i}")
+        return keys
+
+    def wait_bound(self, keys: list[str], timeout: float = 30.0) -> dict:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            bound = {}
+            for key in keys:
+                obj = self.store.get("pods", key)
+                bound[key] = (obj.get("spec") or {}).get("nodeName") \
+                    if obj else None
+            if all(bound.values()):
+                return bound
+            time.sleep(0.05)
+        missing = [k for k in keys
+                   if not ((self.store.get("pods", k) or {})
+                           .get("spec") or {}).get("nodeName")]
+        raise AssertionError(f"pods never bound: {missing}")
+
+    def stop(self) -> None:
+        self.monitor.stop()
+        for f in self.factories:
+            try:
+                f.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        self.api_srv.shutdown()
+        for k, v in self.saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.fixture()
+def ha_rig_factory():
+    rigs: list[HARig] = []
+
+    def make(**kw) -> HARig:
+        rig = HARig(**kw)
+        rigs.append(rig)
+        return rig.run()
+
+    yield make
+    for rig in rigs:
+        rig.stop()
+
+
+NAMESPACES = [f"e2e-ns-{i}" for i in range(8)]
+
+
+class TestActiveActiveE2E:
+    def test_disjoint_ownership_and_full_convergence(self, ha_rig_factory):
+        rig = ha_rig_factory()
+        a, b = rig.factories
+        assert not (set(a.shards.owned()) & set(b.shards.owned()))
+        keys = rig.create_pods(24, NAMESPACES)
+        rig.wait_bound(keys)
+        time.sleep(0.2)
+        rig.monitor.assert_clean()
+
+    def test_both_incarnations_actually_scheduled(self, ha_rig_factory):
+        """Scale-out means both daemons do work: every pod's shard owner
+        — and nobody else — popped it."""
+        rig = ha_rig_factory()
+        keys = rig.create_pods(32, NAMESPACES)
+        rig.wait_bound(keys)
+        per_inc = {
+            f.shards.incarnation:
+                int(f.daemon.config.metrics.scheduling_attempts.labels(
+                    result="scheduled").value)
+            for f in rig.factories}
+        assert all(v > 0 for v in per_inc.values()), \
+            f"an incarnation sat idle: {per_inc}"
+        assert sum(per_inc.values()) == len(keys), \
+            f"duplicate or lost scheduling work: {per_inc}"
+
+    def test_kill_one_survivor_takes_over_under_a_second(
+            self, ha_rig_factory):
+        rig = ha_rig_factory(n_incarnations=3, n_shards=6)
+        victim = rig.factories[0]
+        victim_shards = set(victim.shards.owned())
+        assert victim_shards
+        keys = rig.create_pods(30, NAMESPACES, prefix="storm")
+        t_kill = time.monotonic()
+        victim.abandon()
+        survivors = rig.factories[1:]
+        while time.monotonic() - t_kill < 10:
+            covered: set[int] = set()
+            for f in survivors:
+                covered |= set(f.shards.owned())
+            if len(covered) == rig.n_shards:
+                break
+            time.sleep(0.005)
+        takeover_s = time.monotonic() - t_kill
+        assert takeover_s < 1.0, \
+            f"takeover took {takeover_s:.2f}s (bar: < 1 s)"
+        for f in survivors:
+            f.shards.drain_callbacks(timeout=10)
+        rig.wait_bound(keys)
+        time.sleep(0.3)
+        rig.monitor.assert_clean()
+
+    def test_dead_incarnations_assume_not_bound_pod_requeues_once(
+            self, ha_rig_factory):
+        """ISSUE 11 satellite: the victim dies AFTER assuming a pod but
+        BEFORE its bind lands.  The pod is unbound at the apiserver; the
+        survivor's takeover reconcile must requeue and bind it exactly
+        once — and the survivor's OWN stale assume of some earlier spell
+        (simulated directly) must be forgotten, not double-counted."""
+        rig = ha_rig_factory()
+        a, b = rig.factories
+        # A namespace owned by the victim (a).
+        ns = next(n for n in NAMESPACES if a.shards.owns_namespace(n))
+        # Freeze a's pipeline the way a kill does: stop the drain loop
+        # outright, then create the pod and hand-assume it in a's cache
+        # — solved, assumed, bind never dispatched.
+        a.daemon._stop.set()
+        time.sleep(0.1)
+        self_key = rig.create_pods(1, [ns], prefix="orphan")[0]
+        pod = api.pod_from_json(rig.store.get("pods", self_key))
+        node = a.algorithm.cache.nodes()[0].name
+        a.algorithm.cache.assume_pod(pod, node)
+        # The survivor also carries a STALE assume for the same pod
+        # from a hypothetical earlier ownership spell.
+        b.algorithm.cache.assume_pod(
+            api.pod_from_json(rig.store.get("pods", self_key)), node)
+        a.abandon()
+        b.shards.drain_callbacks(timeout=10)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if len(b.shards.owned()) == rig.n_shards:
+                break
+            time.sleep(0.02)
+        b.shards.drain_callbacks(timeout=10)
+        rig.wait_bound([self_key])
+        time.sleep(0.3)
+        rig.monitor.assert_clean()
+        assert rig.monitor.binds >= 1
+        # The survivor's takeover pass recorded the forget+requeue.
+        takeovers = [r for r in b.shard_recoveries if r.get("handoff")]
+        assert any(r["expired"] >= 1 for r in takeovers), \
+            f"stale assume was never forgotten: {takeovers}"
+
+    def test_stale_incarnations_late_bind_rejected_by_cas(
+            self, ha_rig_factory):
+        """ISSUE 11 satellite: an incarnation that lost its lease fires
+        the bind it solved before losing it — AFTER the new owner bound
+        the pod.  The apiserver CAS must reject the late bind, the
+        pod's placement must remain the new owner's, and the loser's
+        conflict must count as a cross-shard 409 and NOT requeue onto
+        the loser's queue."""
+        rig = ha_rig_factory()
+        a, b = rig.factories
+        ns = next(n for n in NAMESPACES if a.shards.owns_namespace(n))
+        # Park both drain loops: this test drives binds by hand.
+        a.daemon._stop.set()
+        b.daemon._stop.set()
+        time.sleep(0.1)
+        key = rig.create_pods(1, [ns], prefix="late")[0]
+        pod_a = api.pod_from_json(rig.store.get("pods", key))
+        nodes = [n.name for n in a.algorithm.cache.nodes()]
+        a.algorithm.cache.assume_pod(pod_a, nodes[0])
+        # The lease moves: a loses the namespace's shard.  The manager
+        # loop is parked first so it cannot re-acquire mid-assertion,
+        # and its shed callback is NOT drained — the assume must stay,
+        # because the path under test is the LATE BIND's own
+        # forget+requeue, not the wholesale shard shed.
+        shard = shard_of(ns, rig.n_shards)
+        assert a.shards.owns_shard(shard)
+        a.shards._stop.set()
+        time.sleep(0.15)  # tick + callback threads drain out
+        a.shards._transition(shard, owned=False)
+        assert not a.shards.owns_namespace(ns)
+        rig.store.bind(ns, pod_a.name, nodes[1])  # the new owner's bind
+        conflicts_before = metrics.CROSS_SHARD_CONFLICTS.value
+        # The stale incarnation's late bind rides the daemon's real
+        # bind path: CAS rejects, forget+requeue fires, the requeue
+        # gate drops the unowned pod.
+        a.daemon._stop.clear()
+        a.daemon._bind_assumed(pod_a, nodes[0], time.perf_counter(),
+                               assumed=True)
+        a.daemon.wait_for_binds()
+        bound = (rig.store.get("pods", key).get("spec") or {})
+        assert bound.get("nodeName") == nodes[1], \
+            "the stale incarnation's late bind clobbered the new owner's"
+        assert metrics.CROSS_SHARD_CONFLICTS.value > conflicts_before
+        time.sleep(0.3)
+        assert key not in a.daemon.queue, \
+            "the loser requeued a pod whose shard it no longer owns"
+        rig.monitor.assert_clean()
+        assert rig.monitor.binds == 1
+
+
+class TestHAWaveSmoke:
+    def test_mini_ha_wave(self):
+        """A toy-scale run of the soak's HA wave end to end: the
+        committed artifact's generator, exercised in tier-1 so the wave
+        itself cannot rot between artifact refreshes."""
+        from kubernetes_tpu.perf.soak import run_ha_wave
+        rec = run_ha_wave(n_nodes=8, n_shards=4, n_incarnations=2,
+                          n_namespaces=6, seed_pods=30, storm_waves=2,
+                          wave_pods=20, kill_wave_pods=30,
+                          lease_s=0.4, stream_chunk=64,
+                          settle_timeout=60.0, processes=False,
+                          quiet=True)
+        assert rec["double_binds"] == 0
+        assert rec["stranded_pending"] == 0
+        assert rec["pods_bound"] == rec["pods_created"]
+        assert rec["takeover"]["takeover_settle_s"] < 5.0
+        assert rec["aggregate_steady_pods_per_s"] > 0
+        assert rec["single_scheduler_pods_per_s"] > 0
+        assert rec["lease_handoffs"] >= 1

@@ -1,0 +1,169 @@
+"""Multi-lease leader-election hardening (ISSUE 11 satellite): the
+shard manager runs one elector per shard, so the election primitive must
+hold up under clock injection, CAS races, and thundering-herd renewal.
+
+Three contracts pinned here:
+
+* EXPIRY IS CLOCK-DRIVEN: with an injected ``now``, a standby cannot
+  steal before the observed lease expires and must steal after — no
+  wall-clock sleeps, the arithmetic itself is under test.
+* CAS EXCLUSIVITY: two acquirers racing one ``APIResourceLock`` (the
+  annotation-CAS on a raw MemStore AND over HTTP) never both believe
+  they hold the lease — the 409 loser must observe itself losing.
+* RENEW JITTER: the jittered retry sleep stays within its declared
+  band, so N electors desynchronize instead of phase-locking.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.utils.leaderelection import (APIResourceLock,
+                                                 InMemoryLock,
+                                                 LeaderElector)
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _elector(lock, ident, clock, lease=10.0):
+    return LeaderElector(lock=lock, identity=ident, lease_duration=lease,
+                         renew_deadline=lease * 2 / 3,
+                         retry_period=lease / 10, now=clock)
+
+
+class TestClockInjectedExpiry:
+    def test_standby_cannot_steal_live_lease(self):
+        clock = FakeClock()
+        lock = InMemoryLock()
+        a = _elector(lock, "a", clock)
+        b = _elector(lock, "b", clock)
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+        assert a.is_leader() and not b.is_leader()
+        # The whole lease minus epsilon: still held.
+        clock.advance(9.99)
+        assert not b.try_acquire_or_renew()
+        assert not b.lease_dead()
+
+    def test_standby_steals_exactly_at_expiry(self):
+        clock = FakeClock()
+        lock = InMemoryLock()
+        a = _elector(lock, "a", clock)
+        b = _elector(lock, "b", clock)
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()  # observe the record
+        clock.advance(10.0)  # lease_duration, to the tick
+        assert b.lease_dead()
+        assert b.try_acquire_or_renew(), \
+            "standby could not steal an expired lease"
+        assert b.is_leader()
+        # The old holder's next renew attempt must observe the theft
+        # and drop leadership rather than split-brain.
+        assert not a.try_acquire_or_renew()
+        assert not a.is_leader()
+
+    def test_renewal_extends_the_lease(self):
+        clock = FakeClock()
+        lock = InMemoryLock()
+        a = _elector(lock, "a", clock)
+        b = _elector(lock, "b", clock)
+        assert a.try_acquire_or_renew()
+        assert not b.try_acquire_or_renew()
+        clock.advance(6.0)
+        assert a.try_acquire_or_renew()  # renew at t+6
+        assert not b.try_acquire_or_renew()
+        clock.advance(6.0)  # t+12: original lease long gone, renewal not
+        assert not b.try_acquire_or_renew()
+        assert a.is_leader() and not b.is_leader()
+
+    def test_transitions_count_only_on_holder_change(self):
+        clock = FakeClock()
+        lock = InMemoryLock()
+        a = _elector(lock, "a", clock)
+        b = _elector(lock, "b", clock)
+        assert a.try_acquire_or_renew()
+        assert a.try_acquire_or_renew()  # self-renew: no transition
+        assert not b.try_acquire_or_renew()
+        clock.advance(10.0)
+        assert b.try_acquire_or_renew()
+        assert b._observed.leader_transitions == 1
+
+
+class TestAPIResourceLockCAS:
+    def test_memstore_lock_update_is_a_real_cas(self):
+        """Two writers holding the SAME observed version: exactly one
+        update lands (the raw-MemStore path must pass the expected_rv
+        precondition explicitly — without it both writes 'win')."""
+        store = MemStore()
+        lock = APIResourceLock(store)
+        _, version = lock.get()
+        assert lock.update("first", version)
+        assert not lock.update("second", version), \
+            "stale-version update landed — the lock is not a CAS"
+        value, _ = lock.get()
+        assert value == "first"
+
+    def test_racing_acquirers_never_both_lead(self):
+        """N threads x M rounds hammering try_acquire_or_renew on one
+        short-lease lock: after every round, at most one elector may
+        believe it leads; a 409 loser must never think it won."""
+        store = MemStore()
+        clock = FakeClock()
+        electors = [
+            LeaderElector(lock=APIResourceLock(store), identity=f"c{i}",
+                          lease_duration=5.0, renew_deadline=3.0,
+                          retry_period=0.5, now=clock)
+            for i in range(4)]
+        rounds = 30
+        barrier = threading.Barrier(len(electors))
+        leaders_per_round: list[list[str]] = [[] for _ in range(rounds)]
+
+        def race(el: LeaderElector) -> None:
+            for r in range(rounds):
+                barrier.wait()
+                el.try_acquire_or_renew()
+                # Record AFTER the CAS round: the loser's observation
+                # has been refreshed by its own failed attempt.
+                if el.is_leader():
+                    leaders_per_round[r].append(el.identity)
+                barrier.wait()
+                if r % 7 == 6 and el.identity == "c0":
+                    clock.advance(6.0)  # force expiry churn
+
+        threads = [threading.Thread(target=race, args=(el,))
+                   for el in electors]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+        for r, leaders in enumerate(leaders_per_round):
+            assert len(leaders) <= 1, \
+                f"round {r}: {leaders} all believed they held the lease"
+        # The lock did change hands at least once across the expiries.
+        all_leaders = {nm for rnd in leaders_per_round for nm in rnd}
+        assert all_leaders, "nobody ever acquired the lease"
+
+
+class TestRenewJitter:
+    def test_jittered_sleep_stays_in_band(self):
+        el = LeaderElector(lock=InMemoryLock(), identity="j",
+                           retry_period=1.0, jitter=0.25)
+        draws = {el._sleep() for _ in range(200)}
+        assert all(1.0 <= d <= 1.25 for d in draws)
+        assert len(draws) > 10, "jitter produced a constant — not jitter"
+
+    def test_zero_jitter_is_exact(self):
+        el = LeaderElector(lock=InMemoryLock(), identity="j",
+                           retry_period=0.7)
+        assert el._sleep() == 0.7

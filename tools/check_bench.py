@@ -116,6 +116,21 @@ def _committed_family_artifacts(prefix: str, validator) -> \
     return [(name, data) for _, name, data in found]
 
 
+def last_same_backend(artifacts: list[tuple[str, dict]],
+                      new: dict) -> tuple[str, dict] | None:
+    """The most recent predecessor measured on the same backend as
+    ``new`` (None when no prior artifact matches).  Wall-clock rows
+    re-baseline when the accelerator under an artifact changes, but
+    they must scan BACK to the last same-backend artifact rather than
+    only eyeing the immediate predecessor: a mixed history (cpu ->
+    tpu -> cpu) would otherwise re-baseline at every step and never
+    wall-clock-compare anything again, silently retiring the ratchet."""
+    for name, parsed in reversed(artifacts[:-1]):
+        if parsed.get("backend") == new.get("backend"):
+            return name, parsed
+    return None
+
+
 def committed_workloads_artifacts() -> list[tuple[str, dict]]:
     """Committed WORKLOADS_r{N}.json artifacts (the workloads
     subsystem's quality/parity/gang rows, emitted by bench.py)."""
@@ -224,16 +239,122 @@ def check_soak(artifacts: list[tuple[str, dict]] | None = None,
             f"{new_name}: the device-lost wave never re-promoted the "
             f"engine back to device mode")
     if len(artifacts) >= 2:
-        (prev_name, prev) = artifacts[-2]
-        prev_settle, new_settle = prev.get("settle_s"), \
-            new.get("settle_s")
-        if prev_settle and new_settle and \
-                float(new_settle) > float(prev_settle) * \
-                (1.0 + tolerance):
+        # Same backend-gate as the BENCH p50 row: wall-clock rows
+        # re-baseline when the accelerator under the artifact changed —
+        # against the LAST same-backend artifact, not just the
+        # immediate predecessor.
+        base = last_same_backend(artifacts, new)
+        if base is not None:
+            prev_name, prev = base
+            prev_settle, new_settle = prev.get("settle_s"), \
+                new.get("settle_s")
+            if prev_settle and new_settle and \
+                    float(new_settle) > float(prev_settle) * \
+                    (1.0 + tolerance):
+                problems.append(
+                    f"soak settle regressed: {new_name} {new_settle}s "
+                    f"vs {prev_name} {prev_settle}s (tolerance "
+                    f"{tolerance * 100:.0f}%)")
+    return problems
+
+
+def check_ha(artifacts: list[tuple[str, dict]] | None = None,
+             tolerance: float = 0.10) -> list[str]:
+    """The active-active HA ratchet over the newest SOAK artifact's
+    ``ha`` section (perf/soak.run_ha_wave): ANY double-bind fails
+    outright (the bind CAS + lease partition must make them
+    impossible), shard takeover after the mid-drain kill must settle
+    in under a second, nothing may strand, and the 3-incarnation
+    aggregate steady-state rate must not fall below the committed
+    predecessor's single-scheduler number — scale-out that slows the
+    fleet down is a regression, not a feature.  The rate comparisons
+    carry ``tolerance`` (invariant rows never do): both sides are
+    single measurements under a chaos storm, and a hair's-width miss
+    on a noisy rig is measurement noise, not a regression — the same
+    reasoning as check()'s p50 and check_soak's settle margins.
+    Artifacts predating the section ratchet nothing."""
+    if artifacts is None:
+        artifacts = committed_soak_artifacts()
+    problems: list[str] = []
+    if not artifacts:
+        return problems
+    new_name, new = artifacts[-1]
+    ha = new.get("ha") or {}
+    if not ha:
+        return problems
+    if ha.get("double_binds"):
+        problems.append(
+            f"{new_name}: {ha['double_binds']} double-bind(s) in the HA "
+            f"wave — two incarnations bound one pod; the bind CAS or "
+            f"the shard partition broke")
+    if ha.get("stranded_pending"):
+        problems.append(
+            f"{new_name}: {ha['stranded_pending']} pod(s) stranded "
+            f"pending after the HA wave — a shard handoff lost them")
+    if ha.get("invariant_violations"):
+        problems.append(
+            f"{new_name}: {ha['invariant_violations']} invariant "
+            f"violation(s) during the HA wave")
+    takeover = (ha.get("takeover") or {}).get("takeover_settle_s")
+    if takeover is None:
+        problems.append(
+            f"{new_name}: the HA wave recorded no takeover_settle_s — "
+            f"the mid-drain kill never ran")
+    elif float(takeover) > 1.0:
+        problems.append(
+            f"{new_name}: shard takeover settled in {takeover}s after "
+            f"the kill (bar: < 1 s)")
+    agg = ha.get("aggregate_steady_pods_per_s")
+    if not agg:
+        problems.append(
+            f"{new_name}: the HA wave recorded no aggregate "
+            f"steady-state rate")
+    else:
+        # The scale-out bar, controlled: the wave's OWN phase-0
+        # single-scheduler baseline — the same storm on the same rig
+        # under the same chaos with one incarnation holding every
+        # shard, so the only variable is the scheduler count.  Three
+        # schedulers slower than one is a regression, not HA — but the
+        # inequality is only PHYSICALLY reachable when the rig can run
+        # the incarnations concurrently (cpus > n_incarnations); on a
+        # serialized rig N CPU-bound schedulers timeshare one core and
+        # pay N× the watch fan-out for 1× the compute, so there the
+        # aggregate is pinned against the committed predecessor (below)
+        # instead of against an unreachable bar.
+        own = ha.get("single_scheduler_pods_per_s")
+        cpus = ha.get("cpus") or 0
+        n_inc = ha.get("n_incarnations") or 0
+        if not own:
             problems.append(
-                f"soak settle regressed: {new_name} {new_settle}s vs "
-                f"{prev_name} {prev_settle}s (tolerance "
-                f"{tolerance * 100:.0f}%)")
+                f"{new_name}: the HA wave recorded no single-scheduler "
+                f"baseline rate — the phase-0 control never ran")
+        elif int(cpus) > int(n_inc) and \
+                float(agg) < float(own) * (1.0 - tolerance):
+            problems.append(
+                f"{new_name}: HA aggregate {agg} pods/s fell more than "
+                f"{tolerance:.0%} below the same wave's "
+                f"single-scheduler baseline {own} pods/s on a "
+                f"{cpus}-cpu rig — scale-out made the fleet slower")
+        if len(artifacts) >= 2:
+            # Artifact-over-artifact is a wall-clock row: only ratchet
+            # within one backend (check()'s re-baselining rule, with
+            # the same scan-back past foreign-backend artifacts), and
+            # only against predecessors that ran an HA wave at all.
+            comparable = [(n, a) for n, a in artifacts[:-1]
+                          if (a.get("ha") or {})
+                          .get("aggregate_steady_pods_per_s")
+                          and a.get("backend") == new.get("backend")]
+            prev_name, prev = comparable[-1] if comparable \
+                else (None, {})
+            prev_ha = (prev.get("ha") or {}) \
+                .get("aggregate_steady_pods_per_s")
+            if prev_ha and \
+                    float(agg) < float(prev_ha) * (1.0 - tolerance):
+                problems.append(
+                    f"{new_name}: HA aggregate {agg} pods/s fell more "
+                    f"than {tolerance:.0%} below the committed "
+                    f"predecessor's HA aggregate {prev_ha} pods/s "
+                    f"({prev_name})")
     return problems
 
 
@@ -364,13 +485,32 @@ def check(artifacts: list[tuple[str, dict]] | None = None,
     if len(artifacts) < 2:
         return problems
     (prev_name, prev), (new_name, new) = artifacts[-2], artifacts[-1]
-    prev_p50, new_p50 = density_p50_s(prev), density_p50_s(new)
-    if prev_p50 and new_p50 and new_p50 > prev_p50 * (1.0 + tolerance):
-        problems.append(
-            f"density p50 regressed: {new_name} {new_p50:.3f}s vs "
-            f"{prev_name} {prev_p50:.3f}s "
-            f"(+{(new_p50 / prev_p50 - 1) * 100:.0f}%, tolerance "
-            f"{tolerance * 100:.0f}%)")
+    new_p50 = density_p50_s(new)
+    # Wall-clock rows only compare within one accelerator backend: an
+    # artifact measured on a different device (parsed["backend"]:
+    # "cpu"/"tpu"/...; absent = the original tunneled-TPU rig) is a new
+    # baseline, not a regression — 23 s of CPU scan against 1.3 s of
+    # TPU scan says nothing about the code between them.  The ratchet
+    # scans back to the LAST same-backend artifact (a mixed history
+    # must not retire the comparison).  The invariant checks (stages,
+    # device plane, quality ratios) still apply against the immediate
+    # predecessor.
+    if prev.get("backend") != new.get("backend"):
+        print(f"bench ratchet: backend changed "
+              f"({prev_name}={prev.get('backend') or 'tpu'} -> "
+              f"{new_name}={new.get('backend') or 'tpu'}); wall-clock "
+              f"rows re-baseline")
+    base = last_same_backend(artifacts, new)
+    if base is not None:
+        base_name, base_art = base
+        base_p50 = density_p50_s(base_art)
+        if base_p50 and new_p50 and \
+                new_p50 > base_p50 * (1.0 + tolerance):
+            problems.append(
+                f"density p50 regressed: {new_name} {new_p50:.3f}s vs "
+                f"{base_name} {base_p50:.3f}s "
+                f"(+{(new_p50 / base_p50 - 1) * 100:.0f}%, tolerance "
+                f"{tolerance * 100:.0f}%)")
     prev_stages = set((prev.get("stages") or {}))
     new_stages = set((new.get("stages") or {}))
     if prev_stages and new_stages:
@@ -399,6 +539,7 @@ def check(artifacts: list[tuple[str, dict]] | None = None,
 def main() -> int:
     problems = check_workloads()
     problems += check_soak()
+    problems += check_ha()
     problems += check_serving()
     artifacts = committed_artifacts()
     if len(artifacts) < 2:
@@ -424,6 +565,12 @@ def main() -> int:
         print(f"soak ratchet OK: {sk[-1][0]} settle "
               f"{sk[-1][1].get('settle_s')}s, "
               f"{sk[-1][1].get('invariant_violations')} violations")
+        ha = sk[-1][1].get("ha") or {}
+        if ha:
+            print(f"HA ratchet OK: {sk[-1][0]} takeover "
+                  f"{(ha.get('takeover') or {}).get('takeover_settle_s')}"
+                  f"s, {ha.get('double_binds')} double-binds, aggregate "
+                  f"{ha.get('aggregate_steady_pods_per_s')} pods/s")
     sv = committed_serving_artifacts()
     if sv:
         trickle = (sv[-1][1].get("workloads") or {}) \

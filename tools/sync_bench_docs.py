@@ -90,12 +90,21 @@ def _cold_warm(parsed: dict) -> tuple[float | None, float | None]:
     return cw.get("cold_compile_s"), cw.get("warm_start_compile_s")
 
 
+def _hw(parsed: dict) -> str:
+    """Human caption for the artifact's measured backend (absent =
+    the original tunneled-TPU rig)."""
+    backend = parsed.get("backend") or "tpu"
+    if backend == "tpu":
+        return "one TPU v5e chip"
+    return f"the JAX {backend} backend (no accelerator attached)"
+
+
 def render_readme(tag: str, parsed: dict) -> str:
     pods, nodes = _shape(parsed)
     pps = parsed["value"]
     secs = pods / pps
     lines = [
-        f"Measured on one TPU v5e chip ({tag.removesuffix('.json')}): "
+        f"Measured on {_hw(parsed)} ({tag.removesuffix('.json')}): "
         f"**{pods:,} pods onto {nodes:,} nodes in {secs:.2f} s end-to-end "
         f"({pps:,.0f} pods/s)** through the full daemon path — "
         f"~{parsed['vs_baseline']:,.0f}× the reference's 8 pods/s "
