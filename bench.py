@@ -295,6 +295,33 @@ def main(argv=None) -> None:
         except Exception as err:  # noqa: BLE001 — phase is additive
             print(f"serving phase failed: {err}", file=sys.stderr)
 
+    # Multi-tenant solver service (ISSUE 12): K tenants of mixed
+    # trickle/burst/adversarial profiles over the full HTTP rig —
+    # per-tenant p99, cross-tenant interference, weighted-fairness
+    # shares, and poison-batch isolation, written as its own committed
+    # artifact (TENANCY_r{N}.json) that tools/check_bench.py ratchets
+    # (cross-tenant fault leaks, SLO-floor breaches, or
+    # interference/fairness outside the recorded bars fail tier-1).
+    # BENCH_TENANCY=0 skips (~3 min).
+    tenancy = None
+    if os.environ.get("BENCH_TENANCY", "1") != "0":
+        from kubernetes_tpu.perf import tenancy as tenancy_mod
+        try:
+            tenancy = tenancy_mod.collect(quiet=True)
+            tenancy_path = os.environ.get("BENCH_TENANCY_OUT",
+                                          "TENANCY_r12.json")
+            with open(tenancy_path, "w") as f:
+                json.dump(tenancy, f, indent=1)
+                f.write("\n")
+            print(f"tenancy: interference "
+                  f"{tenancy['interference']['ratio']}x, fairness err "
+                  f"{tenancy['fairness']['max_rel_error']}, "
+                  f"cross-tenant faults "
+                  f"{tenancy['isolation']['cross_tenant_faults']} "
+                  f"-> {tenancy_path}", file=sys.stderr)
+        except Exception as err:  # noqa: BLE001 — phase is additive
+            print(f"tenancy phase failed: {err}", file=sys.stderr)
+
     # Kubemark-scale control plane (VERDICT r3 #9): 500 hollow kubelets +
     # 2,000 replicas through the real scheduler, controller sync cost and
     # heartbeat write load measured.  BENCH_FLEET=0 skips (~90 s).

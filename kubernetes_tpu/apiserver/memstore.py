@@ -179,9 +179,21 @@ class MemStore:
         self._dir = storage_dir
         self._wal = None
         self._wal_count = 0
+        # Server-side capacity validation at bind (KT_BIND_CAPACITY,
+        # default on): per-node used-capacity accounting, maintained
+        # incrementally on bind/create/update/delete so the check is
+        # O(containers) per bind, never a walk over the pod set.  A
+        # bind that would overcommit the target node's allocatable is
+        # rejected with the 409 the scheduler already absorbs via
+        # forget + requeue — watch-lagged schedulers can no longer land
+        # transient overcommit in the store.
+        self._capacity_check = os.environ.get(
+            "KT_BIND_CAPACITY", "1") not in ("", "0")
+        self._node_used: dict[str, list] = {}  # node -> [milli, mem, pods]
         if storage_dir is not None:
             os.makedirs(storage_dir, exist_ok=True)
             self._recover(storage_dir)
+            self._recompute_node_used()
             self._wal = open(os.path.join(storage_dir, "wal.jsonl"),
                              "a", encoding="utf-8")
 
@@ -256,6 +268,94 @@ class MemStore:
                 self._wal.close()
                 self._wal = None
 
+    # -- server-side bind capacity accounting -----------------------------
+
+    @staticmethod
+    def _pod_requests(obj: dict) -> tuple[int, int, int]:
+        """(milli_cpu, memory_bytes, 1) summed over a pod JSON's
+        container requests; malformed quantities count as zero (the
+        check must never 500 a bind over a typo'd request)."""
+        from kubernetes_tpu.api.quantity import milli_value, value
+        milli = mem = 0
+        for c in (obj.get("spec") or {}).get("containers") or []:
+            req = ((c.get("resources") or {}).get("requests") or {})
+            try:
+                if "cpu" in req:
+                    milli += milli_value(str(req["cpu"]))
+                if "memory" in req:
+                    mem += value(str(req["memory"]))
+            except (ValueError, ZeroDivisionError):
+                continue
+        return milli, mem, 1
+
+    def _node_alloc(self, node_name: str):
+        """(milli_cpu, memory, pods) allocatable of a stored node, None
+        per missing field (nothing to validate there), or None when the
+        node object itself is unknown to the store."""
+        from kubernetes_tpu.api.quantity import milli_value, value
+        node = self._objects.get("nodes", {}).get(node_name)
+        if node is None:
+            return None
+        alloc = (node.get("status") or {}).get("allocatable") or {}
+        out = []
+        for field_name, parse in (("cpu", milli_value), ("memory", value),
+                                  ("pods", value)):
+            raw = alloc.get(field_name)
+            if raw is None:
+                out.append(None)
+                continue
+            try:
+                out.append(parse(str(raw)))
+            except (ValueError, ZeroDivisionError):
+                out.append(None)
+        return out
+
+    def _account_pod(self, obj: dict, sign: int) -> None:
+        """Add (+1) or remove (-1) a bound pod's requests from its
+        node's used-capacity row.  Caller holds the lock.  A no-op when
+        the capacity check is off — KT_BIND_CAPACITY=0 must restore the
+        old write path byte-for-byte, not keep paying the quantity
+        parsing on every pod write."""
+        if not self._capacity_check:
+            return
+        node_name = (obj.get("spec") or {}).get("nodeName") or ""
+        if not node_name:
+            return
+        req = self._pod_requests(obj)
+        used = self._node_used.setdefault(node_name, [0, 0, 0])
+        for i in range(3):
+            used[i] = max(used[i] + sign * req[i], 0)
+
+    def _recompute_node_used(self) -> None:
+        self._node_used = {}
+        for obj in self._objects.get("pods", {}).values():
+            self._account_pod(obj, +1)
+
+    def _check_bind_capacity(self, key: str, pod: dict,
+                             node_name: str) -> None:
+        """Reject a bind that would overcommit the target node (the
+        PR 11 REMAINING item: near-capacity fleets could transiently
+        overcommit a node during watch lag — pod double-binds were
+        already impossible; node overcommit now is too).  Unknown nodes
+        and absent allocatable fields validate nothing (the server
+        cannot invent capacity it was never told about)."""
+        alloc = self._node_alloc(node_name)
+        if alloc is None:
+            return
+        req = self._pod_requests(pod)
+        used = self._node_used.get(node_name, [0, 0, 0])
+        dims = ("cpu", "memory", "pods")
+        for i, dim in enumerate(dims):
+            if alloc[i] is None:
+                continue
+            if used[i] + req[i] > alloc[i]:
+                from kubernetes_tpu.utils import metrics
+                metrics.BIND_CAPACITY_REJECTS.inc()
+                raise ConflictError(
+                    f"binding pod {key} to node {node_name} would "
+                    f"overcommit {dim} (used {used[i]} + requested "
+                    f"{req[i]} > allocatable {alloc[i]})")
+
     # -- helpers ---------------------------------------------------------
 
     @staticmethod
@@ -304,6 +404,8 @@ class MemStore:
             # earliest-possible-start when lastScheduleTime is unset.
             meta.setdefault("creationTimestamp", _now_rfc3339())
             bucket[key] = obj
+            if kind == "pods":
+                self._account_pod(obj, +1)
             ev = self._emit("ADDED", kind, key, obj)
             # The event snapshot is already shared read-only with every
             # watcher; handing it to an owned caller (which serializes it
@@ -336,6 +438,12 @@ class MemStore:
             else:
                 meta["generation"] = old_gen
             bucket[key] = obj
+            if kind == "pods":
+                # Re-account (a direct update can move or resize a
+                # bound pod — the bind subresource is just the common
+                # path).
+                self._account_pod(current, -1)
+                self._account_pod(obj, +1)
             ev = self._emit("MODIFIED", kind, key, obj, prev=current)
             return ev.object if owned else copy.deepcopy(obj)
 
@@ -345,6 +453,8 @@ class MemStore:
             obj = bucket.pop(key, None)
             if obj is None:
                 raise KeyError(f"{kind} {key} not found")
+            if kind == "pods":
+                self._account_pod(obj, -1)
             # COW before the rv stamp: the popped dict may still be
             # referenced by earlier in-flight events (share_events mode).
             prev = obj
@@ -407,6 +517,11 @@ class MemStore:
             raise ConflictError(
                 f"pod {key} is already assigned to node "
                 f"{pod['spec']['nodeName']}")
+        if self._capacity_check:
+            # Server-side capacity validation: the 409 the scheduler
+            # absorbs via forget + requeue, so watch lag can never land
+            # an overcommitting bind.
+            self._check_bind_capacity(key, pod, node_name)
         # Copy-on-write (pod + the two sub-dicts this write touches): the
         # previous version may still be referenced by in-flight events, so
         # no stored object is ever mutated in place.
@@ -416,6 +531,7 @@ class MemStore:
         pod["metadata"] = dict(pod.get("metadata") or {})
         pod["spec"]["nodeName"] = node_name
         self._objects["pods"][key] = pod
+        self._account_pod(pod, +1)
         self._emit("MODIFIED", "pods", key, pod, prev=prev)
 
     def bind_many(self, bindings: list[tuple[str, str, str]]

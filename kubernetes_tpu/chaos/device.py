@@ -63,6 +63,34 @@ class SimulatedDeviceError(RuntimeError):
     content, like the real thing."""
 
 
+# The tenant set of the solve currently in flight, published by the
+# drain pipeline / solver service around each dispatch so tenant-scoped
+# rules can target one tenant's batches.  Process-global rather than
+# thread-local ON PURPOSE: a deferred-readback chunk's poisoning happens
+# on the commit worker thread, which must still see the drain thread's
+# context (injection rigs run one drain at a time).
+_tenant_ctx: frozenset = frozenset()
+
+
+import contextlib as _contextlib  # noqa: E402 — local to the context helper
+
+
+@_contextlib.contextmanager
+def tenant_context(tenants):
+    """Publish the in-flight solve's tenant set for rule matching."""
+    global _tenant_ctx
+    prev = _tenant_ctx
+    _tenant_ctx = frozenset(tenants or ())
+    try:
+        yield
+    finally:
+        _tenant_ctx = prev
+
+
+def current_tenants() -> frozenset:
+    return _tenant_ctx
+
+
 @dataclass
 class DeviceRule:
     fault: str = FAULT_OOM
@@ -70,6 +98,11 @@ class DeviceRule:
     every_nth: int = 0        # fire on every Nth matching solve (0 = off)
     probability: float = 1.0
     count: int = -1           # max fires; -1 = unlimited
+    # Tenant-scoped chaos (the multi-tenant isolation drills): the rule
+    # fires only for solves whose batch carries this tenant's rows —
+    # the adversarial-tenant poison batch, injectable without touching
+    # the victims' solves.  "" = any tenant (the pre-tenancy behavior).
+    tenant: str = ""
     seen: int = 0
     fired: int = 0
     _pattern: re.Pattern | None = field(default=None, repr=False)
@@ -80,12 +113,16 @@ class DeviceRule:
         self._pattern = re.compile(self.path) if self.path else None
 
     def matches(self, path: str) -> bool:
-        return self._pattern is None or bool(self._pattern.search(path))
+        if self._pattern is not None and \
+                not self._pattern.search(path):
+            return False
+        return not self.tenant or self.tenant in current_tenants()
 
     def to_json(self) -> dict:
         return {"fault": self.fault, "path": self.path,
                 "every_nth": self.every_nth,
                 "probability": self.probability, "count": self.count,
+                "tenant": self.tenant,
                 "seen": self.seen, "fired": self.fired}
 
 

@@ -1035,6 +1035,121 @@ def run_ha_wave(n_nodes: int = 800, n_shards: int = 8,
                 os.environ[k] = v
 
 
+def run_capacity_wave(n_nodes: int = 16, pods_per_node: int = 10,
+                      quiet: bool = False) -> dict:
+    """The near-capacity wave (the PR 11 REMAINING item, closed by the
+    apiserver's server-side bind capacity validation): a fleet offered
+    pods up to ~94 % of its absolute slot capacity, plus deliberate
+    overcommitting bind probes against already-full nodes — the shape a
+    watch-lagged (or buggy) scheduler would produce.  The probes must
+    bounce off the server's 409 (``apiserver_bind_capacity_rejects_
+    total``), the real scheduler must absorb its own rejects via
+    forget + requeue and still converge, and the post-wave audit must
+    find ZERO overcommitted nodes — the zero-overcommit assertion the
+    soak ratchet pins."""
+    from kubernetes_tpu.apiserver.server import serve
+    from kubernetes_tpu.scheduler.factory import ConfigFactory
+    capacity = n_nodes * pods_per_node
+    offered = int(capacity * 0.94)
+    store = MemStore()
+    api_srv = serve(store)
+    api_url = f"http://127.0.0.1:{api_srv.server_address[1]}"
+    direct = APIClient(api_url, qps=0)
+    direct.create_list("nodes", [
+        _node_json(f"cap-{i:03d}", milli_cpu=pods_per_node * 100,
+                   pods=pods_per_node) for i in range(n_nodes)])
+    rejects0 = metrics.BIND_CAPACITY_REJECTS.value
+    factory = ConfigFactory(api_url, qps=5000, burst=5000)
+    factory.daemon.backoff = PodBackoff(default_duration=0.1,
+                                        max_duration=1.0)
+    factory.run()
+    probe_rejects = 0
+    try:
+        direct.create_list("pods", [_pod_json(f"cw-{i:05d}", cpu="100m")
+                                    for i in range(offered)])
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            bound = sum(1 for o in store.list("pods")[0]
+                        if (o.get("spec") or {}).get("nodeName"))
+            if bound >= offered:
+                break
+            time.sleep(0.1)
+        # Overcommitting probes: bind fresh pods straight at the FULL
+        # nodes (bypassing the scheduler — the lagged-peer shape).  The
+        # server must 409 every one.
+        per_node: dict[str, int] = {}
+        for o in store.list("pods")[0]:
+            nd = (o.get("spec") or {}).get("nodeName")
+            if nd:
+                per_node[nd] = per_node.get(nd, 0) + 1
+        full = [n for n, c in per_node.items() if c >= pods_per_node]
+        probes = []
+        # The probe pods become ordinary pending pods afterwards, so
+        # they must still FIT the fleet's remaining slots or the wave
+        # would manufacture stranded pods at toy scales.
+        probe_budget = min(4, capacity - offered)
+        for i, node in enumerate(full[:probe_budget]):
+            name = f"cw-probe-{i}"
+            direct.create("pods", _pod_json(name, cpu="100m"))
+            probes.append(name)
+            try:
+                direct.bind("default", name, node)
+            except Exception:  # noqa: BLE001 — the expected 409
+                probe_rejects += 1
+        # The probe pods are now ordinary pending pods; the scheduler
+        # converges them onto the remaining free slots.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            unbound = sum(1 for o in store.list("pods")[0]
+                          if not (o.get("spec") or {}).get("nodeName"))
+            if unbound == 0:
+                break
+            time.sleep(0.1)
+    finally:
+        try:
+            factory.stop()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        api_srv.shutdown()
+    # Zero-overcommit audit against the store's own truth.
+    pods_final, _ = store.list("pods")
+    used: dict[str, list] = {}
+    for o in pods_final:
+        nd = (o.get("spec") or {}).get("nodeName")
+        if not nd:
+            continue
+        row = used.setdefault(nd, [0, 0])
+        milli, _, _ = MemStore._pod_requests(o)
+        row[0] += milli
+        row[1] += 1
+    overcommitted = 0
+    for i in range(n_nodes):
+        row = used.get(f"cap-{i:03d}", [0, 0])
+        if row[0] > pods_per_node * 100 or row[1] > pods_per_node:
+            overcommitted += 1
+    stranded = sum(1 for o in pods_final
+                   if not (o.get("spec") or {}).get("nodeName"))
+    out = {
+        "nodes": n_nodes,
+        "capacity_slots": capacity,
+        "offered": offered + len(
+            [p for p in pods_final
+             if p["metadata"]["name"].startswith("cw-probe-")]),
+        "bound": len(pods_final) - stranded,
+        "stranded_pending": stranded,
+        "overcommit_probes": probe_rejects,
+        "bind_capacity_rejects":
+            metrics.BIND_CAPACITY_REJECTS.value - rejects0,
+        "overcommitted_nodes": overcommitted,
+    }
+    if not quiet:
+        print(f"capacity wave: {out['bound']}/{out['offered']} bound, "
+              f"{out['bind_capacity_rejects']} server-side capacity "
+              f"rejects, {overcommitted} overcommitted nodes",
+              file=sys.stderr)
+    return out
+
+
 def _reconcile(store: MemStore, factory, monitor: _BindMonitor) -> dict:
     """Post-soak apiserver-vs-oracle reconciliation: the acceptance
     invariants a mid-drain kill must not break."""
@@ -1131,6 +1246,11 @@ def collect(ha: bool = True, **kw) -> dict:
     }
     if ha and os.environ.get("BENCH_SOAK_HA", "1") != "0":
         rec["ha"] = run_ha_wave(quiet=kw.get("quiet", False))
+    if os.environ.get("BENCH_SOAK_CAPACITY", "1") != "0":
+        # The near-capacity wave: server-side bind capacity validation
+        # under deliberate overcommit probes; the ratchet pins
+        # overcommitted_nodes == 0 and stranded_pending == 0.
+        rec["capacity"] = run_capacity_wave(quiet=kw.get("quiet", False))
     return rec
 
 

@@ -134,7 +134,9 @@ def load_policy(opts):
 def _decisions_route(daemon, query: str) -> tuple[int, bytes, str]:
     """/debug/scheduler/decisions: the flight recorder's batch ring;
     ``?pod=ns/name`` explains one pod's latest decision (chosen node, or
-    per-predicate failure counts and top-scoring candidates)."""
+    per-predicate failure counts and top-scoring candidates);
+    ``?tenant=name`` filters batch summaries to one tenant's rows (the
+    multi-tenant service's per-tenant decision history)."""
     from urllib.parse import parse_qs
     recorder = daemon.config.flight_recorder
     if recorder is None:
@@ -154,8 +156,9 @@ def _decisions_route(daemon, query: str) -> tuple[int, bytes, str]:
     except ValueError:
         return (400, b'{"error": "limit must be an integer"}',
                 "application/json")
-    return (200, json.dumps(recorder.snapshot(limit=limit)).encode(),
-            "application/json")
+    tenant = q.get("tenant", [""])[0]
+    return (200, json.dumps(recorder.snapshot(
+        limit=limit, tenant=tenant)).encode(), "application/json")
 
 
 def _status_mux(factory: ConfigFactory, configz: dict, port: int
@@ -271,6 +274,12 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
                     "ha": (factory.shards.report()
                            if getattr(factory, "shards", None)
                            is not None else None),
+                    # The multi-tenant solver service (tenancy/): per-
+                    # tenant mode/weights/trips/fault attribution; null
+                    # when KT_TENANTS is unset.
+                    "tenancy": (factory.tenancy.report()
+                                if getattr(factory, "tenancy", None)
+                                is not None else None),
                     "shardRecoveries": getattr(
                         factory, "shard_recoveries", [])[-8:],
                     "cachedPods": cache.pod_count(),
@@ -278,8 +287,35 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
                     "cacheStats": cache.stats,
                     "generation": cache.generation,
                 }).encode(), "application/json")
+            elif path == "/tenancy":
+                if getattr(factory, "tenancy", None) is None:
+                    self._send(404, b"tenancy disabled")
+                else:
+                    self._send(200,
+                               json.dumps(factory.tenancy.report())
+                               .encode(), "application/json")
             else:
                 self._send(404, b"not found")
+
+        def do_POST(self):
+            # The solver-service boundary over the daemon's existing
+            # HTTP surface: with KT_TENANTS set, other control planes
+            # POST /solve {tenant, pods:[...]} and get placements from
+            # THIS daemon's device (tenancy/service.solve_route).
+            path = self.path.partition("?")[0]
+            if path != "/solve":
+                self._send(404, b"not found")
+                return
+            if getattr(factory, "tenancy", None) is None:
+                self._send(404, b"tenancy disabled")
+                return
+            try:
+                clen = int(self.headers.get("Content-Length", "0") or 0)
+            except ValueError:
+                clen = 0
+            body = self.rfile.read(clen) if clen else b""
+            from kubernetes_tpu.tenancy.service import solve_route
+            self._send(*solve_route(factory.tenancy, body))
 
     server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
     threading.Thread(target=server.serve_forever, daemon=True,

@@ -101,6 +101,52 @@ def _env_deadline_s() -> float:
     return 0.0
 
 
+def prune_first_seen_fair(registry: dict, bound: int,
+                          group_of: Optional[Callable[[str], str]] = None
+                          ) -> dict:
+    """Shrink a first-seen registry to ``bound`` entries PER-GROUP-FAIR.
+
+    The registry backs the e2e decision-latency SLO: losing a pod's
+    stamp silently resets its clock.  Global oldest-first pruning has a
+    multi-tenant failure mode — one tenant's flood of fresh stamps makes
+    every OTHER tenant's (older, still-live) stamps the global-oldest,
+    so the noisy tenant evicts the quiet tenants' clocks.  This prune is
+    fair instead: entries are dropped oldest-first WITHIN whichever
+    group currently holds the most entries, so shedding always lands on
+    the flooder and a quiet group's stamps survive untouched.
+
+    ``group_of`` maps a registry key to its fairness group (default: the
+    key's namespace — the tenant proxy, and the right boundary even
+    without tenancy configured)."""
+    if len(registry) <= bound:
+        return registry
+    if group_of is None:
+        def group_of(key: str) -> str:
+            return key.partition("/")[0]
+    import heapq
+    groups: dict[str, list] = {}
+    for key, ts in registry.items():
+        groups.setdefault(group_of(key), []).append((ts, key))
+    for items in groups.values():
+        # Newest first, so shedding pops the group's OLDEST from the end.
+        items.sort(reverse=True)
+    heap = [(-len(items), name) for name, items in groups.items()]
+    heapq.heapify(heap)
+    excess = len(registry) - bound
+    out = dict(registry)
+    while excess > 0 and heap:
+        neg, name = heapq.heappop(heap)
+        items = groups[name]
+        if not items:
+            continue
+        _, key = items.pop()
+        out.pop(key, None)
+        excess -= 1
+        if items:
+            heapq.heappush(heap, (-len(items), name))
+    return out
+
+
 def stamp_first_seen(pod) -> None:
     """Stamp the pod OBJECT's queue-admission time (idempotent).  The
     daemon's authoritative record is its key-indexed first-seen
@@ -232,6 +278,18 @@ class BatchFormer:
                 if self.queue.degraded():
                     break  # a storm crossed the watermark mid-linger
             self._adapt(len(pods), hit_deadline)
+            # One object per key per batch: the linger's second pop can
+            # re-return a pod that was requeued (bind-conflict backoff)
+            # or watch-redelivered between pops — and a duplicated key
+            # poisons the commit path (the bulk assume skips the second
+            # copy, and the skip-filter then drops BOTH, stranding the
+            # pod assumed-but-never-bound).  Keep the FIRST object.
+            seen_keys: set = set()
+            uniq = [pod for pod in pods
+                    if not (pod.key in seen_keys
+                            or seen_keys.add(pod.key))]
+            if len(uniq) != len(pods):
+                pods = uniq
         formation_s = time.perf_counter() - t0
         metrics_mod.BATCH_FORMATION_LATENCY.observe(formation_s * 1e6)
         missed = deadline_s > 0 and \

@@ -116,13 +116,25 @@ class ConfigFactory:
                  batched: bool = True,
                  qps: float = 50.0, burst: int = 100, token: str = "",
                  tls=None, ha_shards: Optional[int] = None,
-                 incarnation: str = ""):
+                 incarnation: str = "", solver_service=None,
+                 tenant: str = ""):
         if isinstance(store, str):
             store = APIClient(store, qps=qps, burst=burst, token=token,
                               tls=tls)
         self.store = store
         self.listers = Listers()
-        self.algorithm = GenericScheduler(policy=policy, listers=self.listers)
+        if solver_service is not None:
+            # Solver-service CLIENT mode: this daemon owns no device —
+            # its solve verbs submit to a shared SolverService (or a
+            # SolverClient speaking the HTTP /solve surface), tagged
+            # with this daemon's tenant; cache feeding, assume/bind,
+            # and failure handling stay local (tenancy/service.py).
+            from kubernetes_tpu.tenancy.service import ServiceEngine
+            self.algorithm = ServiceEngine(solver_service, tenant=tenant,
+                                           listers=self.listers)
+        else:
+            self.algorithm = GenericScheduler(policy=policy,
+                                              listers=self.listers)
         if isinstance(store, APIClient):
             binder = APIClientBinder(store)
             events_client = store.clone(qps=0)
@@ -169,6 +181,22 @@ class ConfigFactory:
         # Bounded log of shard-takeover reconciles (served on
         # /debug/vars next to lastRecovery).
         self.shard_recoveries: list[dict] = []
+        # Multi-tenant solver service (KT_TENANTS, tenancy/): this
+        # daemon's engine becomes a shared service — the pipeline packs
+        # cross-tenant batches under weighted fairness, attributes
+        # faults per tenant (per-tenant breakers, host fallback), and
+        # the bind path records {tenant=}-labeled SLO metrics.  Unset =
+        # single-owner engine, byte-for-byte the old behavior.
+        from kubernetes_tpu import tenancy as tenancy_mod
+        self.tenancy = None
+        if solver_service is None and tenancy_mod.enabled():
+            from kubernetes_tpu.tenancy.service import SolverService
+            self.tenancy = SolverService(
+                engine=self.algorithm,
+                ladder_fn=self.daemon.effective_ladder,
+                urgent_s_fn=lambda:
+                    self.daemon.pipeline.former.deadline_s)
+            self.daemon.tenancy_service = self.tenancy
         if ha_shards > 0:
             from kubernetes_tpu.scheduler.shards import ShardManager
             incarnation = incarnation or \
@@ -460,7 +488,18 @@ class ConfigFactory:
             # interactive rigs keep their startup latency; the perf rigs
             # and production daemons set KT_PREWARM=1 and, with the
             # persistent compile cache populated, pay near-zero here).
-            self.daemon.prewarm()
+            # With tenancy on, the warm batches span the tenant
+            # namespaces: the selector-spread group axis is
+            # per-namespace, so the FIRST cross-tenant packed batch
+            # would otherwise ratchet that capacity past what the
+            # single-namespace warmup traced — a compile stall on
+            # exactly the first drain the service exists to share.
+            samples = None
+            if self.tenancy is not None:
+                samples = [api.Pod(name=f"__warm-tenant-{i}",
+                                   namespace=t)
+                           for i, t in enumerate(self.tenancy.tenants)]
+            self.daemon.prewarm(sample_pods=samples)
         if os.environ.get("KT_RECOVERY", "1") not in ("", "0"):
             # Crash-safe restart: reconcile cache + queue against one
             # apiserver relist (re-adopt bound pods, requeue orphans,

@@ -40,11 +40,11 @@ TOP_K = 5
 
 class BatchRecord:
     __slots__ = ("batch_id", "trace_id", "ts", "duration_s", "size",
-                 "placed", "placements", "failures")
+                 "placed", "placements", "failures", "tenants")
 
     def __init__(self, batch_id: int, trace_id: str, ts: float,
                  duration_s: float, placements: dict,
-                 failures: dict):
+                 failures: dict, tenants: dict | None = None):
         self.batch_id = batch_id
         self.trace_id = trace_id
         self.ts = ts
@@ -53,12 +53,16 @@ class BatchRecord:
         self.placed = sum(1 for v in placements.values() if v is not None)
         self.placements = placements      # pod key -> node name | None
         self.failures = failures          # pod key -> detail dict
+        self.tenants = tenants            # tenant -> row count (tenancy on)
 
     def summary(self) -> dict:
-        return {"batch_id": self.batch_id, "trace_id": self.trace_id,
-                "ts": self.ts, "duration_s": round(self.duration_s, 6),
-                "size": self.size, "placed": self.placed,
-                "failed": self.size - self.placed}
+        out = {"batch_id": self.batch_id, "trace_id": self.trace_id,
+               "ts": self.ts, "duration_s": round(self.duration_s, 6),
+               "size": self.size, "placed": self.placed,
+               "failed": self.size - self.placed}
+        if self.tenants:
+            out["tenants"] = self.tenants
+        return out
 
 
 class FlightRecorder:
@@ -87,11 +91,14 @@ class FlightRecorder:
 
     def record_batch(self, pods, placements, trace_id: str = "",
                      duration_s: float = 0.0,
-                     failure_detail: dict | None = None) -> int:
+                     failure_detail: dict | None = None,
+                     tenants: dict | None = None) -> int:
         """One drained batch: parallel (pods, placements) lists as produced
         by ``schedule_batch``; ``failure_detail`` maps pod key ->
         {"failed_predicates": {...}, ...} for the pods the engine
-        explained.  Returns the batch id."""
+        explained.  ``tenants`` (tenant -> row count, tenancy rigs only)
+        tags the record so ``/debug/scheduler/decisions?tenant=`` can
+        filter one tenant's decision history.  Returns the batch id."""
         placement_map = {pod.key: dest
                          for pod, dest in zip(pods, placements)}
         failures: dict = {}
@@ -123,7 +130,8 @@ class FlightRecorder:
                     f"pod ({pod.name}) failed to fit in any node"}
             batch_id = next(self._seq)
             rec = BatchRecord(batch_id, trace_id, time.time(),
-                              duration_s, placement_map, failures)
+                              duration_s, placement_map, failures,
+                              tenants=tenants)
             self._ring.append(rec)
         return batch_id
 
@@ -189,7 +197,9 @@ class FlightRecorder:
         with self._lock:
             records = [{"batch_id": r.batch_id, "trace_id": r.trace_id,
                         "ts": r.ts, "duration_s": r.duration_s,
-                        "placements": r.placements, "failures": r.failures}
+                        "placements": r.placements,
+                        "failures": r.failures,
+                        "tenants": r.tenants}
                        for r in self._ring]
         path = os.path.join(flight_dir, FLIGHT_FILE)
         tmp = path + ".tmp"
@@ -216,7 +226,8 @@ class FlightRecorder:
                     float(rec.get("ts", 0.0)),
                     float(rec.get("duration_s", 0.0)),
                     dict(rec.get("placements") or {}),
-                    dict(rec.get("failures") or {})))
+                    dict(rec.get("failures") or {}),
+                    tenants=rec.get("tenants") or None))
                 max_id = max(max_id, int(rec["batch_id"]))
             self._seq = itertools.count(max_id + 1)
             return len(data.get("records", []))
@@ -255,11 +266,16 @@ class FlightRecorder:
                     return out
             return out
 
-    def snapshot(self, limit: int = 0) -> dict:
-        """Batch summaries, newest first (the /debug endpoint body)."""
+    def snapshot(self, limit: int = 0, tenant: str = "") -> dict:
+        """Batch summaries, newest first (the /debug endpoint body).
+        ``tenant`` filters to batches carrying that tenant's rows (the
+        per-tenant flight-recorder view; untagged records — tenancy
+        off — never match a tenant filter)."""
         with self._lock:
             recs = list(self._ring)
         recs.reverse()
+        if tenant:
+            recs = [r for r in recs if r.tenants and tenant in r.tenants]
         if limit > 0:
             recs = recs[:limit]
         return {"capacity": self._ring.maxlen,

@@ -60,7 +60,7 @@ class DrainPipeline:
             queue=daemon.queue,
             ladder_fn=daemon.effective_ladder,
             chunk_fn=daemon.stream_chunk_size,
-            cap_fn=daemon.degraded_drain_cap)
+            cap_fn=self._former_cap)
         # The overlapped commit worker (one thread: chunks commit in
         # solve order); created lazily on the first windowed drain.
         self._commit_pool = None
@@ -70,6 +70,18 @@ class DrainPipeline:
         guard = getattr(daemon.config.algorithm, "guard", None)
         if guard is not None:
             guard.ladder_fn = daemon.effective_ladder
+
+    def _former_cap(self) -> int:
+        """The degraded drain cap.  With tenancy on the former over-pops
+        (4x the solve cap) so the cross-tenant packer sees past the
+        FIFO head — a flood tenant's pods dominate the queue front, and
+        fair selection needs candidates from the quiet tenants behind
+        them; the packer then caps the SOLVE back to one warm bucket
+        and defers the rest."""
+        cap = self.daemon.degraded_drain_cap()
+        if getattr(self.daemon, "tenancy_service", None) is not None:
+            return cap * 4
+        return cap
 
     # -- the single drain entry path -------------------------------------
 
@@ -83,6 +95,18 @@ class DrainPipeline:
         pods = batch.pods
         if not pods:
             return 0
+        svc = getattr(daemon, "tenancy_service", None)
+        if svc is not None:
+            # Cross-tenant packing: bound every solve at one warm
+            # ladder bucket and fill it urgency-first then by weighted
+            # share (tenancy/packer.py); the remainder returns to the
+            # queue with its SLO stamps intact.  Degradation still
+            # wins: the former already shed to a bounded pop above.
+            selected, deferred = svc.packer.pack(
+                pods, daemon.degraded_drain_cap())
+            for pod in deferred:
+                daemon.queue.add(pod)
+            pods = batch.pods = selected
         # The batch root span is backdated to cover the wait: queue_wait
         # (blocking pop + deadline batch formation) is the pipeline's
         # first stage, even though the batch only existed at its end.
@@ -144,12 +168,13 @@ class DrainPipeline:
         them), so progress is monotone across rounds."""
         daemon = self.daemon
         pods = batch.pods
+        if getattr(daemon, "tenancy_service", None) is not None:
+            return self._solve_tenants(pods, tr, trace_id)
         guard = getattr(daemon.config.algorithm, "guard", None)
         if guard is None or not guard.enabled:
             return self._dispatch(pods, tr, trace_id)
         total = len(pods)
         remaining = pods
-        cache = daemon.config.algorithm.cache
         fault: Optional[DeviceFault] = None
         for _ in range(max(guard.max_rounds, 1)):
             mode = guard.solve_mode()
@@ -162,18 +187,7 @@ class DrainPipeline:
                 return total
             except DeviceFault as f:
                 fault = f
-                # Re-dispatch ONLY the stranded remainder: pods a
-                # completed chunk already assumed (or the watch
-                # confirmed) are in the cache, and pods a completed
-                # chunk already FAILED are in the backoff heap / back on
-                # the queue — re-solving those would schedule the same
-                # pod twice (once here, once when its requeue pops).
-                with daemon._requeue_cv:
-                    handled = {p.key for _, _, p in daemon._requeue_heap}
-                remaining = [p for p in remaining
-                             if not cache.contains(p.key)
-                             and p.key not in handled
-                             and p.key not in daemon.queue]
+                remaining = self._uncommitted(remaining)
                 if not remaining:
                     return total
                 action = guard.recover(
@@ -182,6 +196,129 @@ class DrainPipeline:
                             "re-dispatched via %s", f.kind, f.path,
                             len(remaining), action)
         raise fault  # ladder exhausted: crash handler requeues
+
+    def _uncommitted(self, pods: list) -> list:
+        """The stranded remainder of a faulted dispatch: pods a
+        completed chunk already assumed (or the watch confirmed) are in
+        the cache, and pods a completed chunk already FAILED are in the
+        backoff heap / back on the queue — re-solving those would
+        schedule the same pod twice (once here, once when its requeue
+        pops)."""
+        daemon = self.daemon
+        cache = daemon.config.algorithm.cache
+        with daemon._requeue_cv:
+            handled = {p.key for _, _, p in daemon._requeue_heap}
+        return [p for p in pods
+                if not cache.contains(p.key)
+                and p.key not in handled
+                and p.key not in daemon.queue]
+
+    def _solve_tenants(self, pods: list, tr: Optional[Trace],
+                       trace_id: str) -> int:
+        """The multi-tenant solve path: per-tenant breaker routing,
+        mixed-batch fault ATTRIBUTION by per-tenant split, and
+        per-tenant accounting — one tenant's poison batch degrades that
+        tenant to the host engine; the service and the other tenants
+        stay on device.
+
+        A ``lost`` fault still escalates the GLOBAL guard (a dead chip
+        is not one tenant's fault) and OOM still runs the global
+        eviction/bisect-cap ladder; the per-tenant breaker owns the
+        ATTRIBUTABLE kinds (a tenant's poison readbacks, its repeated
+        OOM-sized batches) — it trips at KT_TENANT_BREAKER consecutive
+        faults, before the global breaker's threshold can."""
+        daemon = self.daemon
+        svc = daemon.tenancy_service
+        guard = getattr(daemon.config.algorithm, "guard", None)
+        guard_on = guard is not None and guard.enabled
+        total = len(pods)
+        gmode = guard.solve_mode() if guard_on else "device"
+        if gmode == "host":
+            # Whole-device outage (global breaker open, no probe due):
+            # every tenant decides on the host engine this drain.
+            self._dispatch(pods, tr, trace_id, host=True)
+            return total
+        device_pods, host_pods, probing = svc.partition(pods)
+        if host_pods:
+            self._dispatch(host_pods, tr, trace_id, host=True)
+            for t, n in svc.count_tenants(host_pods).items():
+                svc.note_host_fallback(t, n)
+        if device_pods:
+            # One solver at a time on the shared engine: the service's
+            # packed submits (remote control planes) and this drain
+            # must not race GenericScheduler's solve state.
+            with svc.engine_lock:
+                self._solve_tenant_groups(
+                    device_pods, probing, gmode, tr, trace_id)
+        return total
+
+    def _solve_tenant_groups(self, device_pods: list, probing: set,
+                             gmode: str, tr: Optional[Trace],
+                             trace_id: str) -> None:
+        """The device section of a tenant drain (caller holds the
+        service's engine lock): dispatch, attribution splits, and the
+        per-tenant breaker routing."""
+        from collections import deque
+
+        from kubernetes_tpu.chaos import device as chaos_device
+        from kubernetes_tpu.engine import devicestats
+        from kubernetes_tpu.engine.guard import ACT_HOST, KIND_LOST, KIND_OOM
+        daemon = self.daemon
+        svc = daemon.tenancy_service
+        guard = getattr(daemon.config.algorithm, "guard", None)
+        guard_on = guard is not None and guard.enabled
+        # Transfer attribution covers the DEVICE section only — a
+        # host-degraded tenant must not be billed for device bytes it
+        # never moved.
+        transfers0 = sum(devicestats.transfer_snapshot().values())
+        groups = deque([device_pods])
+        rounds = 0
+        budget = (guard.max_rounds if guard_on else 1) + \
+            len(svc.tenants) + 2
+        while groups:
+            group = groups.popleft()
+            tenants_g = svc.tenants_of(group)
+            try:
+                with chaos_device.tenant_context(tenants_g):
+                    self._dispatch(group, tr, trace_id)
+                if guard_on:
+                    guard.note_success(probe=(gmode == "probe"))
+                for t in tenants_g:
+                    svc.note_success(t, probe=(t in probing))
+            except DeviceFault as f:
+                rounds += 1
+                remaining = self._uncommitted(group)
+                if not remaining:
+                    continue
+                if rounds > budget:
+                    raise  # crash handler requeues — never drops
+                tenants_r = svc.tenants_of(remaining)
+                if len(tenants_r) > 1:
+                    # Attribution bisection: split per tenant and
+                    # re-solve each alone — the culprit's solo batch
+                    # keeps faulting and trips ITS breaker.
+                    svc.note_split(f)
+                    groups.extend(svc.split_by_tenant(remaining))
+                    log.warning("device fault [%s] on a %d-tenant "
+                                "batch: split per tenant for "
+                                "attribution", f.kind, len(tenants_r))
+                    continue
+                tenant = tenants_r[0]
+                tripped = svc.note_fault(tenant, f.kind,
+                                         probe=(tenant in probing))
+                to_host = tripped or f.kind == KIND_LOST
+                if guard_on and f.kind in (KIND_LOST, KIND_OOM):
+                    action = guard.recover(
+                        f, can_bisect=self._can_bisect(remaining))
+                    to_host = to_host or action == ACT_HOST
+                if to_host:
+                    self._dispatch(remaining, tr, trace_id, host=True)
+                    svc.note_host_fallback(tenant, len(remaining))
+                else:
+                    groups.append(remaining)
+        svc.record_solve(
+            device_pods, sum(devicestats.transfer_snapshot().values())
+            - transfers0)
 
     def _can_bisect(self, pods: list) -> bool:
         """OOM bisection re-solves the remainder as stream chunks at a

@@ -137,6 +137,13 @@ class Scheduler:
         # cross-shard 409 counter all consult it.  None = own everything
         # (the single-scheduler default).
         self.owns_pod: Optional[Callable[[api.Pod], bool]] = None
+        # Multi-tenant solver service (tenancy/service.py), attached by
+        # the factory when KT_TENANTS is set (or by a rig): the drain
+        # pipeline then packs cross-tenant batches under weighted
+        # fairness, routes per-tenant breakers, and the bind path
+        # attributes per-tenant SLO metrics.  None = single-owner
+        # engine, byte-for-byte the pre-tenancy behavior.
+        self.tenancy_service = None
         self._stop = threading.Event()
         self._bind_threads: list[threading.Thread] = []
         # Single requeue worker over a timer heap (a thread per failed pod
@@ -198,13 +205,24 @@ class Scheduler:
     def _prune_first_seen(self) -> None:
         """Drop registry entries for pods no longer anywhere in flight
         (deleted while pending): keep keys still queued, in backoff, or
-        assumed — everything else bound (cleared at ack) or vanished."""
+        assumed — everything else bound (cleared at ack) or vanished.
+        If the registry is STILL over its bound (one tenant flooding
+        more live pods than the cap), shed per-namespace-fair — oldest
+        first WITHIN the largest namespace groups — so a noisy tenant's
+        flood can never evict a quiet tenant's stamps and silently
+        reset its SLO clock (the pre-fix pruning was global, exactly
+        that failure)."""
+        from kubernetes_tpu.scheduler.batchformer import \
+            prune_first_seen_fair
         cache = self.config.algorithm.cache
         with self._requeue_cv:
             backoff = {pod.key for _, _, pod in self._requeue_heap}
         self._first_seen = {
             k: t for k, t in self._first_seen.items()
             if k in backoff or k in self.queue or cache.contains(k)}
+        if len(self._first_seen) > 65536:
+            self._first_seen = prune_first_seen_fair(
+                self._first_seen, 65536)
 
     # -- one-pod path (scheduleOne, scheduler.go:93-154) -----------------
 
@@ -243,7 +261,10 @@ class Scheduler:
             if self.config.flight_recorder is not None:
                 self.config.flight_recorder.record_batch(
                     [pod], [dest], trace_id=root.trace_id,
-                    duration_s=algo_us / 1e6)
+                    duration_s=algo_us / 1e6,
+                    tenants=(self.tenancy_service.count_tenants([pod])
+                             if self.tenancy_service is not None
+                             else None))
             self._assume_and_bind(pod, dest, start)
             return True
         finally:
@@ -320,7 +341,11 @@ class Scheduler:
                         if t > cutoff}
         recorder.record_batch(pods, placements, trace_id=trace_id,
                               duration_s=duration_s,
-                              failure_detail=detail)
+                              failure_detail=detail,
+                              tenants=(self.tenancy_service
+                                       .count_tenants(pods)
+                                       if self.tenancy_service is not None
+                                       else None))
 
     def _assume_and_bind_batch(self, pods: list[api.Pod],
                                placements: list, start: float,
@@ -815,6 +840,9 @@ class Scheduler:
             metrics_mod.E2E_DECISION_LATENCY.observe(
                 (now - seen) * 1e6,
                 exemplar=trace_mod.current_trace_id())
+        if self.tenancy_service is not None:
+            self.tenancy_service.record_bound(
+                pod, (now - seen) if seen is not None else None)
         self._first_seen.pop(pod.key, None)
         self.config.metrics.scheduling_attempts.labels(
             result="scheduled").inc()
@@ -889,11 +917,15 @@ class Scheduler:
         # The batch's trace id rides along as the bucket exemplar: a bad
         # p99 bucket then names the exact trace to pull from the ring.
         tid = trace_mod.current_trace_id()
+        svc = self.tenancy_service
         for pod in bound_pods:
             seen = first_seen(pod)
             if seen is not None:
                 metrics_mod.E2E_DECISION_LATENCY.observe(
                     (done - seen) * 1e6, exemplar=tid)
+            if svc is not None:
+                svc.record_bound(
+                    pod, (done - seen) if seen is not None else None)
             self._first_seen.pop(pod.key, None)
         if ok:
             self.config.metrics.scheduling_attempts.labels(

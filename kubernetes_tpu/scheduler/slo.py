@@ -78,6 +78,13 @@ class SLOMonitor:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.last_burn: dict[str, float] = {}
+        # Per-tenant burn (multi-tenant solver service): sample rings
+        # per tenant child of the {tenant=}-labeled decision histogram,
+        # burned over the SHORT window only (the paging signal; the
+        # global gauge keeps both windows).  Zero cost until a tenant
+        # child exists — i.e. until tenancy actually observes.
+        self._tenant_samples: dict[str, list] = {}
+        self.last_tenant_burn: dict[str, float] = {}
 
     # -- histogram reading ------------------------------------------------
 
@@ -118,7 +125,37 @@ class SLOMonitor:
         remaining = max(0.0, 1.0 - burns.get(longest_label, 0.0))
         metrics.SLO_BUDGET_REMAINING.set(remaining)
         self.last_burn = burns
+        self._tick_tenants(now)
         return burns
+
+    def _tick_tenants(self, now: float) -> None:
+        """Per-tenant burn over the shortest window, one sample ring per
+        tenant child of the labeled decision histogram."""
+        children = metrics.TENANT_DECISION_LATENCY.children()
+        if not children:
+            return
+        span = min(w for _, w in self.windows)
+        slo_us = self.slo_ms * 1e3
+        tenant_burns: dict[str, float] = {}
+        with self._lock:
+            for key, child in children.items():
+                tenant = key[0]
+                uppers, counts, total, _ = child.bucket_counts()
+                k = bisect_right(uppers, slo_us)
+                good = sum(counts[:k])
+                ring = self._tenant_samples.setdefault(tenant, [])
+                ring.append((now, total, good))
+                cutoff = now - self._longest
+                keep = 0
+                while keep + 1 < len(ring) and \
+                        ring[keep + 1][0] <= cutoff:
+                    keep += 1
+                del ring[:keep]
+                tenant_burns[tenant] = self._burn(
+                    list(ring), now - span, total, good)
+        for tenant, burn in tenant_burns.items():
+            metrics.TENANT_SLO_BURN.labels(tenant=tenant).set(burn)
+        self.last_tenant_burn = tenant_burns
 
     @staticmethod
     def _base(samples: list, t0: float) -> tuple[int, int]:
@@ -147,14 +184,18 @@ class SLOMonitor:
     def report(self) -> dict:
         """The /debug/vars payload."""
         total, good = self._counts()
-        return {"sloMs": self.slo_ms,
-                "objectivePct": self.objective_pct,
-                "decisionsTotal": total,
-                "decisionsOverSlo": total - good,
-                "burnRate": {k: round(v, 4)
-                             for k, v in self.last_burn.items()},
-                "budgetRemaining": round(
-                    float(metrics.SLO_BUDGET_REMAINING.value), 4)}
+        out = {"sloMs": self.slo_ms,
+               "objectivePct": self.objective_pct,
+               "decisionsTotal": total,
+               "decisionsOverSlo": total - good,
+               "burnRate": {k: round(v, 4)
+                            for k, v in self.last_burn.items()},
+               "budgetRemaining": round(
+                   float(metrics.SLO_BUDGET_REMAINING.value), 4)}
+        if self.last_tenant_burn:
+            out["tenantBurnRate"] = {t: round(v, 4) for t, v in
+                                     self.last_tenant_burn.items()}
+        return out
 
     def run(self, period: float = 5.0) -> threading.Thread:
         def loop():

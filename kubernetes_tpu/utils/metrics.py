@@ -658,6 +658,70 @@ CROSS_SHARD_CONFLICTS = register(Counter(
     "> 0): another incarnation (or a chaos rule) bound the pod first — "
     "the steady state should keep this near zero; bursts mark lease "
     "handoff windows where two incarnations briefly race one shard"))
+# Multi-tenant solver service (kubernetes_tpu/tenancy/): one device
+# shared by N tenants — per-tenant SLO, fairness, and fault-isolation
+# accounting.  Label values come from the bounded KT_TENANTS set (never
+# from client-controlled strings), so the families cannot mint series.
+TENANT_DECISION_LATENCY = register(Histogram(
+    "scheduler_tenant_decision_latency_microseconds",
+    "Per-pod decision latency (first-seen to bind ack) attributed to "
+    "the pod's tenant — the per-tenant serving SLO number the "
+    "multi-tenant bench and the per-tenant burn gauge read",
+    exponential_buckets(1000, 2, 18), labelnames=("tenant",)))
+TENANT_BOUND = register(Counter(
+    "scheduler_tenant_pods_bound_total",
+    "Pods bound per tenant — the fairness observable: under saturation "
+    "the per-tenant rates converge to the KT_TENANT_WEIGHTS shares",
+    labelnames=("tenant",)))
+TENANT_DEFERRED = register(Counter(
+    "scheduler_tenant_deferred_pods_total",
+    "Pods the cross-tenant packer deferred back to the queue because "
+    "the tenant was over its weighted share for the drain (first-seen "
+    "stamps survive, so deferral never resets the SLO clock)",
+    labelnames=("tenant",)))
+TENANT_FAULTS = register(Counter(
+    "scheduler_tenant_device_faults_total",
+    "Device faults attributed to one tenant's sub-batch after the "
+    "mixed-batch attribution split, by tenant and fault kind",
+    labelnames=("tenant", "kind")))
+TENANT_BREAKER_TRIPS = register(Counter(
+    "scheduler_tenant_breaker_trips_total",
+    "Per-tenant circuit-breaker trips: KT_TENANT_BREAKER consecutive "
+    "attributable faults degraded the tenant to the host engine while "
+    "every other tenant stayed on device",
+    labelnames=("tenant",)))
+TENANT_ENGINE_MODE = register(Gauge(
+    "scheduler_tenant_engine_mode",
+    "Which solver a tenant's batches route to: 0 = device, 1 = host "
+    "(tenant breaker open; probe solves re-promote to 0)",
+    labelnames=("tenant",)))
+TENANT_TRANSFER_BYTES = register(Counter(
+    "scheduler_tenant_transfer_bytes_total",
+    "Host<->device transfer bytes attributed to a tenant by its row "
+    "share of each solve (the per-tenant slice of the PR 9 per-cause "
+    "transfer plane)",
+    labelnames=("tenant",)))
+TENANT_HBM_BYTES = register(Gauge(
+    "scheduler_tenant_hbm_attributed_bytes",
+    "Live device HBM attributed to a tenant by an EMA of its row share "
+    "of recent solves (the resident tensors serve every tenant; the "
+    "EMA answers whose load the device is carrying)",
+    labelnames=("tenant",)))
+TENANT_SLO_BURN = register(Gauge(
+    "scheduler_tenant_slo_burn_rate",
+    "Per-tenant error-budget burn rate of the decision-latency SLO "
+    "over the 5m window (1.0 = exactly exhausting the budget; the "
+    "global burn gauge's tenant-attributed sibling)",
+    labelnames=("tenant",)))
+# Server-side capacity validation at bind (apiserver/memstore.py): the
+# apiserver rejects a bind that would overcommit the target node's
+# allocatable (watch-lagged schedulers absorb the 409 via forget +
+# requeue), so transient overcommit cannot land in the store.
+BIND_CAPACITY_REJECTS = register(Counter(
+    "apiserver_bind_capacity_rejects_total",
+    "Bind requests rejected by the apiserver's server-side capacity "
+    "check because the pod's requests exceeded the target node's "
+    "remaining allocatable (cpu/memory/pod-count)"))
 # Bind path (scheduler/scheduler.py).
 BIND_CONFLICTS = register(Counter(
     "scheduler_bind_conflicts_total",

@@ -233,6 +233,7 @@ const GROUPS = [
  ["SLO burn", /^scheduler_slo_/],
  ["Device HBM", /^scheduler_device_hbm_/],
  ["Device faults & fallback", /^scheduler_(device_faults|solve_fallback|engine_mode|hbm_watermark|sanity_)/],
+ ["Multi-tenant service", /^scheduler_tenant_|^apiserver_bind_capacity/],
  ["Device transfers", /^scheduler_(device_transfer|post_prewarm_compiles)/],
  ["Decisions & binds", /^scheduler_(pod_scheduling_attempts|e2e_decision|bind_|batch_formation|batch_deadline)/],
  ["Everything else", /./],

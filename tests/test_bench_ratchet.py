@@ -469,3 +469,129 @@ def test_ha_aggregate_ratchets_against_predecessors_ha_wave():
             ("SOAK_r12.json", dict(_soak(), backend="tpu",
                                    ha=_ha(agg=700.0)))]
     assert cb.check_ha(arts) == []
+
+
+# -- tenancy ratchet (ISSUE 12) ----------------------------------------------
+
+def _tenancy(backend="cpu", ratio=1.4, fair_err=0.03, cross=0,
+             attainment=100.0, floor=100.0, compiles=0, repromoted=True,
+             victim_mode="device", all_bound=True):
+    return {
+        "backend": backend,
+        "tenants": ["t-a", "t-b", "t-c"],
+        "weights": {"t-a": 2.0, "t-b": 1.0, "t-c": 1.0},
+        "rows": {"trickle_with_neighbor": {
+            "tenant": "t-a",
+            "latency_ms": {"p99": 200.0},
+            "slo": {"slo_ms": 1000.0, "attainment_pct": attainment,
+                    "attainment_floor_pct": floor}}},
+        "interference": {"ratio": ratio, "bar": 2.0},
+        "fairness": {"max_rel_error": fair_err, "bar": 0.10,
+                     "observed_shares": {}, "expected_shares": {}},
+        "isolation": {"cross_tenant_faults": cross,
+                      "cross_tenant_sanity_rejects": 0,
+                      "victim_modes": {"t-a": victim_mode,
+                                       "t-b": "device"},
+                      "repromoted": repromoted,
+                      "all_bound": all_bound},
+        "device": {"post_prewarm_compiles": compiles},
+    }
+
+
+def test_tenancy_repo_artifacts_pass():
+    assert cb.check_tenancy() == []
+
+
+def test_tenancy_clean_artifact_passes():
+    assert cb.check_tenancy([("TENANCY_r12.json", _tenancy())]) == []
+
+
+def test_tenancy_slo_floor_breach_fails():
+    problems = cb.check_tenancy(
+        [("TENANCY_r12.json", _tenancy(attainment=98.0))])
+    assert len(problems) == 1 and "attainment" in problems[0]
+
+
+def test_tenancy_cross_tenant_fault_leak_fails():
+    problems = cb.check_tenancy(
+        [("TENANCY_r12.json", _tenancy(cross=2))])
+    assert len(problems) == 1 and "cross-tenant" in problems[0]
+
+
+def test_tenancy_interference_over_bar_fails():
+    problems = cb.check_tenancy(
+        [("TENANCY_r12.json", _tenancy(ratio=2.3))])
+    assert len(problems) == 1 and "interference" in problems[0]
+
+
+def test_tenancy_fairness_over_bar_fails():
+    problems = cb.check_tenancy(
+        [("TENANCY_r12.json", _tenancy(fair_err=0.15))])
+    assert len(problems) == 1 and "fairness" in problems[0]
+
+
+def test_tenancy_victim_knocked_off_device_fails():
+    problems = cb.check_tenancy(
+        [("TENANCY_r12.json", _tenancy(victim_mode="host"))])
+    assert len(problems) == 1 and "knocked" in problems[0]
+
+
+def test_tenancy_stuck_host_or_stranded_fails():
+    assert any("re-promoted" in p for p in cb.check_tenancy(
+        [("TENANCY_r12.json", _tenancy(repromoted=False))]))
+    assert any("stranded" in p for p in cb.check_tenancy(
+        [("TENANCY_r12.json", _tenancy(all_bound=False))]))
+
+
+def test_tenancy_post_prewarm_compile_fails():
+    problems = cb.check_tenancy(
+        [("TENANCY_r12.json", _tenancy(compiles=3))])
+    assert len(problems) == 1 and "compile" in problems[0]
+
+
+def test_tenancy_interference_ratchets_same_backend_scan_back():
+    # Regression vs the predecessor fails...
+    arts = [("TENANCY_r12.json", _tenancy(ratio=1.2)),
+            ("TENANCY_r13.json", _tenancy(ratio=1.5))]
+    problems = cb.check_tenancy(arts)
+    assert len(problems) == 1 and "regressed" in problems[0]
+    # ...within tolerance passes...
+    arts = [("TENANCY_r12.json", _tenancy(ratio=1.4)),
+            ("TENANCY_r13.json", _tenancy(ratio=1.45))]
+    assert cb.check_tenancy(arts) == []
+    # ...a foreign-backend predecessor re-baselines, but the scan-back
+    # still finds the LAST same-backend artifact past it.
+    arts = [("TENANCY_r11.json", _tenancy(ratio=1.0, backend="cpu")),
+            ("TENANCY_r12.json", _tenancy(ratio=1.0, backend="tpu")),
+            ("TENANCY_r13.json", _tenancy(ratio=1.5, backend="cpu"))]
+    problems = cb.check_tenancy(arts)
+    assert len(problems) == 1 and "regressed" in problems[0]
+
+
+def test_tenancy_fairness_error_ratchets():
+    arts = [("TENANCY_r12.json", _tenancy(fair_err=0.02)),
+            ("TENANCY_r13.json", _tenancy(fair_err=0.06))]
+    problems = cb.check_tenancy(arts)
+    assert len(problems) == 1 and "fairness error regressed" in problems[0]
+
+
+# -- soak near-capacity wave (ISSUE 12 satellite) ----------------------------
+
+def test_soak_capacity_wave_overcommit_fails():
+    art = dict(_soak(), capacity={"overcommitted_nodes": 2,
+                                  "stranded_pending": 0,
+                                  "bind_capacity_rejects": 4})
+    problems = cb.check_soak([("SOAK_r12.json", art)])
+    assert any("overcommitted" in p for p in problems)
+
+
+def test_soak_capacity_wave_stranded_fails():
+    art = dict(_soak(), capacity={"overcommitted_nodes": 0,
+                                  "stranded_pending": 3,
+                                  "bind_capacity_rejects": 4})
+    problems = cb.check_soak([("SOAK_r12.json", art)])
+    assert any("stranded" in p for p in problems)
+
+
+def test_soak_without_capacity_section_ratchets_nothing():
+    assert cb.check_soak([("SOAK_r11.json", _soak())]) == []

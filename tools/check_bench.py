@@ -238,6 +238,21 @@ def check_soak(artifacts: list[tuple[str, dict]] | None = None,
         problems.append(
             f"{new_name}: the device-lost wave never re-promoted the "
             f"engine back to device mode")
+    # Near-capacity wave (server-side bind capacity validation):
+    # overcommit landing in the store, or pods stranded by the 409
+    # absorption, both break the zero-overcommit contract.  Artifacts
+    # predating the wave carry no section and ratchet nothing.
+    capacity = new.get("capacity") or {}
+    if capacity.get("overcommitted_nodes"):
+        problems.append(
+            f"{new_name}: {capacity['overcommitted_nodes']} node(s) "
+            f"overcommitted in the near-capacity wave — the server-side "
+            f"bind capacity check failed")
+    if capacity.get("stranded_pending"):
+        problems.append(
+            f"{new_name}: {capacity['stranded_pending']} pod(s) "
+            f"stranded pending after the near-capacity wave — the "
+            f"scheduler never converged past the capacity 409s")
     if len(artifacts) >= 2:
         # Same backend-gate as the BENCH p50 row: wall-clock rows
         # re-baseline when the accelerator under the artifact changed —
@@ -406,6 +421,112 @@ def check_serving(artifacts: list[tuple[str, dict]] | None = None,
     return problems
 
 
+def committed_tenancy_artifacts() -> list[tuple[str, dict]]:
+    """Committed TENANCY_r{N}.json artifacts (the multi-tenant solver
+    service rows emitted by perf/tenancy.py)."""
+    return _committed_family_artifacts(
+        "TENANCY", lambda d: bool(d.get("tenants")))
+
+
+def check_tenancy(artifacts: list[tuple[str, dict]] | None = None,
+                  tolerance: float = 0.10) -> list[str]:
+    """The multi-tenant ratchet over the newest TENANCY artifact.
+
+    Absolute invariants on the newest artifact alone: any per-tenant
+    SLO attainment below its recorded floor, a cross-tenant fault leak
+    (a fault attributed to a tenant other than the adversary), a victim
+    tenant knocked off the device, an adversarial tenant never
+    re-promoted, interference or fairness outside the artifact's own
+    recorded bars, and any post-prewarm compile all fail tier-1.
+    Artifact-over-artifact, the cross-tenant p99 interference ratio and
+    the fairness error must not regress beyond ``tolerance`` vs the
+    last SAME-BACKEND predecessor (check()'s scan-back rule — a mixed
+    cpu/tpu history must not retire the comparison)."""
+    if artifacts is None:
+        artifacts = committed_tenancy_artifacts()
+    problems: list[str] = []
+    if not artifacts:
+        return problems
+    new_name, new = artifacts[-1]
+    for row_name, row in (new.get("rows") or {}).items():
+        slo = row.get("slo") or {}
+        floor = slo.get("attainment_floor_pct")
+        got = slo.get("attainment_pct")
+        if floor is not None and got is not None and \
+                float(got) < float(floor):
+            problems.append(
+                f"{new_name}: {row_name} SLO attainment {got}% fell "
+                f"below its recorded floor {floor}% (tenant "
+                f"{row.get('tenant')}, slo {slo.get('slo_ms')}ms)")
+    interference = new.get("interference") or {}
+    ratio = interference.get("ratio")
+    bar = interference.get("bar")
+    if ratio is not None and bar is not None and \
+            float(ratio) > float(bar):
+        problems.append(
+            f"{new_name}: cross-tenant p99 interference ratio {ratio} "
+            f"exceeded the artifact's bar {bar} — the noisy neighbor "
+            f"moved the trickle tenant's tail")
+    fairness = new.get("fairness") or {}
+    err = fairness.get("max_rel_error")
+    fbar = fairness.get("bar")
+    if err is not None and fbar is not None and \
+            float(err) > float(fbar):
+        problems.append(
+            f"{new_name}: fairness error {err} exceeded the bar {fbar} "
+            f"— observed shares drifted from the configured weights "
+            f"(observed {fairness.get('observed_shares')} vs expected "
+            f"{fairness.get('expected_shares')})")
+    iso = new.get("isolation") or {}
+    if iso.get("cross_tenant_faults"):
+        problems.append(
+            f"{new_name}: {iso['cross_tenant_faults']} cross-tenant "
+            f"fault(s) — a fault leaked onto a tenant other than the "
+            f"adversary; per-tenant isolation broke")
+    if iso.get("cross_tenant_sanity_rejects"):
+        problems.append(
+            f"{new_name}: {iso['cross_tenant_sanity_rejects']} sanity "
+            f"reject(s) on clean tenants' batches during the poison "
+            f"phase")
+    for victim, mode in (iso.get("victim_modes") or {}).items():
+        if mode != "device":
+            problems.append(
+                f"{new_name}: victim tenant {victim} was knocked to "
+                f"{mode} mode by the adversary's poison batches")
+    if iso and not iso.get("repromoted", True):
+        problems.append(
+            f"{new_name}: the adversarial tenant was never re-promoted "
+            f"to device after the poison cleared")
+    if iso and not iso.get("all_bound", True):
+        problems.append(
+            f"{new_name}: pods stranded unbound after the isolation "
+            f"phase — a tenant's breaker cost another tenant progress")
+    dev = new.get("device") or {}
+    if dev.get("post_prewarm_compiles"):
+        problems.append(
+            f"{new_name}: {dev['post_prewarm_compiles']} post-prewarm "
+            f"XLA compile(s) during the tenancy run — cross-tenant "
+            f"packing minted a shape the prewarm ladder never traced")
+    base = last_same_backend(artifacts, new)
+    if base is not None:
+        prev_name, prev = base
+        prev_ratio = (prev.get("interference") or {}).get("ratio")
+        if prev_ratio and ratio and \
+                float(ratio) > float(prev_ratio) * (1.0 + tolerance):
+            problems.append(
+                f"interference ratio regressed: {new_name} {ratio} vs "
+                f"{prev_name} {prev_ratio} (tolerance "
+                f"{tolerance * 100:.0f}%)")
+        prev_err = (prev.get("fairness") or {}).get("max_rel_error")
+        if prev_err and err and \
+                float(err) > float(prev_err) * (1.0 + tolerance):
+            problems.append(
+                f"fairness error regressed: {new_name} {err} vs "
+                f"{prev_name} {prev_err} (tolerance "
+                f"{tolerance * 100:.0f}%)")
+    return problems
+
+
 def _shape_pods(parsed: dict) -> int:
     m = re.search(r"([\d,]+) pods onto", parsed.get("metric", ""))
     return int(m.group(1).replace(",", "")) if m else 30000
@@ -541,6 +662,7 @@ def main() -> int:
     problems += check_soak()
     problems += check_ha()
     problems += check_serving()
+    problems += check_tenancy()
     artifacts = committed_artifacts()
     if len(artifacts) < 2:
         print("bench ratchet: fewer than two committed BENCH artifacts; "
@@ -571,6 +693,15 @@ def main() -> int:
                   f"{(ha.get('takeover') or {}).get('takeover_settle_s')}"
                   f"s, {ha.get('double_binds')} double-binds, aggregate "
                   f"{ha.get('aggregate_steady_pods_per_s')} pods/s")
+    tn = committed_tenancy_artifacts()
+    if tn:
+        new = tn[-1][1]
+        print(f"tenancy ratchet OK: {tn[-1][0]} interference "
+              f"{(new.get('interference') or {}).get('ratio')}, "
+              f"fairness error "
+              f"{(new.get('fairness') or {}).get('max_rel_error')}, "
+              f"{(new.get('isolation') or {}).get('cross_tenant_faults')}"
+              f" cross-tenant faults")
     sv = committed_serving_artifacts()
     if sv:
         trickle = (sv[-1][1].get("workloads") or {}) \
