@@ -1,0 +1,26 @@
+"""kt-lint: AST-enforced device & concurrency discipline.
+
+The invariants the last twelve PRs bought — host fallback means NO
+device participation, readbacks go through the sanity gate, knobs are
+read once at init, locks nest in one global order, every daemon thread
+is auditable — were enforced by convention and by whichever test
+happened to exercise the path.  This package makes them machine-checked
+at tier-1 time, before any chip is touched:
+
+* :mod:`kubernetes_tpu.analysis.core` — the framework: rule registry,
+  per-line ``# ktlint: disable=RULE`` suppressions, committed baseline
+  for grandfathered findings, text/JSON output;
+* :mod:`kubernetes_tpu.analysis.rules_device` — D01..D04 (import
+  layering, readback routing, jit purity, knob discipline);
+* :mod:`kubernetes_tpu.analysis.rules_concurrency` — C01..C03 (static
+  lock-order graph + cycle detection, the locktrace runtime companion,
+  thread-start registration).
+
+Driver: ``python -m tools.ktlint`` (tests/test_ktlint.py runs it in
+tier-1 with a zero-new-findings ratchet).
+"""
+
+from kubernetes_tpu.analysis.core import (Finding, Project, RULES,  # noqa: F401
+                                          run_project)
+from kubernetes_tpu.analysis import rules_device  # noqa: F401
+from kubernetes_tpu.analysis import rules_concurrency  # noqa: F401

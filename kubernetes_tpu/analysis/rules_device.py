@@ -1,0 +1,280 @@
+"""Device-discipline rules D01..D04.
+
+The PR 10 guarantee — "forward progress with NO device participation"
+when the breaker is open — and the PR 4/PR 8 warm-path guarantees — "no
+unwarmed shapes, no env re-reads after warmup" — are structural claims
+about which modules may touch JAX, where readbacks happen, and when
+knobs are read.  These rules make each claim a parse-time fact.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kubernetes_tpu.analysis import core
+from kubernetes_tpu.analysis.core import Module, Rule
+
+# D01: the only modules allowed to import jax/jaxlib.  Everything else
+# — scheduler daemon, cache, apiserver, clients, controllers, tenancy
+# policy, the host fallback's callers — must stay importable and
+# runnable on a machine with no accelerator runtime at all.
+DEVICE_ALLOWED = (
+    "kubernetes_tpu/engine/",
+    "kubernetes_tpu/ops/",
+    "kubernetes_tpu/parallel/",
+    "kubernetes_tpu/perf/",
+    "kubernetes_tpu/utils/profiling.py",
+)
+
+_DEVICE_ROOTS = {"jax", "jaxlib"}
+
+
+def _device_allowed(path: str) -> bool:
+    return any(path.startswith(p) for p in DEVICE_ALLOWED)
+
+
+def _check_d01(module: Module) -> list:
+    if _device_allowed(module.path):
+        return []
+    out = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = alias.name.split(".")[0]
+                if root in _DEVICE_ROOTS:
+                    out.append(module.finding(
+                        "D01", node,
+                        f"import {alias.name}: device imports are "
+                        f"allowed only under "
+                        f"{', '.join(DEVICE_ALLOWED)} — the host "
+                        f"fallback guarantee is structural"))
+        elif isinstance(node, ast.ImportFrom):
+            root = (node.module or "").split(".")[0]
+            if root in _DEVICE_ROOTS:
+                out.append(module.finding(
+                    "D01", node,
+                    f"from {node.module} import ...: device imports "
+                    f"are allowed only under "
+                    f"{', '.join(DEVICE_ALLOWED)}"))
+    return out
+
+
+Rule("D01", "device imports only in the engine/ops/parallel/perf "
+     "layers", check=_check_d01,
+     doc="jax/jaxlib imports outside the allowlist break the host-"
+         "fallback guarantee (PR 10): a breaker-open daemon must make "
+         "forward progress with no device participation.")
+
+
+# D02: raw readback/sync calls outside engine internals.  Every
+# readback must flow through guard.checked_readback (sanity gate) and
+# devicestats.record_transfer (accounting); a bare device_get or
+# block_until_ready elsewhere is an unguarded, unaccounted sync point.
+_READBACK_CALLS = {"jax.device_get"}
+_READBACK_METHODS = {"block_until_ready"}
+
+
+def _check_d02(module: Module) -> list:
+    if _device_allowed(module.path):
+        return []
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = core.call_name(node)
+        if name in _READBACK_CALLS:
+            out.append(module.finding(
+                "D02", node,
+                f"raw readback {name}(): route through "
+                f"engine.guard.checked_readback / "
+                f"engine.devicestats recorded sites"))
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _READBACK_METHODS:
+            out.append(module.finding(
+                "D02", node,
+                f"raw device sync .{node.func.attr}(): route through "
+                f"engine readback sites"))
+    return out
+
+
+Rule("D02", "readbacks route through checked_readback/devicestats",
+     check=_check_d02,
+     doc="jax.device_get / .block_until_ready() outside engine/ "
+         "bypass the post-solve sanity gate and the transfer "
+         "accounting plane.")
+
+
+# D03: solve-path purity.  A function that is jitted or vmapped is
+# traced ONCE per shape signature; a wall-clock read, RNG draw, or env
+# read inside it is baked into the compiled program as a constant — the
+# bug class where behavior silently depends on trace time.
+_D03_SCOPE = ("kubernetes_tpu/engine/", "kubernetes_tpu/ops/")
+_JIT_WRAPPERS = {"jax.jit", "jit", "jax.vmap", "vmap", "pjit",
+                 "jax.pjit"}
+_IMPURE_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "random.random", "random.randint", "random.choice",
+    "random.uniform", "random.shuffle",
+    "np.random.rand", "np.random.randn", "numpy.random.rand",
+    "os.getenv", "os.environ.get", "environ.get",
+    "knobs.get", "knobs.get_int", "knobs.get_float",
+    "knobs.get_bool", "knobs.get_str",
+}
+
+
+def _jitted_function_names(tree: ast.AST) -> set[str]:
+    """Names of functions that are jit/vmap targets: decorated
+    (@jax.jit, @partial(jax.jit, ...)) or referenced as the first
+    argument of a jit/vmap call (fn = jax.jit(_impl))."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec
+                if isinstance(dec, ast.Call):
+                    name = core.call_name(dec)
+                    if name.endswith("partial") and dec.args:
+                        target = dec.args[0]
+                    else:
+                        target = dec.func
+                if core.dotted(target) in _JIT_WRAPPERS:
+                    names.add(node.name)
+        elif isinstance(node, ast.Call) and \
+                core.call_name(node) in _JIT_WRAPPERS and node.args:
+            arg = node.args[0]
+            ref = core.dotted(arg)
+            if ref:
+                names.add(ref.split(".")[-1])
+    return names
+
+
+def _check_d03(module: Module) -> list:
+    if not any(module.path.startswith(p) for p in _D03_SCOPE):
+        return []
+    jitted = _jitted_function_names(module.tree)
+    if not jitted:
+        return []
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) or \
+                node.name not in jitted:
+            continue
+        for sub in ast.walk(node):
+            impure = None
+            if isinstance(sub, ast.Call) and \
+                    core.call_name(sub) in _IMPURE_CALLS:
+                impure = f"{core.call_name(sub)}()"
+            elif isinstance(sub, ast.Subscript) and \
+                    core.dotted(sub.value) in ("os.environ", "environ"):
+                impure = "os.environ[...]"
+            if impure:
+                out.append(module.finding(
+                    "D03", sub,
+                    f"{impure} inside jitted/vmapped "
+                    f"'{node.name}': traced once per shape, the "
+                    f"value is frozen into the compiled program"))
+    return out
+
+
+Rule("D03", "no clock/RNG/env reads inside jitted function bodies",
+     check=_check_d03,
+     doc="A traced function captures host values as compile-time "
+         "constants; wall-clock, RNG, and knob reads there are "
+         "silent staleness bugs.")
+
+
+# D04: every KT_* env read goes through utils/knobs.py, against the
+# declared registry — and NO knob read (raw or via knobs) happens
+# inside a per-drain hot-path function (the PR 4 stream_min_bucket bug
+# class: a knob re-read after warmup minting unwarmed shapes).
+_ENV_GET_CALLS = {"os.environ.get", "environ.get", "os.getenv",
+                  "getenv", "_os.environ.get"}
+_KNOBS_CALLS = {"knobs.get", "knobs.get_int", "knobs.get_float",
+                "knobs.get_bool", "knobs.get_str"}
+_KNOBS_MODULE = "kubernetes_tpu/utils/knobs.py"
+
+# Functions on the per-drain path: formation -> solve -> commit.  A
+# knob read inside any of these runs once per drain (thousands/s under
+# storm) and can observe a mid-run env change the prewarm never saw.
+HOT_PATH_FUNCTIONS = {
+    "kubernetes_tpu/scheduler/scheduler.py": {
+        "schedule_pending", "_schedule_pending_stream", "schedule_one",
+        "_assume_and_bind_batch", "_bind_assumed_batch"},
+    "kubernetes_tpu/scheduler/pipeline.py": {"drain", "_solve",
+                                             "_commit"},
+    "kubernetes_tpu/scheduler/batchformer.py": {"form"},
+    "kubernetes_tpu/engine/generic_scheduler.py": {
+        "schedule_batch", "schedule_batch_stream",
+        "schedule_batch_host", "schedule", "_compile",
+        "_schedule_host"},
+    "kubernetes_tpu/engine/solver.py": {
+        "evaluate", "select_hosts", "solve_scan"},
+    "kubernetes_tpu/tenancy/packer.py": {"pack"},
+    "kubernetes_tpu/tenancy/service.py": {"submit", "solve_packed"},
+}
+
+
+def _env_read_name(node: ast.Call) -> str | None:
+    """The KT_* name read by this call, or None if not an env read."""
+    name = core.call_name(node)
+    if name in _ENV_GET_CALLS and node.args:
+        return core.const_str(node.args[0])
+    return None
+
+
+def _check_d04(module: Module) -> list:
+    from kubernetes_tpu.utils.knobs import REGISTRY
+    out = []
+    hot = HOT_PATH_FUNCTIONS.get(module.path, set())
+    in_knobs = module.path == _KNOBS_MODULE
+
+    def visit(node: ast.AST, hot_fn: str | None) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            hot_fn = node.name if node.name in hot else hot_fn
+        for child in ast.iter_child_nodes(node):
+            visit(child, hot_fn)
+        if isinstance(node, ast.Call):
+            env_name = _env_read_name(node)
+            name = core.call_name(node)
+            if env_name and env_name.startswith("KT_") and \
+                    not in_knobs:
+                out.append(module.finding(
+                    "D04", node,
+                    f"raw env read of {env_name}: use "
+                    f"utils.knobs.get_* (registry-backed, "
+                    f"tools/check_knobs.py ratchets it)"))
+            if name in _KNOBS_CALLS and node.args:
+                knob = core.const_str(node.args[0])
+                if knob is not None and knob not in REGISTRY:
+                    out.append(module.finding(
+                        "D04", node,
+                        f"knobs read of undeclared {knob}: declare "
+                        f"it in utils/knobs.py"))
+            if hot_fn and (name in _KNOBS_CALLS or env_name or
+                           name in _ENV_GET_CALLS):
+                out.append(module.finding(
+                    "D04", node,
+                    f"env/knob read inside per-drain hot path "
+                    f"'{hot_fn}': read once at daemon init (the "
+                    f"KT_STREAM_MIN_BUCKET bug class)"))
+        elif isinstance(node, ast.Subscript) and \
+                core.dotted(node.value) in ("os.environ", "environ") \
+                and not in_knobs and not isinstance(
+                    getattr(node, "ctx", None),
+                    (ast.Store, ast.Del)):
+            key = core.const_str(node.slice)
+            if key is not None and key.startswith("KT_"):
+                out.append(module.finding(
+                    "D04", node,
+                    f"raw env read of {key}: use utils.knobs.get_*"))
+
+    visit(module.tree, None)
+    return out
+
+
+Rule("D04", "KT_* knobs resolve through the utils/knobs.py registry; "
+     "no env reads on the per-drain path", check=_check_d04,
+     doc="Scattered env reads drift from docs and re-read mid-run; "
+         "the registry is the single source and hot paths read knobs "
+         "only at init.")

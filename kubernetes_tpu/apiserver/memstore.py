@@ -187,8 +187,8 @@ class MemStore:
         # rejected with the 409 the scheduler already absorbs via
         # forget + requeue — watch-lagged schedulers can no longer land
         # transient overcommit in the store.
-        self._capacity_check = os.environ.get(
-            "KT_BIND_CAPACITY", "1") not in ("", "0")
+        from kubernetes_tpu.utils import knobs
+        self._capacity_check = knobs.get_bool("KT_BIND_CAPACITY")
         self._node_used: dict[str, list] = {}  # node -> [milli, mem, pods]
         if storage_dir is not None:
             os.makedirs(storage_dir, exist_ok=True)

@@ -23,6 +23,7 @@ from typing import Callable, Optional
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.features import compiler as fc
+from kubernetes_tpu.utils import locktrace
 
 def _locked(fn):
     """Serialize public cache methods on self.lock (cache.go mutex)."""
@@ -57,7 +58,13 @@ class SchedulerCache:
         self._now = now
         # schedulerCache.mu (cache.go:60): the daemon's async bind threads
         # forget failed binds while the scheduling loop assumes new batches.
-        self.lock = threading.RLock()
+        # Named so KT_LOCKTRACE=1 puts it on the lock-order graph.
+        # hold_ms=0: the drain holds this lock across the whole batch
+        # snapshot/compile BY DESIGN (the snapshot must be consistent
+        # against concurrent assumes), so its hold time is the compile
+        # stage span, not a long-hold bug; order tracking stays on.
+        self.lock = locktrace.make_rlock("cache.SchedulerCache",
+                                         hold_ms=0)
         self._nodes: dict[str, api.Node] = {}
         self._node_order: list[str] = []
         self._pod_states: dict[str, _PodState] = {}

@@ -39,7 +39,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from kubernetes_tpu.api import types as api
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import metrics, threadreg
 from kubernetes_tpu.utils.logging import get_logger
 
 log = get_logger("verifier")
@@ -272,10 +272,7 @@ class Verifier:
                     self.verify_once()
                 except Exception:  # noqa: BLE001 — verifier never kills
                     log.exception("verifier pass crashed; continuing")
-        t = threading.Thread(target=loop, daemon=True,
-                             name="cache-verifier")
-        t.start()
-        return t
+        return threadreg.spawn(loop, name="cache-verifier")
 
     def stop(self) -> None:
         self._stop.set()

@@ -250,8 +250,8 @@ def active() -> DeviceChaos | None:
     global _active, _env_checked
     if _active is None and not _env_checked:
         _env_checked = True
-        import os
-        spec = os.environ.get("KT_CHAOS_DEVICE", "")
+        from kubernetes_tpu.utils import knobs
+        spec = knobs.get("KT_CHAOS_DEVICE")
         if spec:
             _active = DeviceChaos(parse_spec(spec))
     return _active

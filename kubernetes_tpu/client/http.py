@@ -27,7 +27,7 @@ from typing import Callable, Optional
 from kubernetes_tpu.api.types import NAMESPACED_KINDS
 from kubernetes_tpu.apiserver.memstore import (ConflictError, Event,
                                                TooOldError)
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import knobs, metrics, threadreg
 from kubernetes_tpu.utils import trace
 from kubernetes_tpu.utils.flowcontrol import TokenBucketRateLimiter
 
@@ -378,7 +378,7 @@ class APIClient:
     # concurrent in-flight chunk POSTs, each on its own per-thread
     # keep-alive connection.
     BIND_CHUNK = 4096
-    BIND_PIPELINE = int(os.environ.get("KT_BIND_PIPELINE", "4") or "4")
+    BIND_PIPELINE = knobs.get_int("KT_BIND_PIPELINE")
 
     def bind_list(self, bindings: list[tuple[str, str, str]],
                   chunk_size: Optional[int] = None
@@ -524,9 +524,8 @@ class HTTPWatcher:
                 raise TooOldError(body)
             raise APIError(resp.status, body)
         self._resp = resp
-        self._thread = threading.Thread(target=self._pump, daemon=True,
-                                        name=f"watch-{kind}")
-        self._thread.start()
+        self._thread = threadreg.spawn(self._pump, name=f"watch-{kind}",
+                                       transient=True)
 
     def _pump(self) -> None:
         # Decode fast path: bulk read1() into ONE reused bytearray and

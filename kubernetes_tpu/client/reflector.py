@@ -21,7 +21,7 @@ from typing import Callable, Optional
 
 from kubernetes_tpu.api import fieldsel
 from kubernetes_tpu.apiserver.memstore import MemStore, TooOldError
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import metrics, threadreg
 
 Handler = Callable[[str, dict], None]
 
@@ -151,10 +151,7 @@ class Reflector:
                 elif not self._stop.is_set():
                     self._stop.wait(backoff * random.uniform(0.5, 1.5))
                     backoff = min(backoff * 2, RELIST_BACKOFF_MAX)
-        t = threading.Thread(target=loop, daemon=True,
-                             name=f"reflector-{self.kind}")
-        t.start()
-        return t
+        return threadreg.spawn(loop, name=f"reflector-{self.kind}")
 
     def wait_for_sync(self, timeout: float = 10.0) -> bool:
         return self._synced.wait(timeout)

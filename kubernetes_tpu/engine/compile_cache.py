@@ -49,7 +49,8 @@ def configure() -> Optional[str]:
         if _configured:
             return _dir
         _configured = True
-        raw = os.environ.get("KT_COMPILE_CACHE", "").strip()
+        from kubernetes_tpu.utils import knobs
+        raw = knobs.get("KT_COMPILE_CACHE")
         if raw.lower() in _DISABLED_VALUES:
             return None
         path = raw or DEFAULT_CACHE_DIR

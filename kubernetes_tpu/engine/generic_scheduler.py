@@ -164,6 +164,11 @@ class GenericScheduler:
         # Spread-constraint term tables for the batch _compile last saw
         # (None = no pod carried topologySpreadConstraints).
         self._topo_terms = None
+        # Stream-path debug prints, read ONCE at engine init: the old
+        # per-drain env read ran twice per streamed drain (a ktlint D04
+        # hot-path finding — the KT_STREAM_MIN_BUCKET bug class).
+        from kubernetes_tpu.utils import knobs
+        self._stream_debug = knobs.get_bool("KT_STREAM_DEBUG")
 
     def _pinned_flags(self, batch) -> sv.BatchFlags:
         """Content flags OR-ed monotonically (padcap's discipline for the
@@ -807,7 +812,7 @@ class GenericScheduler:
                                                    dc.topo_dom)
             topo_mask_np = None if tmask is None else np.asarray(tmask)
             topo_score_np = None if tscore is None else np.asarray(tscore)
-        if os.environ.get("KT_STREAM_DEBUG") == "1":
+        if self._stream_debug:
             shapes = {f: tuple(getattr(hb, f).shape)
                       for f in ("sel_required", "spread_node_counts",
                                 "avoid_rows")}
@@ -818,7 +823,7 @@ class GenericScheduler:
                            for f in ("pd_pod_ebs", "pd_pod_gce", "vz_mask",
                                      "sa_mask", "saa_cnt",
                                      "nl_prio_rows")})
-            print(f"KT_STREAM compile({len(all_pods)} pods): "
+            print(f"stream-debug compile({len(all_pods)} pods): "
                   f"{time.perf_counter() - t_c0:.3f}s flags={tuple(flags)} "
                   f"shapes={shapes}", file=sys.stderr)
         n = dc.alloc.shape[0]
@@ -849,7 +854,7 @@ class GenericScheduler:
             return chunk_pods, placements
 
         from kubernetes_tpu.utils.profiling import device_trace
-        debug_t = os.environ.get("KT_STREAM_DEBUG") == "1"
+        debug_t = self._stream_debug
         for start in range(0, padded, chunk_size):
             t0 = time.perf_counter() if debug_t else 0.0
             # Host-slice (free numpy views), then one batched device_put of
@@ -883,7 +888,7 @@ class GenericScheduler:
                 else:
                     yield emit(s_k, c_k)
             if debug_t:
-                print(f"KT_STREAM chunk@{start}: put+launch "
+                print(f"stream-debug chunk@{start}: put+launch "
                       f"{t1 - t0:.3f}s emit {time.perf_counter() - t1:.3f}s",
                       file=sys.stderr)
         for start, choices_k in pending:

@@ -54,7 +54,7 @@ import time
 import numpy as np
 
 from kubernetes_tpu.chaos import device as chaos_device
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import knobs, locktrace, metrics
 from kubernetes_tpu.utils.logging import get_logger
 
 log = get_logger("guard")
@@ -138,26 +138,21 @@ class DeviceGuard:
     worker, and the single-pod path all cross it."""
 
     def __init__(self, evict_fn=None, ladder_fn=None):
-        self.enabled = os.environ.get("KT_GUARD", "1") not in ("", "0")
+        self.enabled = knobs.get_bool("KT_GUARD")
         # Consecutive same-kind faults before the breaker trips to host.
-        self.breaker_threshold = int(os.environ.get(
-            "KT_GUARD_BREAKER", "3") or "3")
+        self.breaker_threshold = knobs.get_int("KT_GUARD_BREAKER")
         # Seconds between device probe solves while the breaker is open.
-        self.probe_period_s = float(os.environ.get(
-            "KT_GUARD_PROBE_S", "15") or "15")
+        self.probe_period_s = knobs.get_float("KT_GUARD_PROBE_S")
         # Bound on recovery rounds per drain (each round re-solves only
         # the still-uncommitted pods, so progress is monotone anyway).
-        self.max_rounds = int(os.environ.get(
-            "KT_GUARD_ROUNDS", "6") or "6")
+        self.max_rounds = knobs.get_int("KT_GUARD_ROUNDS")
         # Device-healthy drains before a bisected bucket cap resets.
-        self.cap_reset_streak = int(os.environ.get(
-            "KT_GUARD_CAP_RESET", "4") or "4")
+        self.cap_reset_streak = knobs.get_int("KT_GUARD_CAP_RESET")
         # Proactive HBM ceiling in bytes (0 = off).
-        self.hbm_watermark = int(float(os.environ.get(
-            "KT_HBM_WATERMARK", "0") or "0"))
+        self.hbm_watermark = knobs.get_int("KT_HBM_WATERMARK")
         self.evict_fn = evict_fn
         self.ladder_fn = ladder_fn or (lambda: [])
-        self._lock = threading.Lock()
+        self._lock = locktrace.make_lock("engine.DeviceGuard")
         self._mode = "device"
         self._consecutive: dict[str, int] = {}
         self._bucket_cap: int | None = None

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubernetes_tpu.utils import knobs
 from kubernetes_tpu.api.policy import (DEFAULT_MAX_EBS_VOLUMES,
                                        DEFAULT_MAX_GCE_PD_VOLUMES, Policy,
                                        canonical_predicate_name,
@@ -70,7 +71,7 @@ PASSTHROUGH_PRIORITIES = ()
 # unroll=4 runs the scan ~1.2x faster than unroll=1 (705 -> 605 ms) by
 # amortizing loop control and xs slicing.  Compile time scales with the
 # factor; 4 is the knee.
-SCAN_UNROLL = int(os.environ.get("KT_SCAN_UNROLL", "4") or "4")
+SCAN_UNROLL = knobs.get_int("KT_SCAN_UNROLL")
 
 
 class DeviceAffinity(NamedTuple):

@@ -40,10 +40,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_tpu.features.compiler import RES_PODS
+from kubernetes_tpu.utils import knobs
 
 # Victim-table width: victims per node considered, padded pow2.  Bounds
 # both the kernel shape and the blast radius of one decision.
-MAX_VICTIMS = int(os.environ.get("KT_PREEMPT_MAX_VICTIMS", "16") or "16")
+MAX_VICTIMS = knobs.get_int("KT_PREEMPT_MAX_VICTIMS")
 
 
 class VictimTable(NamedTuple):

@@ -296,7 +296,8 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
     # compiled Go apiserver, and the Python server's GIL was the measured
     # wire ceiling.  KT_NATIVE_APISERVER=0 forces the Python server.
     server_cmd = None
-    if _os.environ.get("KT_NATIVE_APISERVER", "1") != "0":
+    from kubernetes_tpu.utils import knobs
+    if knobs.get_bool("KT_NATIVE_APISERVER"):
         from kubernetes_tpu.apiserver.native import native_binary
         binary = native_binary()
         if binary is not None:
@@ -366,16 +367,16 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         # covering the whole queue.  Measured r5: 4,700 -> 6,300 pods/s
         # over the 4096-chunk pipeline at 30k/5k.  KT_WIRE_CHUNK /
         # KT_WIRE_ACCUM expose the space for measurement.
-        daemon.stream_chunk = int(_os.environ.get(
-            "KT_WIRE_CHUNK", str((num_pods + 2047) // 2048 * 2048)))
+        daemon.stream_chunk = knobs.get_int(
+            "KT_WIRE_CHUNK", default=(num_pods + 2047) // 2048 * 2048)
         # Coalesce the arrival race into full chunks through the batch
         # former's deadline (scheduler/batchformer.py): a trickle-fed
         # drain otherwise pays a full padded scan (plus per-launch tunnel
         # overhead) for every fragment the creators happen to land.  The
         # former exits early once arrivals go idle, so the deadline is a
         # ceiling, not a tax.
-        daemon.pipeline.former.deadline_s = float(
-            _os.environ.get("KT_WIRE_ACCUM", "3.0"))
+        daemon.pipeline.former.deadline_s = \
+            knobs.get_float("KT_WIRE_ACCUM")
         # Start the adaptive target at the wire chunk: this rig WANTS
         # whole-burst accumulation (one launch beats chunking on a
         # tunneled chip), not the serving default of growing up from

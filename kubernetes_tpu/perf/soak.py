@@ -61,7 +61,7 @@ from kubernetes_tpu.chaos import (BindMonitor, ChaosProxy, DeviceChaos,
 from kubernetes_tpu.chaos import device as chaos_device
 from kubernetes_tpu.client.http import APIClient
 from kubernetes_tpu.scheduler.backoff import PodBackoff
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import knobs, locktrace, metrics
 
 
 def _labeled_snapshot(counter) -> dict[str, int]:
@@ -222,10 +222,18 @@ def run_soak(n_nodes: int = 2000, duration_s: float = 60.0,
     sampler = _QueueSampler()
     saved_env = {k: os.environ.get(k)
                  for k in ("KT_PREWARM", "KT_VERIFY_PERIOD",
-                           "KT_RECOVERY", "KT_GUARD_PROBE_S")}
+                           "KT_RECOVERY", "KT_GUARD_PROBE_S",
+                           "KT_LOCKTRACE")}
     os.environ["KT_PREWARM"] = "1"
     os.environ["KT_VERIFY_PERIOD"] = str(verify_period)
     os.environ["KT_RECOVERY"] = "1"
+    # Every chaos run doubles as a race/deadlock detector: the daemon's
+    # graph-tracked locks (cache, tenancy, shards, SLO, rings) are
+    # minted traced, and the artifact's locktrace columns are ratcheted
+    # to zero by check_soak.
+    os.environ["KT_LOCKTRACE"] = "1"
+    locktrace.set_enabled(True)
+    lock_counts0 = locktrace.report()
     # Fast device probes: the device-lost wave must demonstrate the
     # full breaker arc (host fallback -> probe -> re-promotion) inside
     # the scenario window.
@@ -536,6 +544,16 @@ def run_soak(n_nodes: int = 2000, duration_s: float = 60.0,
                                            _stage_snapshot())
         report["chaos"]["injected"] = proxy.stats()["injected"]
         report["heartbeats_sent"] = hb_sent[0]
+        lock_rep = locktrace.report()
+        report["locktrace"] = {
+            "lock_inversions": lock_rep["lock_inversions"] -
+            lock_counts0["lock_inversions"],
+            "long_holds": lock_rep["long_holds"] -
+            lock_counts0["long_holds"],
+            "acquires": lock_rep["acquires"] - lock_counts0["acquires"],
+            "inversion_detail": lock_rep["inversion_detail"],
+            "long_hold_detail": lock_rep["long_hold_detail"],
+        }
         report["duration_s"] = round(time.monotonic() - t_start, 1)
         report["scale"].update({
             "pods_created_total": created_total[0],
@@ -563,6 +581,7 @@ def run_soak(n_nodes: int = 2000, duration_s: float = 60.0,
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+        locktrace.set_enabled(knobs.get_bool("KT_LOCKTRACE"))
 
 
 def run_ha_wave(n_nodes: int = 800, n_shards: int = 8,
@@ -630,10 +649,16 @@ def run_ha_wave(n_nodes: int = 800, n_shards: int = 8,
         "KT_BATCH_DEADLINE_MS": "100",
         "KT_POD_BACKOFF_S": "0.1", "KT_POD_BACKOFF_MAX_S": "2",
         "KT_STREAM_CHUNK": str(stream_chunk),
+        # Race/deadlock detection rides the storm: every incarnation's
+        # graph-tracked locks are traced, and the wave's inversion/
+        # long-hold counts (scraped from the survivors' /metrics) land
+        # in the artifact's locktrace columns, ratcheted to zero.
+        "KT_LOCKTRACE": "1",
     }
     conflicts_before = metrics.CROSS_SHARD_CONFLICTS.value
     handoffs_before = metrics.SHARD_LEASE_HANDOFFS.value
     violations_before = metrics.CACHE_INVARIANT_VIOLATIONS.value
+    lock_counts0 = locktrace.report()
 
     for i in range(0, n_nodes, 1000):
         direct.create_list("nodes", [
@@ -827,6 +852,7 @@ def run_ha_wave(n_nodes: int = 800, n_shards: int = 8,
         if not processes:
             saved_env = {k: os.environ.get(k) for k in ha_env}
             os.environ.update(ha_env)
+            locktrace.set_enabled(True)
 
         # -- Phase 0: ONE incarnation, the whole keyspace — the same-
         # rig, same-chaos single-scheduler control that the aggregate
@@ -965,6 +991,7 @@ def run_ha_wave(n_nodes: int = 800, n_shards: int = 8,
                        if not (o.get("spec") or {}).get("nodeName"))
         if processes:
             conflicts = handoffs = violations = 0.0
+            lock_inversions = long_holds = 0.0
             recoveries = []
             for name, child, port, _lp in children[1:]:
                 try:
@@ -978,6 +1005,10 @@ def run_ha_wave(n_nodes: int = 800, n_shards: int = 8,
                     violations += _metric_sum(
                         text, "scheduler_cache_invariant_violations_"
                               "total")
+                    lock_inversions += _metric_sum(
+                        text, "scheduler_lock_inversions_total")
+                    long_holds += _metric_sum(
+                        text, "scheduler_lock_long_holds_total")
                     dv = _json.loads(_scrape(port, "/debug/vars"))
                     recoveries += [r for r in
                                    dv.get("shardRecoveries") or []
@@ -992,9 +1023,18 @@ def run_ha_wave(n_nodes: int = 800, n_shards: int = 8,
                 handoffs_before
             violations = metrics.CACHE_INVARIANT_VIOLATIONS.value - \
                 violations_before
+            lock_rep = locktrace.report()
+            lock_inversions = lock_rep["lock_inversions"] - \
+                lock_counts0["lock_inversions"]
+            long_holds = lock_rep["long_holds"] - \
+                lock_counts0["long_holds"]
             report["takeover"]["shard_recoveries"] = [
                 r for f in factories[1:] for r in f.shard_recoveries
                 if r.get("handoff")][-12:]
+        report["locktrace"] = {
+            "lock_inversions": int(lock_inversions),
+            "long_holds": int(long_holds),
+        }
         report.update({
             "pods_created": created[0],
             "pods_bound": monitor.binds,
@@ -1033,6 +1073,8 @@ def run_ha_wave(n_nodes: int = 800, n_shards: int = 8,
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+        if saved_env:
+            locktrace.set_enabled(knobs.get_bool("KT_LOCKTRACE"))
 
 
 def run_capacity_wave(n_nodes: int = 16, pods_per_node: int = 10,
@@ -1150,6 +1192,116 @@ def run_capacity_wave(n_nodes: int = 16, pods_per_node: int = 10,
     return out
 
 
+def run_tenancy_poison_wave(n_nodes: int = 60, pods_per_tenant: int = 150,
+                            quiet: bool = False) -> dict:
+    """The tenancy poison wave under KT_LOCKTRACE=1: an embedded
+    multi-tenant SolverService (packed submits racing the daemon's own
+    drain across the engine_lock / pending / state locks, PR 12's
+    hairiest concurrency surface) while an adversarial tenant's
+    poison batches trip its per-tenant breaker — exactly the
+    interleavings a lock-order bug would need.  The wave asserts the
+    PR 12 isolation contract still converges and returns locktrace's
+    inversion/long-hold counts for the artifact's ratcheted columns."""
+    from kubernetes_tpu.apiserver.server import serve
+    from kubernetes_tpu.scheduler.factory import ConfigFactory
+    tenants = ("lt-a", "lt-b", "lt-c")
+    saved_env = {k: os.environ.get(k)
+                 for k in ("KT_TENANTS", "KT_TENANT_WEIGHTS",
+                           "KT_TENANT_BREAKER", "KT_TENANT_PROBE_S",
+                           "KT_LOCKTRACE", "KT_POD_BACKOFF_S",
+                           "KT_POD_BACKOFF_MAX_S")}
+    os.environ.update({
+        "KT_TENANTS": ",".join(tenants),
+        "KT_TENANT_WEIGHTS": "lt-a:2,lt-b:1,lt-c:1",
+        "KT_TENANT_BREAKER": "2",
+        "KT_TENANT_PROBE_S": "0.5",
+        "KT_LOCKTRACE": "1",
+        "KT_POD_BACKOFF_S": "0.1",
+        "KT_POD_BACKOFF_MAX_S": "1",
+    })
+    locktrace.set_enabled(True)
+    lock_counts0 = locktrace.report()
+    store = MemStore()
+    api_srv = serve(store)
+    api_url = f"http://127.0.0.1:{api_srv.server_address[1]}"
+    direct = APIClient(api_url, qps=0)
+    direct.create_list("nodes", [_node_json(f"lt-{i:03d}")
+                                 for i in range(n_nodes)])
+    chaos = DeviceChaos([DeviceRule(fault="corrupt", every_nth=1,
+                                    count=3, tenant="lt-c")])
+    factory = None
+    try:
+        chaos_device.install(chaos)
+        factory = ConfigFactory(api_url, qps=5000, burst=5000)
+        factory.run()
+        svc = factory.tenancy
+        offered = 0
+        for tenant in tenants:
+            objs = []
+            for i in range(pods_per_tenant):
+                obj = _pod_json(f"lp-{tenant}-{i:04d}")
+                obj["metadata"]["namespace"] = tenant
+                objs.append(obj)
+            direct.create_list("pods", objs)
+            offered += len(objs)
+        deadline = time.time() + 120
+        bound = 0
+        while time.time() < deadline:
+            bound = sum(1 for o in store.list("pods")[0]
+                        if (o.get("spec") or {}).get("nodeName"))
+            if bound >= offered:
+                break
+            time.sleep(0.1)
+        # Poison exhausted (count=3): drive probe traffic until the
+        # poisoned tenant re-promotes to the device.
+        chaos_device.install(None)
+        probe_i = 0
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                svc is not None and svc.tenant_mode("lt-c") != "device":
+            obj = _pod_json(f"lp-probe-{probe_i:03d}")
+            obj["metadata"]["namespace"] = "lt-c"
+            direct.create("pods", obj)
+            probe_i += 1
+            time.sleep(0.4)
+        lock_rep = locktrace.report()
+        out = {
+            "tenants": list(tenants),
+            "offered": offered,
+            "bound": bound,
+            "poisoned_tenant": "lt-c",
+            "repromoted": svc is not None and
+            svc.tenant_mode("lt-c") == "device",
+            "lock_inversions": lock_rep["lock_inversions"] -
+            lock_counts0["lock_inversions"],
+            "long_holds": lock_rep["long_holds"] -
+            lock_counts0["long_holds"],
+            "acquires": lock_rep["acquires"] -
+            lock_counts0["acquires"],
+        }
+        if not quiet:
+            print(f"tenancy poison wave: {bound}/{offered} bound, "
+                  f"repromoted={out['repromoted']}, "
+                  f"{out['lock_inversions']} inversions / "
+                  f"{out['long_holds']} long holds over "
+                  f"{out['acquires']} traced acquires", file=sys.stderr)
+        return out
+    finally:
+        chaos_device.install(None)
+        if factory is not None:
+            try:
+                factory.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        api_srv.shutdown()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        locktrace.set_enabled(knobs.get_bool("KT_LOCKTRACE"))
+
+
 def _reconcile(store: MemStore, factory, monitor: _BindMonitor) -> dict:
     """Post-soak apiserver-vs-oracle reconciliation: the acceptance
     invariants a mid-drain kill must not break."""
@@ -1251,6 +1403,35 @@ def collect(ha: bool = True, **kw) -> dict:
         # under deliberate overcommit probes; the ratchet pins
         # overcommitted_nodes == 0 and stranded_pending == 0.
         rec["capacity"] = run_capacity_wave(quiet=kw.get("quiet", False))
+    if os.environ.get("BENCH_SOAK_TENANCY_POISON", "1") != "0":
+        rec["tenancy_poison"] = run_tenancy_poison_wave(
+            quiet=kw.get("quiet", False))
+    # The artifact-level locktrace columns check_soak ratchets to zero:
+    # the main churn run + the HA wave (scraped from the survivor
+    # processes) + the tenancy poison wave, all under KT_LOCKTRACE=1.
+    main_lt = rec.get("locktrace") or {}
+    ha_lt = (rec.get("ha") or {}).get("locktrace") or {}
+    tp = rec.get("tenancy_poison") or {}
+    rec["locktrace"] = {
+        "lock_inversions": int(main_lt.get("lock_inversions", 0)) +
+        int(ha_lt.get("lock_inversions", 0)) +
+        int(tp.get("lock_inversions", 0)),
+        "long_holds": int(main_lt.get("long_holds", 0)) +
+        int(ha_lt.get("long_holds", 0)) +
+        int(tp.get("long_holds", 0)),
+        "waves": {
+            "soak": {k: v for k, v in main_lt.items()
+                     if k in ("lock_inversions", "long_holds",
+                              "acquires")},
+            "ha": dict(ha_lt),
+            "tenancy_poison": {
+                k: tp.get(k, 0)
+                for k in ("lock_inversions", "long_holds",
+                          "acquires")},
+        },
+        "inversion_detail": main_lt.get("inversion_detail", []),
+        "long_hold_detail": main_lt.get("long_hold_detail", []),
+    }
     return rec
 
 

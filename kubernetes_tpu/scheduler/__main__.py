@@ -22,6 +22,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.utils import threadreg
 from kubernetes_tpu.api.policy import (cluster_autoscaler_provider,
                                        default_provider, policy_from_json)
 from kubernetes_tpu.scheduler.factory import ConfigFactory
@@ -318,8 +319,7 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
             self._send(*solve_route(factory.tenancy, body))
 
     server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-    threading.Thread(target=server.serve_forever, daemon=True,
-                     name="scheduler-status-http").start()
+    threadreg.spawn(server.serve_forever, name="scheduler-status-http")
     return server
 
 

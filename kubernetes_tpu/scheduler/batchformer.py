@@ -51,11 +51,11 @@ to the commit worker, which closes the loop with
 
 from __future__ import annotations
 
-import os
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from kubernetes_tpu.utils import knobs
 from kubernetes_tpu.utils import metrics as metrics_mod
 from kubernetes_tpu.utils.logging import get_logger
 
@@ -81,14 +81,14 @@ def _env_deadline_s() -> float:
     former (the daemon-lifetime discipline every other knob follows).
     ``KT_BATCH_DEADLINE_MS`` wins; ``KT_COALESCE`` (seconds) is the
     deprecated alias for rigs predating the former."""
-    raw = os.environ.get("KT_BATCH_DEADLINE_MS", "").strip()
+    raw = knobs.get("KT_BATCH_DEADLINE_MS")
     if raw:
         try:
             return max(float(raw), 0.0) / 1e3
         except ValueError:
             log.warning("bad KT_BATCH_DEADLINE_MS=%r; deadline off", raw)
             return 0.0
-    legacy = os.environ.get("KT_COALESCE", "").strip()
+    legacy = knobs.get("KT_COALESCE")
     if legacy:
         try:
             val = max(float(legacy), 0.0)

@@ -31,6 +31,7 @@ from kubernetes_tpu.client.reflector import Reflector
 from kubernetes_tpu.engine.generic_scheduler import GenericScheduler, Listers
 from kubernetes_tpu.scheduler.binder import APIClientBinder
 from kubernetes_tpu.scheduler.scheduler import Scheduler, SchedulerConfig
+from kubernetes_tpu.utils import threadreg
 from kubernetes_tpu.utils.events import EventRecorder
 from kubernetes_tpu.utils.logging import get_logger
 
@@ -173,10 +174,11 @@ class ConfigFactory:
         # scheduling only pods in shards whose lease it holds.  0 (the
         # default) is the single-scheduler mode, byte-for-byte the old
         # behavior.
-        import os
         import uuid
+
+        from kubernetes_tpu.utils import knobs
         if ha_shards is None:
-            ha_shards = int(os.environ.get("KT_HA_SHARDS", "0") or "0")
+            ha_shards = knobs.get_int("KT_HA_SHARDS")
         self.shards = None
         # Bounded log of shard-takeover reconciles (served on
         # /debug/vars next to lastRecovery).
@@ -200,9 +202,9 @@ class ConfigFactory:
         if ha_shards > 0:
             from kubernetes_tpu.scheduler.shards import ShardManager
             incarnation = incarnation or \
-                os.environ.get("KT_INCARNATION", "") or \
+                knobs.get("KT_INCARNATION") or \
                 f"scheduler-{uuid.uuid4().hex[:8]}"
-            lease_s = float(os.environ.get("KT_HA_LEASE_S", "3.0"))
+            lease_s = knobs.get_float("KT_HA_LEASE_S")
             # Lease clients must not compete with the drain loop for the
             # main client's rate budget: a QPS-starved renew loses a
             # healthy incarnation its shards mid-storm.
@@ -212,10 +214,10 @@ class ConfigFactory:
                 lease_client, incarnation=incarnation,
                 n_shards=ha_shards,
                 lease_duration=lease_s,
-                renew_deadline=float(os.environ.get(
-                    "KT_HA_RENEW_S", str(lease_s * 2 / 3))),
-                retry_period=float(os.environ.get(
-                    "KT_HA_RETRY_S", str(lease_s / 6))),
+                renew_deadline=knobs.get_float(
+                    "KT_HA_RENEW_S", default=lease_s * 2 / 3),
+                retry_period=knobs.get_float(
+                    "KT_HA_RETRY_S", default=lease_s / 6),
                 on_acquired=self._on_shard_acquired,
                 on_lost=self._on_shard_lost)
             self.daemon.owns_pod = self.shards.owns_pod
@@ -482,8 +484,8 @@ class ConfigFactory:
             r.wait_for_sync()
         log.info("reflectors synced (%d nodes cached); starting loop",
                  len(self.algorithm.cache.nodes()))
-        import os
-        if os.environ.get("KT_PREWARM", "0") not in ("", "0"):
+        from kubernetes_tpu.utils import knobs
+        if knobs.get_bool("KT_PREWARM"):
             # Trace the bucket ladder before the queue opens (opt-in:
             # interactive rigs keep their startup latency; the perf rigs
             # and production daemons set KT_PREWARM=1 and, with the
@@ -500,7 +502,7 @@ class ConfigFactory:
                                    namespace=t)
                            for i, t in enumerate(self.tenancy.tenants)]
             self.daemon.prewarm(sample_pods=samples)
-        if os.environ.get("KT_RECOVERY", "1") not in ("", "0"):
+        if knobs.get_bool("KT_RECOVERY"):
             # Crash-safe restart: reconcile cache + queue against one
             # apiserver relist (re-adopt bound pods, requeue orphans,
             # expire stale assumes, re-seed the resident tensors) BEFORE
@@ -509,14 +511,13 @@ class ConfigFactory:
             self.last_recovery = recovery.reconcile(
                 self.daemon, self.store,
                 scheduler_name=self.daemon.config.scheduler_name)
-        slo_period = float(os.environ.get("KT_SLO_PERIOD", "5") or "0")
+        slo_period = knobs.get_float("KT_SLO_PERIOD")
         if slo_period > 0:
             # Multi-window SLO burn: one cheap bucket read per tick
             # feeding scheduler_slo_burn_rate{window=} and the budget
             # gauge (scheduler/slo.py).
             self._threads.append(self.slo.run(period=slo_period))
-        verify_period = float(os.environ.get("KT_VERIFY_PERIOD", "0")
-                              or "0")
+        verify_period = knobs.get_float("KT_VERIFY_PERIOD")
         if verify_period > 0:
             # Resident-state invariant checker (cache/verifier.py): a
             # low-frequency background cross-check of cache aggregates vs
@@ -534,26 +535,20 @@ class ConfigFactory:
             # sees pods in shards this incarnation actually holds.
             self.shards.run()
             self._threads.extend(self.shards.threads)
-            sweep_s = float(os.environ.get("KT_HA_SWEEP_S", "10")
-                            or "0")
-            stale_assume_s = float(os.environ.get(
-                "KT_HA_STALE_ASSUME_S", "3") or "3")
+            sweep_s = knobs.get_float("KT_HA_SWEEP_S")
+            stale_assume_s = knobs.get_float("KT_HA_STALE_ASSUME_S")
             if sweep_s > 0:
-                t = threading.Thread(target=self._shard_sweep_loop,
-                                     args=(sweep_s, stale_assume_s),
-                                     daemon=True,
-                                     name="shard-ownership-sweep")
-                t.start()
-                self._threads.append(t)
+                self._threads.append(threadreg.spawn(
+                    self._shard_sweep_loop,
+                    args=(sweep_s, stale_assume_s),
+                    name="shard-ownership-sweep"))
         self._threads.append(self.daemon.run(batched=self.batched))
 
         def ttl_sweep():  # cleanupAssumedPods (cache.go:309-330)
             while not self._stop.wait(CLEANUP_PERIOD):
                 self.algorithm.cache.cleanup_expired()
-        t = threading.Thread(target=ttl_sweep, daemon=True,
-                             name="assume-ttl-sweep")
-        t.start()
-        self._threads.append(t)
+        self._threads.append(threadreg.spawn(ttl_sweep,
+                                             name="assume-ttl-sweep"))
         return self
 
     def stop(self) -> None:

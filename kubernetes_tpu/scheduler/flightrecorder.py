@@ -25,6 +25,8 @@ import threading
 import time
 from collections import deque
 
+from kubernetes_tpu.utils import knobs, locktrace
+
 # Ring capacity in BATCHES (a batch may be one pod or thirty thousand).
 DEFAULT_CAPACITY = 64
 # The ring's on-disk form under KT_FLIGHT_DIR: dumped on graceful
@@ -77,10 +79,10 @@ class FlightRecorder:
         continue past the reloaded maximum so restart records never
         collide with pre-restart ones."""
         self._ring: deque[BatchRecord] = deque(maxlen=max(1, capacity))
-        self._lock = threading.Lock()
+        self._lock = locktrace.make_lock("scheduler.FlightRecorder")
         self._seq = itertools.count(1)
         if flight_dir is None:
-            flight_dir = os.environ.get("KT_FLIGHT_DIR", "")
+            flight_dir = knobs.get("KT_FLIGHT_DIR")
         if flight_dir:
             try:
                 self.load(flight_dir)

@@ -38,13 +38,13 @@ memory growth.
 from __future__ import annotations
 
 import heapq
-import os
 import time
 from typing import Optional
 
 import threading
 
 from kubernetes_tpu.api import types as api
+from kubernetes_tpu.utils import knobs
 
 # Degradation threshold default: far above any healthy backlog (the 30k
 # density burst fits with headroom) but low enough that a runaway storm
@@ -63,9 +63,7 @@ class FIFO:
         # Load-shedding threshold, read once at construction (the daemon's
         # whole-lifetime discipline, like the stream floor): 0 disables.
         if high_watermark is None:
-            high_watermark = int(os.environ.get(
-                "KT_QUEUE_HIGH_WATERMARK",
-                str(DEFAULT_HIGH_WATERMARK)) or str(DEFAULT_HIGH_WATERMARK))
+            high_watermark = knobs.get_int("KT_QUEUE_HIGH_WATERMARK")
         self.high_watermark = high_watermark
         # Churn observability: deepest backlog ever seen (soak artifact).
         self.peak_depth = 0

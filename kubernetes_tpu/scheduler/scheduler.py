@@ -35,6 +35,7 @@ from kubernetes_tpu.scheduler.batchformer import first_seen
 from kubernetes_tpu.scheduler.binder import Binder, BindConflict, InMemoryBinder
 from kubernetes_tpu.scheduler.flightrecorder import FlightRecorder
 from kubernetes_tpu.scheduler.queue import FIFO
+from kubernetes_tpu.utils import knobs, threadreg
 from kubernetes_tpu.utils import metrics as metrics_mod
 from kubernetes_tpu.utils import trace as trace_mod
 from kubernetes_tpu.utils.events import EventRecorder
@@ -85,24 +86,20 @@ class Scheduler:
         # --pod-backoff knobs): chaos/soak rigs and latency-sensitive
         # fleets compress it; the defaults are the reference's 1s -> 60s.
         self.backoff = PodBackoff(
-            default_duration=float(os.environ.get(
-                "KT_POD_BACKOFF_S", "1") or "1"),
-            max_duration=float(os.environ.get(
-                "KT_POD_BACKOFF_MAX_S", "60") or "60"))
+            default_duration=knobs.get_float("KT_POD_BACKOFF_S"),
+            max_duration=knobs.get_float("KT_POD_BACKOFF_MAX_S"))
         # Stream floor, read ONCE at startup: the pre-warm pass and the
         # small-drain bucket computation must agree on the ladder for the
         # daemon's whole lifetime (a later env change would mint shapes
         # the warmup never traced).
-        self.stream_min_bucket = int(os.environ.get(
-            "KT_STREAM_MIN_BUCKET", str(self.STREAM_MIN_BUCKET))
-            or str(self.STREAM_MIN_BUCKET))
+        self.stream_min_bucket = knobs.get_int(
+            "KT_STREAM_MIN_BUCKET", default=self.STREAM_MIN_BUCKET)
         # Overlapped solve/bind pipeline: while the device scans chunk N,
         # chunk N-1's readback/assume/bind runs on a dedicated commit
         # worker; at most this many chunks are in flight uncommitted
         # (0 = commit synchronously on the drain thread, the pre-pipeline
         # behavior).
-        self.pipeline_window = int(os.environ.get(
-            "KT_PIPELINE_WINDOW", "2") or "2")
+        self.pipeline_window = knobs.get_int("KT_PIPELINE_WINDOW")
         # Workload-subsystem prewarm timings (string-keyed; see
         # _prewarm_workloads) — {} until prewarm() runs.
         self.workloads_prewarm_s: dict = {}
@@ -278,8 +275,7 @@ class Scheduler:
     # costs ~250 ms, so one big scan beats any multi-launch pipeline; on
     # locally-attached chips (launch ~1 ms) set KT_STREAM_CHUNK to e.g.
     # 4096 and the pipeline wins.
-    STREAM_THRESHOLD = int(os.environ.get("KT_STREAM_CHUNK", "0") or "0") \
-        or (1 << 62)
+    STREAM_THRESHOLD = knobs.get_int("KT_STREAM_CHUNK") or (1 << 62)
 
     # Drains below this size are routed through the stream path with a
     # power-of-two chunk, whose live-flag padding gives them a fixed
@@ -412,11 +408,10 @@ class Scheduler:
                 self._handle_failure(pod, "FailedScheduling", msg,
                                      result=result)
         if self.config.async_bind:
-            t = threading.Thread(target=self._bind_assumed_batch,
-                                 args=(placed, start,
-                                       trace_mod.current_context()),
-                                 daemon=True)
-            t.start()
+            t = threadreg.spawn(self._bind_assumed_batch,
+                                args=(placed, start,
+                                      trace_mod.current_context()),
+                                name="bind-batch", transient=True)
             # Prune finished binders on append: a long-running daemon
             # drains every ~50 ms and must not accumulate dead Thread
             # objects without bound.
@@ -725,10 +720,7 @@ class Scheduler:
                     log.exception("scheduling iteration crashed; "
                                   "continuing")
                     time.sleep(0.5)
-        t = threading.Thread(target=loop, daemon=True,
-                             name="scheduler-loop")
-        t.start()
-        return t
+        return threadreg.spawn(loop, name="scheduler-loop")
 
     def stop(self) -> None:
         self._stop.set()
@@ -739,7 +731,7 @@ class Scheduler:
         # Graceful shutdown persists the decision ring (KT_FLIGHT_DIR) so
         # `kubectl explain pod` keeps answering across a scheduler bounce.
         recorder = self.config.flight_recorder
-        flight_dir = os.environ.get("KT_FLIGHT_DIR", "")
+        flight_dir = knobs.get("KT_FLIGHT_DIR")
         if recorder is not None and flight_dir:
             try:
                 recorder.save(flight_dir)
@@ -805,8 +797,7 @@ class Scheduler:
                 self._bind_assumed(pod, dest, start, assumed=assumed)
 
         if self.config.async_bind:
-            t = threading.Thread(target=bind, daemon=True)
-            t.start()
+            t = threadreg.spawn(bind, name="bind-one", transient=True)
             self._bind_threads = [x for x in self._bind_threads
                                   if x.is_alive()]
             self._bind_threads.append(t)
@@ -954,10 +945,8 @@ class Scheduler:
                             self._requeue_seq, pod))
             if self._requeue_thread is None or \
                     not self._requeue_thread.is_alive():
-                self._requeue_thread = threading.Thread(
-                    target=self._requeue_worker, daemon=True,
-                    name="backoff-requeue")
-                self._requeue_thread.start()
+                self._requeue_thread = threadreg.spawn(
+                    self._requeue_worker, name="backoff-requeue")
             self._requeue_cv.notify()
 
     def _requeue_worker(self) -> None:

@@ -59,7 +59,7 @@ import time
 import zlib
 from typing import Callable, Optional
 
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import locktrace, metrics, threadreg
 from kubernetes_tpu.utils.leaderelection import (APIResourceLock,
                                                  LeaderElector)
 from kubernetes_tpu.utils.logging import get_logger
@@ -145,7 +145,7 @@ class ShardManager:
         # out).
         self._acquired_at: dict[int, float] = {}
         self.lease_duration = lease_duration
-        self._mu = threading.Lock()
+        self._mu = locktrace.make_lock("scheduler.ShardManager")
         # Per-shard renew-success stamp: a holder that cannot CAS for
         # renew_deadline gives the shard up LOCALLY (stops scheduling it)
         # even before the lease expires for everyone else — the reference
@@ -207,12 +207,11 @@ class ShardManager:
     # -- the tick loop -----------------------------------------------------
 
     def run(self) -> "ShardManager":
-        t = threading.Thread(target=self._loop, daemon=True,
-                             name=f"shard-manager-{self.incarnation}")
-        t.start()
-        cb = threading.Thread(target=self._callback_loop, daemon=True,
-                              name=f"shard-callbacks-{self.incarnation}")
-        cb.start()
+        t = threadreg.spawn(
+            self._loop, name=f"shard-manager-{self.incarnation}")
+        cb = threadreg.spawn(
+            self._callback_loop,
+            name=f"shard-callbacks-{self.incarnation}")
         self._threads = [t, cb]
         return self
 

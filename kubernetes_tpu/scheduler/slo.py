@@ -37,8 +37,7 @@ import time
 from bisect import bisect_right
 from typing import Callable, Optional
 
-from kubernetes_tpu.utils import metrics
-from kubernetes_tpu.utils.envutil import env_float
+from kubernetes_tpu.utils import knobs, locktrace, metrics, threadreg
 from kubernetes_tpu.utils.logging import get_logger
 
 log = get_logger("slo")
@@ -64,13 +63,13 @@ class SLOMonitor:
         self.histogram = histogram if histogram is not None \
             else metrics.E2E_DECISION_LATENCY
         self.slo_ms = slo_ms if slo_ms is not None \
-            else env_float("KT_SLO_MS", DEFAULT_SLO_MS)
+            else knobs.get_float("KT_SLO_MS")
         self.objective_pct = objective_pct if objective_pct is not None \
-            else env_float("KT_SLO_OBJECTIVE", DEFAULT_OBJECTIVE_PCT)
+            else knobs.get_float("KT_SLO_OBJECTIVE")
         self.budget = max(1.0 - self.objective_pct / 100.0, 1e-9)
         self.windows = tuple(windows)
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = locktrace.make_lock("scheduler.SLOMonitor")
         # (t, total, good) samples, oldest first, bounded to the longest
         # window (plus one sample of slack for the delta at the edge).
         self._samples: list[tuple[float, int, int]] = []
@@ -204,9 +203,7 @@ class SLOMonitor:
                     self.tick()
                 except Exception:  # noqa: BLE001 — monitor must survive
                     log.exception("slo tick crashed; continuing")
-        self._thread = threading.Thread(target=loop, daemon=True,
-                                        name="slo-burn-monitor")
-        self._thread.start()
+        self._thread = threadreg.spawn(loop, name="slo-burn-monitor")
         return self._thread
 
     def stop(self) -> None:

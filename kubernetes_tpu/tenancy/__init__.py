@@ -34,7 +34,8 @@ def tenant_names() -> list[str]:
     """The configured tenant set (``KT_TENANTS="t-a,t-b,t-c"``); empty
     list = tenancy disabled (the single-owner engine, byte-for-byte the
     pre-tenancy behavior)."""
-    raw = os.environ.get("KT_TENANTS", "").strip()
+    from kubernetes_tpu.utils import knobs
+    raw = knobs.get("KT_TENANTS")
     if not raw:
         return []
     return [t.strip() for t in raw.split(",") if t.strip()]
@@ -51,7 +52,8 @@ def tenant_weights(tenants: list[str] | None = None) -> dict[str, float]:
     if tenants is None:
         tenants = tenant_names()
     weights = {t: 1.0 for t in tenants}
-    raw = os.environ.get("KT_TENANT_WEIGHTS", "").strip()
+    from kubernetes_tpu.utils import knobs
+    raw = knobs.get("KT_TENANT_WEIGHTS")
     for entry in raw.split(","):
         entry = entry.strip()
         if not entry or ":" not in entry:

@@ -34,10 +34,10 @@ observable — a noisy tenant's deferrals grow while its share is capped.
 
 from __future__ import annotations
 
-import os
 from typing import Callable, Optional
 
 from kubernetes_tpu.scheduler.batchformer import first_seen
+from kubernetes_tpu.utils import knobs
 from kubernetes_tpu.utils import metrics as metrics_mod
 
 # Deficit carried across drains is clamped to this many drains' worth of
@@ -59,7 +59,7 @@ class TenantPacker:
         self.tenant_of = tenant_of
         self.weights = dict(weights)
         self.urgent_s_fn = urgent_s_fn
-        env = os.environ.get("KT_TENANT_URGENT_MS", "").strip()
+        env = knobs.get("KT_TENANT_URGENT_MS")
         self._urgent_override = float(env) / 1e3 if env else None
         self._deficit: dict[str, float] = {}
 

@@ -43,7 +43,7 @@ from typing import Callable, Optional
 
 from kubernetes_tpu import tenancy as tenancy_mod
 from kubernetes_tpu.tenancy.packer import TenantPacker
-from kubernetes_tpu.utils import metrics
+from kubernetes_tpu.utils import knobs, locktrace, metrics, threadreg
 from kubernetes_tpu.utils.logging import get_logger
 
 log = get_logger("tenancy")
@@ -83,15 +83,12 @@ class SolverService:
         self.weights = dict(weights) if weights is not None \
             else tenancy_mod.tenant_weights(self.tenants)
         self.ladder_fn = ladder_fn or (lambda: [])
-        self.breaker_threshold = int(os.environ.get(
-            "KT_TENANT_BREAKER", "2") or "2")
-        self.probe_period_s = float(os.environ.get(
-            "KT_TENANT_PROBE_S", "10") or "10")
-        self.pack_window_s = float(os.environ.get(
-            "KT_TENANT_PACK_MS", "5") or "5") / 1e3
+        self.breaker_threshold = knobs.get_int("KT_TENANT_BREAKER")
+        self.probe_period_s = knobs.get_float("KT_TENANT_PROBE_S")
+        self.pack_window_s = knobs.get_float("KT_TENANT_PACK_MS") / 1e3
         self.packer = TenantPacker(self.pod_tenant, self.weights,
                                    urgent_s_fn=urgent_s_fn)
-        self._lock = threading.Lock()
+        self._lock = locktrace.make_lock("tenancy.SolverService.state")
         self._states: dict[str, TenantState] = {}
         # Fault-attribution accounting: splits of mixed faulted batches,
         # and faults that landed on a batch carrying NO tenant currently
@@ -112,8 +109,14 @@ class SolverService:
         # solve state (last_node_index, agg handoff, resident arrays)
         # is not safe under two concurrent solvers.
         self._pending: list[dict] = []
-        self._pending_lock = threading.Lock()
-        self.engine_lock = threading.Lock()
+        self._pending_lock = locktrace.make_lock(
+            "tenancy.SolverService.pending")
+        # hold_ms=0: this lock IS the device occupancy — packed submits
+        # and the embedded daemon's drain serialize on one solver, so
+        # its hold time is the solve itself (measured by stage spans),
+        # not a long-hold bug.  Order tracking stays on.
+        self.engine_lock = locktrace.make_lock(
+            "tenancy.SolverService.engine", hold_ms=0)
         for t in self.tenants:
             metrics.TENANT_ENGINE_MODE.labels(tenant=t).set(0.0)
 
@@ -499,8 +502,7 @@ def serve_solver(service: SolverService, port: int = 0,
             self._send(*solve_route(service, body))
 
     server = ThreadingHTTPServer((host, port), Handler)
-    threading.Thread(target=server.serve_forever, daemon=True,
-                     name="solver-service-http").start()
+    threadreg.spawn(server.serve_forever, name="solver-service-http")
     return server
 
 

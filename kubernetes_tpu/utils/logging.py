@@ -25,7 +25,8 @@ def configure(v: int | None = None, stream=sys.stderr) -> None:
     library users configure logging themselves)."""
     global _configured
     if v is None:
-        v = int(os.environ.get("KT_LOG_V", "0") or "0")
+        from kubernetes_tpu.utils import knobs
+        v = knobs.get_int("KT_LOG_V")
     level = logging.INFO if v <= 1 else (logging.DEBUG if v < 5 else VERBOSE)
     root = logging.getLogger(_ROOT)
     if not _configured:

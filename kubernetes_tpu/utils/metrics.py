@@ -31,6 +31,8 @@ import time
 from bisect import bisect_left
 from typing import Iterable
 
+from kubernetes_tpu.utils import locktrace
+
 
 def _escape_help(text: str) -> str:
     """HELP escaping per the exposition spec: backslash and line feed."""
@@ -61,7 +63,8 @@ class _Family:
         self.help = help_text
         self._labelnames = tuple(labelnames)
         self._children: dict = {}
-        self._lock = threading.Lock()
+        self._lock = locktrace.make_lock(
+            f"metrics.{type(self).__name__}")
 
     def labels(self, **kw):
         """The child metric for this label set (created on first use).
@@ -418,7 +421,7 @@ def exponential_buckets(start: float, factor: float, count: int) -> list[float]:
 # shape).
 
 _REGISTRY: list = []
-_REGISTRY_LOCK = threading.Lock()
+_REGISTRY_LOCK = locktrace.make_lock("metrics.registry")
 
 
 def register(metric):
@@ -713,6 +716,19 @@ TENANT_SLO_BURN = register(Gauge(
     "over the 5m window (1.0 = exactly exhausting the budget; the "
     "global burn gauge's tenant-attributed sibling)",
     labelnames=("tenant",)))
+# Concurrency-discipline plane (utils/locktrace.py, KT_LOCKTRACE=1):
+# the runtime companion of ktlint's static lock-order graph.  The soak
+# scrapes both from every incarnation and ratchets them to zero.
+LOCK_INVERSIONS = register(Counter(
+    "scheduler_lock_inversions_total",
+    "Lock-order inversions observed by the KT_LOCKTRACE instrumented "
+    "locks: some thread acquired A then B after another acquired B "
+    "then A — a deadlock precondition, counted once per lock pair"))
+LOCK_LONG_HOLDS = register(Counter(
+    "scheduler_lock_long_holds_total",
+    "Traced-lock holds longer than KT_LOCKTRACE_HOLD_MS (default "
+    "100 ms): a lock held across device work or I/O is a latency "
+    "cliff for every thread queued behind it"))
 # Server-side capacity validation at bind (apiserver/memstore.py): the
 # apiserver rejects a bind that would overcommit the target node's
 # allocatable (watch-lagged schedulers absorb the 409 via forget +

@@ -13,12 +13,13 @@ goroutine-dump analogue).
 from __future__ import annotations
 
 import contextlib
-import os
 import sys
 import threading
 import traceback
 
-_PROFILE_DIR = [os.environ.get("KT_PROFILE_DIR", "")]
+from kubernetes_tpu.utils import knobs
+
+_PROFILE_DIR = [knobs.get("KT_PROFILE_DIR")]
 
 
 def set_profile_dir(path: str) -> None:

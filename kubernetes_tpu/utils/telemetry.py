@@ -34,8 +34,7 @@ import time
 from collections import deque
 from typing import Callable, Iterable, Optional
 
-from kubernetes_tpu.utils import metrics
-from kubernetes_tpu.utils.envutil import env_float
+from kubernetes_tpu.utils import knobs, locktrace, metrics, threadreg
 from kubernetes_tpu.utils.logging import get_logger
 from kubernetes_tpu.utils.metrics import (Counter, Gauge, Histogram,
                                           _label_str)
@@ -76,17 +75,17 @@ class TimeSeriesRing:
                  period_s: Optional[float] = None,
                  collect: Optional[Callable[[], dict]] = None,
                  clock: Callable[[], float] = time.time):
-        self.capacity = capacity if capacity is not None else int(
-            env_float("KT_TELEMETRY_RING", DEFAULT_CAPACITY))
+        self.capacity = capacity if capacity is not None else \
+            knobs.get_int("KT_TELEMETRY_RING")
         self.period_s = period_s if period_s is not None else \
-            env_float("KT_TELEMETRY_PERIOD", DEFAULT_PERIOD_S)
+            knobs.get_float("KT_TELEMETRY_PERIOD")
         self.clock = clock
         self._collect = collect
         # Extra metric objects beyond the default registry (the
         # scheduler daemon's SchedulerMetrics set), identity-deduped.
         self._extra: list = []
         self._samples: deque = deque(maxlen=max(self.capacity, 1))
-        self._lock = threading.Lock()
+        self._lock = locktrace.make_lock("telemetry.TimeSeriesRing")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.scrapes = 0
@@ -135,9 +134,7 @@ class TimeSeriesRing:
                     self.scrape()
                 except Exception:  # noqa: BLE001 — keep scraping
                     log.exception("telemetry scrape crashed; continuing")
-        self._thread = threading.Thread(target=loop, daemon=True,
-                                        name="telemetry-ring")
-        self._thread.start()
+        self._thread = threadreg.spawn(loop, name="telemetry-ring")
         return self._thread
 
     def stop(self) -> None:
@@ -162,7 +159,7 @@ class TimeSeriesRing:
 # -- the process-global ring -------------------------------------------------
 
 _ring: Optional[TimeSeriesRing] = None
-_ring_lock = threading.Lock()
+_ring_lock = locktrace.make_lock("telemetry.ring_global")
 
 
 def ring() -> TimeSeriesRing:

@@ -45,13 +45,12 @@ TRACE_THRESHOLD_S = 0.020
 # Ring capacity in spans.  A batch emits ~10 spans, so the default holds
 # the last several hundred batches; the buffer is allocated only when the
 # first span is recorded (a tracing-disabled daemon never pays for it).
-RING_CAPACITY = int(os.environ.get("KT_TRACE_RING", "8192") or "8192")
+from kubernetes_tpu.utils import knobs
 
-_enabled = os.environ.get("KT_TRACE", "1") != "0"
-try:
-    _sample = float(os.environ.get("KT_TRACE_SAMPLE", "1") or "1")
-except ValueError:
-    _sample = 1.0
+RING_CAPACITY = knobs.get_int("KT_TRACE_RING")
+
+_enabled = knobs.get_bool("KT_TRACE")
+_sample = max(0.0, min(1.0, knobs.get_float("KT_TRACE_SAMPLE")))
 
 _ring: deque | None = None   # lazily allocated; deque append is atomic
 _ring_lock = threading.Lock()

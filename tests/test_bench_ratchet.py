@@ -185,6 +185,40 @@ def test_soak_restart_parity_below_100_fails():
     assert len(problems) == 1 and "parity" in problems[0]
 
 
+def test_soak_lock_inversions_fail():
+    art = _soak()
+    art["locktrace"] = {"lock_inversions": 1, "long_holds": 0}
+    problems = cb.check_soak([("SOAK_r13.json", art)])
+    assert len(problems) == 1 and "inversion" in problems[0]
+
+
+def test_soak_long_holds_fail():
+    art = _soak()
+    art["locktrace"] = {"lock_inversions": 0, "long_holds": 3}
+    problems = cb.check_soak([("SOAK_r13.json", art)])
+    assert len(problems) == 1 and "long lock hold" in problems[0]
+
+
+def test_soak_tenancy_poison_contract_rows():
+    art = _soak()
+    art["tenancy_poison"] = {"offered": 450, "bound": 300,
+                             "repromoted": False}
+    problems = cb.check_soak([("SOAK_r13.json", art)])
+    assert any("bound only 300/450" in p for p in problems)
+    assert any("never re-promoted" in p for p in problems)
+    art["tenancy_poison"] = {"offered": 450, "bound": 450,
+                             "repromoted": True}
+    assert cb.check_soak([("SOAK_r13.json", art)]) == []
+
+
+def test_soak_clean_locktrace_and_prelocktrace_artifacts_pass():
+    art = _soak()
+    art["locktrace"] = {"lock_inversions": 0, "long_holds": 0}
+    assert cb.check_soak([("SOAK_r13.json", art)]) == []
+    # Artifacts predating locktrace carry no section: nothing ratchets.
+    assert cb.check_soak([("SOAK_r07.json", _soak())]) == []
+
+
 def test_soak_settle_regression_beyond_tolerance_fails():
     arts = [("SOAK_r07.json", _soak(settle=10.0)),
             ("SOAK_r08.json", _soak(settle=12.0))]

@@ -1,0 +1,447 @@
+"""kt-lint in tier-1: the zero-new-findings ratchet over the real tree,
+the rule-inventory self-check (a rule cannot be silently deleted), unit
+coverage of every rule on synthetic sources, the suppression/baseline
+protocol, the knob registry, and the threadreg stop/join audit."""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from kubernetes_tpu.analysis import core  # noqa: E402
+from kubernetes_tpu.analysis import rules_concurrency  # noqa: E402,F401
+from kubernetes_tpu.analysis import rules_device  # noqa: E402,F401
+from kubernetes_tpu.utils import knobs  # noqa: E402
+
+EXPECTED_RULES = {"D01", "D02", "D03", "D04", "C01", "C02", "C03"}
+
+
+def _module(src: str, path: str) -> core.Module:
+    return core.Module(path=path, src=src, tree=ast.parse(src))
+
+
+def _check(rule_id: str, src: str, path: str) -> list:
+    out = core.RULES[rule_id].check(_module(src, path))
+    return [f for f in out if f is not None]
+
+
+# -- the tier-1 ratchet -------------------------------------------------
+
+def test_tree_is_clean_against_baseline():
+    """The zero-new-findings ratchet: any new D/C finding anywhere in
+    kubernetes_tpu/ fails tier-1; stale baseline entries fail too."""
+    result = core.run_project(REPO)
+    msgs = [f.text() for f in result.new] + \
+        [f"STALE: {fp}" for fp in result.stale_baseline]
+    assert not result.failed, \
+        "ktlint found new (or stale-baselined) findings — fix them or " \
+        "justify in tools/ktlint_baseline.json:\n" + "\n".join(msgs)
+
+
+def test_baseline_entries_are_justified():
+    baseline = core.load_baseline()
+    for fp, why in baseline.items():
+        assert why and "JUSTIFY" not in why, \
+            f"baseline entry without a real justification: {fp}"
+
+
+def test_driver_json_output():
+    import subprocess
+    import sys
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.ktlint", "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["new"] == []
+    assert payload["stale_baseline"] == []
+    assert set(payload["rules"]) == EXPECTED_RULES
+
+
+# -- rule-inventory self-check ------------------------------------------
+
+def test_rule_inventory_pinned():
+    """A deleted (or renamed) rule must fail loudly, not silently lint
+    less — mirror of tools/check_metrics.py's inventory ratchet."""
+    assert set(core.RULES) == EXPECTED_RULES
+    for rule in core.RULES.values():
+        assert rule.title and rule.doc
+        assert rule.check is not None or rule.finalize is not None
+
+
+def test_rule_inventory_in_architecture_md():
+    with open(os.path.join(REPO, "ARCHITECTURE.md")) as f:
+        text = f.read()
+    assert "## Static analysis & concurrency discipline" in text
+    section = text.split("## Static analysis & concurrency discipline",
+                         1)[1].split("\n## ", 1)[0]
+    for rule_id in EXPECTED_RULES:
+        assert f"`{rule_id}`" in section, \
+            f"rule {rule_id} missing from the ARCHITECTURE.md inventory"
+
+
+# -- D01: device-import layering ----------------------------------------
+
+def test_d01_flags_jax_import_outside_allowlist():
+    src = "import jax\nimport jax.numpy as jnp\n"
+    found = _check("D01", src, "kubernetes_tpu/scheduler/foo.py")
+    assert len(found) == 2 and all(f.rule == "D01" for f in found)
+
+
+def test_d01_allows_engine_and_function_scoped_elsewhere_flagged():
+    src = "from jax import numpy\n"
+    assert not _check("D01", src, "kubernetes_tpu/engine/foo.py")
+    assert not _check("D01", src, "kubernetes_tpu/perf/foo.py")
+    assert not _check("D01", src, "kubernetes_tpu/utils/profiling.py")
+    nested = "def f():\n    import jax\n"
+    assert _check("D01", nested, "kubernetes_tpu/cache/foo.py")
+
+
+# -- D02: readback routing ----------------------------------------------
+
+def test_d02_flags_raw_readbacks_outside_engine():
+    src = "x = jax.device_get(y)\nz = arr.block_until_ready()\n"
+    found = _check("D02", src, "kubernetes_tpu/scheduler/foo.py")
+    assert len(found) == 2
+    assert not _check("D02", src, "kubernetes_tpu/engine/solver.py")
+
+
+# -- D03: jit purity ----------------------------------------------------
+
+_D03_SRC = """
+import jax, time, os
+
+@jax.jit
+def solve(x):
+    t = time.time()
+    return x + t
+
+def pure(x):
+    return time.time()
+
+_impl = jax.vmap(victim)
+
+def victim(x):
+    return os.environ.get("KT_FOO", x)
+"""
+
+
+def test_d03_flags_impure_jitted_bodies_only():
+    found = _check("D03", _D03_SRC, "kubernetes_tpu/engine/foo.py")
+    lines = {f.line for f in found}
+    assert any("time.time" in f.message for f in found)
+    assert any("environ" in f.message for f in found)
+    # `pure` is never jitted — its time.time() is not a finding.
+    assert len(found) == 2, [f.message for f in found]
+    assert not _check("D03", _D03_SRC, "kubernetes_tpu/scheduler/x.py")
+    assert lines
+
+
+def test_d03_partial_jit_decorator():
+    src = ("import jax, functools, random\n"
+           "@functools.partial(jax.jit, static_argnums=0)\n"
+           "def f(n, x):\n"
+           "    return x * random.random()\n")
+    assert _check("D03", src, "kubernetes_tpu/ops/foo.py")
+
+
+# -- D04: knob discipline -----------------------------------------------
+
+def test_d04_flags_raw_kt_env_reads():
+    src = 'import os\nv = os.environ.get("KT_TRACE", "1")\n'
+    found = _check("D04", src, "kubernetes_tpu/scheduler/foo.py")
+    assert found and "KT_TRACE" in found[0].message
+
+
+def test_d04_ignores_non_kt_and_dynamic_reads():
+    src = ('import os\nv = os.environ.get("HOME")\n'
+           'w = os.environ.get(name)\nos.environ["KT_X"] = "1"\n')
+    assert not _check("D04", src, "kubernetes_tpu/scheduler/foo.py")
+
+
+def test_d04_flags_undeclared_knob_names():
+    src = ('from kubernetes_tpu.utils import knobs\n'
+           'v = knobs.get_int("KT_NOT_A_REAL_KNOB")\n')
+    found = _check("D04", src, "kubernetes_tpu/scheduler/foo.py")
+    assert found and "undeclared" in found[0].message
+
+
+def test_d04_flags_hot_path_reads_even_via_knobs():
+    src = ("from kubernetes_tpu.utils import knobs\n"
+           "class Scheduler:\n"
+           "    def schedule_pending(self):\n"
+           "        return knobs.get_int('KT_PIPELINE_WINDOW')\n")
+    found = _check("D04", src, "kubernetes_tpu/scheduler/scheduler.py")
+    assert found and "hot path" in found[0].message
+    # The same read at init is fine.
+    init = src.replace("schedule_pending", "__init__")
+    assert not _check("D04", init,
+                      "kubernetes_tpu/scheduler/scheduler.py")
+
+
+# -- C01: lock-order cycles ---------------------------------------------
+
+def _project_of(src: str, path: str) -> core.Project:
+    p = core.Project(root=REPO)
+    p.modules.append(_module(src, path))
+    return p
+
+
+_CYCLE_SRC = """
+import threading
+
+class A:
+    def f(self):
+        with self.alpha_lock:
+            with self.beta_lock:
+                pass
+
+    def g(self):
+        with self.beta_lock:
+            with self.alpha_lock:
+                pass
+"""
+
+
+def test_c01_detects_inverted_with_nesting():
+    p = _project_of(_CYCLE_SRC, "kubernetes_tpu/scheduler/foo.py")
+    found = [f for f in core.RULES["C01"].finalize(p) if f]
+    assert found and "cycle" in found[0].message
+    assert "A.alpha_lock" in found[0].message
+
+
+def test_c01_no_cycle_on_consistent_order():
+    src = _CYCLE_SRC.replace(
+        "with self.beta_lock:\n            with self.alpha_lock:",
+        "with self.alpha_lock:\n            with self.beta_lock:")
+    p = _project_of(src, "kubernetes_tpu/scheduler/foo.py")
+    assert not [f for f in core.RULES["C01"].finalize(p) if f]
+
+
+def test_c01_with_release_does_not_leak_to_siblings():
+    """The scanner regression this PR hit live: a lock released at the
+    end of a `with` must not count as held by later sibling statements
+    (that false nesting minted a phantom ShardManager cycle)."""
+    src = ("class A:\n"
+           "    def f(self):\n"
+           "        with self.alpha_lock:\n"
+           "            pass\n"
+           "        with self.beta_lock:\n"
+           "            pass\n"
+           "    def g(self):\n"
+           "        with self.beta_lock:\n"
+           "            pass\n"
+           "        with self.alpha_lock:\n"
+           "            pass\n")
+    p = _project_of(src, "kubernetes_tpu/scheduler/foo.py")
+    assert not [f for f in core.RULES["C01"].finalize(p) if f]
+
+
+def test_c01_acquire_release_chains():
+    src = ("class A:\n"
+           "    def f(self):\n"
+           "        self.alpha_lock.acquire()\n"
+           "        with self.beta_lock:\n"
+           "            pass\n"
+           "        self.alpha_lock.release()\n"
+           "    def g(self):\n"
+           "        with self.beta_lock:\n"
+           "            self.alpha_lock.acquire()\n"
+           "            self.alpha_lock.release()\n")
+    p = _project_of(src, "kubernetes_tpu/scheduler/foo.py")
+    found = [f for f in core.RULES["C01"].finalize(p) if f]
+    assert found and "cycle" in found[0].message
+
+
+def test_c01_call_under_lock_propagates():
+    src = ("class A:\n"
+           "    def outer_one(self):\n"
+           "        with self.alpha_lock:\n"
+           "            self.helper_takes_beta()\n"
+           "    def helper_takes_beta(self):\n"
+           "        with self.beta_lock:\n"
+           "            pass\n"
+           "    def other(self):\n"
+           "        with self.beta_lock:\n"
+           "            with self.alpha_lock:\n"
+           "                pass\n")
+    p = _project_of(src, "kubernetes_tpu/scheduler/foo.py")
+    found = [f for f in core.RULES["C01"].finalize(p) if f]
+    assert found, "call-under-lock edge missed"
+
+
+def test_c01_real_tree_graph_is_exported_and_acyclic():
+    project = core.load_project(REPO)
+    findings = [f for f in core.run_rules(project)
+                if f.rule == "C01"]
+    assert not findings, [f.message for f in findings]
+    graph = project.scratch["lock_graph"]
+    assert graph["nodes"], "lock graph came back empty"
+
+
+# -- C02/C03: factory discipline ----------------------------------------
+
+def test_c02_flags_raw_lock_in_tracked_module():
+    src = "import threading\nlock = threading.Lock()\n"
+    assert _check("C02", src, "kubernetes_tpu/utils/metrics.py")
+    assert not _check("C02", src, "kubernetes_tpu/controller/foo.py")
+
+
+def test_c03_flags_raw_thread_in_daemon_modules():
+    src = "import threading\nt = threading.Thread(target=print)\n"
+    assert _check("C03", src, "kubernetes_tpu/scheduler/foo.py")
+    assert _check("C03", src, "kubernetes_tpu/tenancy/foo.py")
+    assert not _check("C03", src, "kubernetes_tpu/server/foo.py")
+
+
+# -- suppression & baseline mechanics -----------------------------------
+
+def test_suppression_comment_silences_exact_rule_only():
+    src = ("import threading\n"
+           "t = threading.Thread(target=print)  "
+           "# ktlint: disable=C03\n")
+    assert not _check("C03", src, "kubernetes_tpu/scheduler/foo.py")
+    wrong = src.replace("C03", "D01")
+    assert _check("C03", wrong, "kubernetes_tpu/scheduler/foo.py")
+
+
+def test_baseline_grandfathers_and_goes_stale(tmp_path):
+    src = "import jax\n"
+    path = os.path.join(REPO, "kubernetes_tpu", "scheduler",
+                        "__init__.py")
+    # Synthesize a baseline for a finding, then verify run_project
+    # splits new vs baselined vs stale correctly on a tiny tree.
+    finding = core.Finding("D01", "kubernetes_tpu/scheduler/x.py", 1,
+                           "import jax: device imports are allowed "
+                           "only under kubernetes_tpu/engine/, "
+                           "kubernetes_tpu/ops/, "
+                           "kubernetes_tpu/parallel/, "
+                           "kubernetes_tpu/perf/, "
+                           "kubernetes_tpu/utils/profiling.py — the "
+                           "host fallback guarantee is structural")
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(
+        {"findings": {finding.fingerprint: "synthetic test entry"}}))
+    tree = tmp_path / "kubernetes_tpu" / "scheduler"
+    tree.mkdir(parents=True)
+    (tree / "x.py").write_text(src)
+    result = core.run_project(str(tmp_path), baseline_path=str(bl))
+    assert not result.new and len(result.baselined) == 1
+    assert not result.failed
+    # Fix the finding: the baseline entry must go stale and FAIL.
+    (tree / "x.py").write_text("import os\n")
+    result = core.run_project(str(tmp_path), baseline_path=str(bl))
+    assert result.stale_baseline and result.failed
+    assert path  # silence lint on the unused anchor
+
+
+# -- knob registry ------------------------------------------------------
+
+def test_check_knobs_in_sync():
+    spec = importlib.util.spec_from_file_location(
+        "check_knobs", os.path.join(REPO, "tools", "check_knobs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0, \
+        "knob registry drifted — see tools/check_knobs.py output " \
+        "(regenerate the table with --render)"
+
+
+def test_knob_reads_follow_the_contract(monkeypatch):
+    monkeypatch.delenv("KT_PIPELINE_WINDOW", raising=False)
+    assert knobs.get_int("KT_PIPELINE_WINDOW") == 2
+    monkeypatch.setenv("KT_PIPELINE_WINDOW", "")
+    assert knobs.get_int("KT_PIPELINE_WINDOW") == 2
+    monkeypatch.setenv("KT_PIPELINE_WINDOW", "7")
+    assert knobs.get_int("KT_PIPELINE_WINDOW") == 7
+    monkeypatch.setenv("KT_PIPELINE_WINDOW", "garbage")
+    assert knobs.get_int("KT_PIPELINE_WINDOW") == 2  # warn, default
+    monkeypatch.setenv("KT_HBM_WATERMARK", "2e9")
+    assert knobs.get_int("KT_HBM_WATERMARK") == 2_000_000_000
+
+
+def test_knob_bool_contract(monkeypatch):
+    monkeypatch.delenv("KT_GUARD", raising=False)
+    assert knobs.get_bool("KT_GUARD") is True          # default "1"
+    monkeypatch.setenv("KT_GUARD", "0")
+    assert knobs.get_bool("KT_GUARD") is False
+    monkeypatch.setenv("KT_GUARD", "")
+    assert knobs.get_bool("KT_GUARD") is False          # set-empty = off
+    monkeypatch.delenv("KT_PREWARM", raising=False)
+    assert knobs.get_bool("KT_PREWARM") is False        # default "0"
+
+
+def test_undeclared_knob_raises():
+    with pytest.raises(KeyError):
+        knobs.get("KT_NOT_A_REAL_KNOB")
+    with pytest.raises(KeyError):
+        knobs.get_bool("KT_NOT_A_REAL_KNOB")
+
+
+def test_site_computed_defaults():
+    assert knobs.get_float("KT_HA_RENEW_S", default=2.0) == 2.0
+    assert knobs.get_int("KT_WIRE_CHUNK", default=4096) == 4096
+
+
+def test_render_table_lists_every_knob():
+    table = knobs.render_table()
+    for name in knobs.REGISTRY:
+        assert f"`{name}`" in table
+
+
+# -- threadreg: the stop/join audit -------------------------------------
+
+def test_factory_threads_are_registered_and_stop_clean():
+    """The C03 runtime contract: every thread a ConfigFactory starts is
+    registered under a name, and stop() leaves none of the long-lived
+    ones running."""
+    from kubernetes_tpu.apiserver.memstore import MemStore
+    from kubernetes_tpu.scheduler.factory import ConfigFactory
+    from kubernetes_tpu.utils import threadreg
+    store = MemStore()
+    factory = ConfigFactory(store, batched=True).run()
+    try:
+        live = threadreg.live()
+        assert any(n.startswith("reflector-") for n in live)
+        assert any(n == "scheduler-loop" for n in live)
+        assert any(n == "assume-ttl-sweep" for n in live)
+        assert any(n == "slo-burn-monitor" for n in live)
+    finally:
+        factory.stop()
+    import time
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        leaked = [n for n in threadreg.live()
+                  if n in ("scheduler-loop", "assume-ttl-sweep",
+                           "slo-burn-monitor")
+                  or n.startswith("reflector-")]
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, f"threads still live after stop(): {leaked}"
+
+
+def test_threadreg_audit_surface():
+    from kubernetes_tpu.utils import threadreg
+    import threading
+    done = threading.Event()
+    t = threadreg.spawn(done.wait, name="audit-probe")
+    assert "audit-probe" in threadreg.live()
+    report = threadreg.audit(expect_stopped=("audit-probe",))
+    assert "audit-probe" in report["leaked"]
+    done.set()
+    t.join(timeout=5)
+    assert "audit-probe" not in threadreg.live()
+    # Transients never enter the registry.
+    done2 = threading.Event()
+    t2 = threadreg.spawn(done2.wait, name="transient-probe",
+                         transient=True)
+    assert "transient-probe" not in threadreg.live()
+    done2.set()
+    t2.join(timeout=5)

@@ -1,0 +1,250 @@
+"""utils/locktrace.py: deadlock-injection units (two threads, inverted
+acquisition order -> inversion reported without any schedule collision),
+long-hold detection, RLock recursion semantics, and the KT_LOCKTRACE=0
+zero-cost contract (plain locks, pinned by a 100k-acquire guard — the
+PR 2 trace-overhead-guard pattern)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.utils import locktrace, metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    was = locktrace.enabled()
+    locktrace.reset()
+    locktrace.set_hold_threshold_ms(100.0)
+    yield
+    locktrace.set_enabled(was)
+    locktrace.reset()
+
+
+def _run(fn) -> threading.Thread:
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    return t
+
+
+# -- off path: zero cost ------------------------------------------------
+
+def test_disabled_factory_returns_plain_locks():
+    locktrace.set_enabled(False)
+    lock = locktrace.make_lock("test.plain")
+    assert type(lock) is type(threading.Lock())
+    rlock = locktrace.make_rlock("test.plain_r")
+    assert type(rlock) is type(threading.RLock())
+
+
+def test_disabled_overhead_guard_100k_acquires_under_1s():
+    """The one-branch contract: with KT_LOCKTRACE off the lock IS a
+    threading.Lock, so 100k acquire/release pairs cost what they always
+    did (same bar as the KT_TRACE=0 span guard)."""
+    locktrace.set_enabled(False)
+    lock = locktrace.make_lock("test.overhead")
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        with lock:
+            pass
+    assert time.perf_counter() - t0 < 1.0
+    assert locktrace.report()["acquires"] == 0
+
+
+# -- inversion detection ------------------------------------------------
+
+def test_inverted_order_across_two_threads_is_reported():
+    locktrace.set_enabled(True)
+    a = locktrace.make_lock("test.A")
+    b = locktrace.make_lock("test.B")
+    inv0 = metrics.LOCK_INVERSIONS.value
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    _run(ab)   # records edge A -> B, no inversion yet
+    assert locktrace.report()["lock_inversions"] == 0
+    _run(ba)   # reverse edge -> the deadlock precondition
+    rep = locktrace.report()
+    assert rep["lock_inversions"] == 1
+    detail = rep["inversion_detail"][0]
+    assert set(detail["locks"]) == {"test.A", "test.B"}
+    assert detail["chain"][-1] == "test.A"
+    assert metrics.LOCK_INVERSIONS.value == inv0 + 1
+
+
+def test_inversion_counted_once_per_pair():
+    locktrace.set_enabled(True)
+    a = locktrace.make_lock("test.A1")
+    b = locktrace.make_lock("test.B1")
+
+    def pair(outer, inner):
+        def body():
+            with outer:
+                with inner:
+                    pass
+        return body
+
+    for _ in range(3):
+        _run(pair(a, b))
+        _run(pair(b, a))
+    assert locktrace.report()["lock_inversions"] == 1
+
+
+def test_consistent_order_is_silent():
+    locktrace.set_enabled(True)
+    a = locktrace.make_lock("test.A2")
+    b = locktrace.make_lock("test.B2")
+
+    def ab():
+        a.acquire()
+        b.acquire()
+        b.release()
+        a.release()
+
+    for _ in range(2):
+        _run(ab)
+    rep = locktrace.report()
+    assert rep["lock_inversions"] == 0
+    assert "test.A2 -> test.B2" in rep["edges"]
+
+
+def test_same_name_nesting_is_not_an_edge():
+    """Two instances of one lock class (two caches in one test process)
+    share a name; their nesting is not an ordering fact."""
+    locktrace.set_enabled(True)
+    a1 = locktrace.make_lock("test.same")
+    a2 = locktrace.make_lock("test.same")
+    with a1:
+        with a2:
+            pass
+    assert locktrace.report()["edges"] == []
+
+
+def test_three_lock_chain_detects_transitive_inversion():
+    locktrace.set_enabled(True)
+    a = locktrace.make_lock("test.A3")
+    b = locktrace.make_lock("test.B3")
+    c = locktrace.make_lock("test.C3")
+
+    def abc():
+        with a, b, c:
+            pass
+
+    def ca():
+        with c:
+            with a:
+                pass
+
+    _run(abc)
+    _run(ca)
+    assert locktrace.report()["lock_inversions"] == 1
+
+
+# -- long holds ---------------------------------------------------------
+
+def test_long_hold_fires_past_threshold():
+    locktrace.set_enabled(True)
+    locktrace.set_hold_threshold_ms(20.0)
+    lh0 = metrics.LOCK_LONG_HOLDS.value
+    lock = locktrace.make_lock("test.slow")
+    with lock:
+        time.sleep(0.05)
+    rep = locktrace.report()
+    assert rep["long_holds"] == 1
+    assert rep["long_hold_detail"][0]["lock"] == "test.slow"
+    assert rep["long_hold_detail"][0]["held_ms"] >= 20.0
+    assert metrics.LOCK_LONG_HOLDS.value == lh0 + 1
+
+
+def test_short_hold_is_silent():
+    locktrace.set_enabled(True)
+    lock = locktrace.make_lock("test.fast")
+    with lock:
+        pass
+    assert locktrace.report()["long_holds"] == 0
+
+
+def test_per_lock_hold_override():
+    """A capacity-serializing lock (the tenancy engine lock: hold time
+    IS the device solve) opts out of long-hold detection with
+    hold_ms=0; order tracking stays on."""
+    locktrace.set_enabled(True)
+    locktrace.set_hold_threshold_ms(10.0)
+    engine = locktrace.make_lock("test.engine", hold_ms=0)
+    state = locktrace.make_lock("test.state")
+    with engine:
+        with state:
+            pass
+        time.sleep(0.03)
+    rep = locktrace.report()
+    assert rep["long_holds"] == 0
+    assert "test.engine -> test.state" in rep["edges"]
+    slow = locktrace.make_lock("test.slowish", hold_ms=5)
+    with slow:
+        time.sleep(0.01)
+    assert locktrace.report()["long_holds"] == 1
+
+
+# -- RLock semantics ----------------------------------------------------
+
+def test_rlock_recursion_is_not_nesting():
+    locktrace.set_enabled(True)
+    r = locktrace.make_rlock("test.R")
+    other = locktrace.make_lock("test.O")
+    with r:
+        with r:     # re-entry: no self-edge, no double acquire count
+            with other:
+                pass
+    rep = locktrace.report()
+    assert rep["edges"] == ["test.R -> test.O"]
+    assert rep["acquires"] == 2  # one outermost R + one O
+
+
+def test_rlock_hold_measured_outermost():
+    locktrace.set_enabled(True)
+    locktrace.set_hold_threshold_ms(20.0)
+    r = locktrace.make_rlock("test.R2")
+    with r:
+        with r:
+            pass
+        time.sleep(0.05)
+    assert locktrace.report()["long_holds"] == 1
+
+
+# -- misc API -----------------------------------------------------------
+
+def test_traced_lock_nonblocking_and_locked():
+    locktrace.set_enabled(True)
+    lock = locktrace.make_lock("test.nb")
+    assert lock.acquire(blocking=False)
+    assert lock.locked()
+    got = []
+    _run(lambda: got.append(lock.acquire(blocking=False)))
+    assert got == [False]
+    assert locktrace.report()["acquires"] == 1  # failed tries don't count
+    lock.release()
+    assert not lock.locked()
+
+
+def test_reset_clears_evidence():
+    locktrace.set_enabled(True)
+    a = locktrace.make_lock("test.RST")
+    with a:
+        pass
+    assert locktrace.report()["acquires"] == 1
+    locktrace.reset()
+    rep = locktrace.report()
+    assert rep["acquires"] == 0 and rep["edges"] == []

@@ -253,6 +253,35 @@ def check_soak(artifacts: list[tuple[str, dict]] | None = None,
             f"{new_name}: {capacity['stranded_pending']} pod(s) "
             f"stranded pending after the near-capacity wave — the "
             f"scheduler never converged past the capacity 409s")
+    # Tenancy poison wave (run under KT_LOCKTRACE=1): beyond the lock
+    # columns below, the wave's own PR 12 contract holds — everything
+    # offered binds and the poisoned tenant re-promotes to device.
+    tp = new.get("tenancy_poison") or {}
+    if tp and tp.get("bound", 0) < tp.get("offered", 0):
+        problems.append(
+            f"{new_name}: tenancy poison wave bound only "
+            f"{tp.get('bound')}/{tp.get('offered')} pods — the "
+            f"per-tenant breaker/packer stopped converging")
+    if tp and not tp.get("repromoted", True):
+        problems.append(
+            f"{new_name}: the tenancy poison wave never re-promoted "
+            f"the poisoned tenant back to the device")
+    # Concurrency-discipline columns (KT_LOCKTRACE=1 over the churn
+    # run, the HA wave, and the tenancy poison wave): a lock-order
+    # inversion is a deadlock precondition and a long hold is a latency
+    # cliff — both ratchet to ZERO.  Artifacts predating locktrace
+    # carry no section and ratchet nothing.
+    lt = new.get("locktrace") or {}
+    if lt.get("lock_inversions"):
+        problems.append(
+            f"{new_name}: {lt['lock_inversions']} lock-order "
+            f"inversion(s) under KT_LOCKTRACE — a deadlock "
+            f"precondition (see locktrace.inversion_detail)")
+    if lt.get("long_holds"):
+        problems.append(
+            f"{new_name}: {lt['long_holds']} long lock hold(s) under "
+            f"KT_LOCKTRACE — a traced lock was held past the "
+            f"long-hold threshold (see locktrace.long_hold_detail)")
     if len(artifacts) >= 2:
         # Same backend-gate as the BENCH p50 row: wall-clock rows
         # re-baseline when the accelerator under the artifact changed —

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Knob-registry drift check (check_metrics-style, tier-1 via
+tests/test_ktlint.py): every ``KT_*`` name referenced in code must be
+declared in utils/knobs.py, every declared knob must be referenced
+somewhere (a dead knob is documentation of behavior that no longer
+exists), and the ARCHITECTURE.md "Configuration knobs" table must be
+byte-identical to the registry's rendering.
+
+Code side: ``KT_[A-Z0-9_]+`` literals under ``kubernetes_tpu/``,
+``tools/``, ``tests/`` and ``bench.py`` (tests count as references —
+a knob only tests exercise is still live).  Docs side: the table between
+the "## Configuration knobs" heading and the next section.
+
+Usage:
+    python tools/check_knobs.py            # exit 1 + diff on drift
+    python tools/check_knobs.py --render   # print the canonical table
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_KT_RE = re.compile(r"\bKT_[A-Z0-9_]+\b")
+# Undeclared names fail only when they appear in shipped code; tests
+# mint synthetic KT_ names for negative cases.  Test references still
+# count toward the dead-knob check (a knob only tests exercise is live).
+_STRICT_DIRS = ("kubernetes_tpu", "tools")
+_STRICT_FILES = ("bench.py",)
+_REFERENCE_DIRS = _STRICT_DIRS + ("tests",)
+_KNOBS_MODULE = os.path.join("kubernetes_tpu", "utils", "knobs.py")
+
+
+def _scan(dirs: tuple[str, ...], files: tuple[str, ...]) -> set[str]:
+    names: set[str] = set()
+    paths = [os.path.join(REPO, f) for f in files]
+    for d in dirs:
+        for dirpath, dirnames, fns in os.walk(os.path.join(REPO, d)):
+            dirnames[:] = [x for x in dirnames if x != "__pycache__"]
+            paths.extend(os.path.join(dirpath, fn) for fn in fns
+                         if fn.endswith(".py"))
+    for path in paths:
+        if os.path.relpath(path, REPO) == _KNOBS_MODULE:
+            continue  # declarations are not references
+        try:
+            with open(path) as f:
+                names.update(_KT_RE.findall(f.read()))
+        except OSError:
+            pass
+    return names
+
+
+def knobs_in_code() -> set[str]:
+    return _scan(_STRICT_DIRS, _STRICT_FILES)
+
+
+def knobs_referenced() -> set[str]:
+    return _scan(_REFERENCE_DIRS, _STRICT_FILES)
+
+
+def table_in_docs() -> str:
+    with open(os.path.join(REPO, "ARCHITECTURE.md")) as f:
+        text = f.read()
+    m = re.search(r"^## Configuration knobs$(.*?)(?=^## |\Z)", text,
+                  re.MULTILINE | re.DOTALL)
+    if m is None:
+        return ""
+    rows = [ln for ln in m.group(1).splitlines()
+            if ln.startswith("|")]
+    return "\n".join(rows) + ("\n" if rows else "")
+
+
+def main(argv=None) -> int:
+    from kubernetes_tpu.utils import knobs
+    rendered = knobs.render_table()
+    if argv and "--render" in argv:
+        sys.stdout.write(rendered)
+        return 0
+    declared = set(knobs.REGISTRY)
+    used = knobs_referenced()
+    problems = 0
+    undeclared = sorted(knobs_in_code() - declared)
+    if undeclared:
+        problems = 1
+        print("KT_* names in code but not declared in "
+              "utils/knobs.py:", file=sys.stderr)
+        for n in undeclared:
+            print(f"  {n}", file=sys.stderr)
+    dead = sorted(declared - used)
+    if dead:
+        problems = 1
+        print("declared knobs referenced nowhere in code/tests:",
+              file=sys.stderr)
+        for n in dead:
+            print(f"  {n}", file=sys.stderr)
+    docs = table_in_docs()
+    if not docs:
+        problems = 1
+        print("ARCHITECTURE.md has no '## Configuration knobs' table "
+              "(render one: python tools/check_knobs.py --render)",
+              file=sys.stderr)
+    elif docs != rendered:
+        problems = 1
+        print("ARCHITECTURE.md knob table drifted from the registry — "
+              "replace it with `python tools/check_knobs.py --render` "
+              "output", file=sys.stderr)
+        doc_names = set(re.findall(r"`(KT_[A-Z0-9_]+)`", docs))
+        for n in sorted(declared - doc_names):
+            print(f"  missing from docs: {n}", file=sys.stderr)
+        for n in sorted(doc_names - declared):
+            print(f"  in docs but undeclared: {n}", file=sys.stderr)
+    if not problems:
+        print(f"knob registry in sync ({len(declared)} knobs)")
+    return problems
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

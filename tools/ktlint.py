@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""kt-lint driver: AST-enforced device & concurrency discipline.
+
+Runs the rule families in kubernetes_tpu/analysis/ over the package
+tree and fails on any finding not in the committed baseline
+(tools/ktlint_baseline.json) — the zero-new-findings ratchet that
+tests/test_ktlint.py runs in tier-1.
+
+Usage:
+    python -m tools.ktlint                # text report, exit 1 on new
+    python -m tools.ktlint --json         # machine-readable report
+    python -m tools.ktlint --rules        # rule inventory
+    python -m tools.ktlint --lock-graph   # C01's extracted graph
+    python -m tools.ktlint --write-baseline   # grandfather current
+    python -m tools.ktlint PATH [PATH..]  # lint specific files
+
+Suppressions: ``# ktlint: disable=D01`` on the finding's line (for
+sites where the rule is wrong by construction).  The baseline is for
+real findings whose fix is out of scope — every entry carries a
+justification, and fixing the finding must remove the entry (stale
+entries fail the run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from kubernetes_tpu import analysis  # noqa: E402
+from kubernetes_tpu.analysis import core  # noqa: E402,F401 (registers rules)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="AST lint for device & concurrency discipline")
+    ap.add_argument("paths", nargs="*",
+                    help="specific files to lint (default: the "
+                         "kubernetes_tpu package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="JSON report on stdout")
+    ap.add_argument("--baseline", default=core.DEFAULT_BASELINE)
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather every current finding")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule inventory and exit")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="print C01's extracted lock graph and exit")
+    opts = ap.parse_args(argv)
+
+    if opts.rules:
+        for rid in sorted(core.RULES):
+            rule = core.RULES[rid]
+            print(f"{rid} [{rule.kind}] {rule.title}")
+        return 0
+
+    if opts.lock_graph:
+        project = core.load_project(REPO)
+        core.run_rules(project)
+        print(json.dumps(project.scratch.get("lock_graph", {}),
+                         indent=1))
+        return 0
+
+    paths = [os.path.abspath(p) for p in opts.paths] or None
+    result = core.run_project(REPO, baseline_path=opts.baseline,
+                              paths=paths)
+
+    if opts.write_baseline:
+        core.write_baseline(result.new + result.baselined,
+                            path=opts.baseline)
+        print(f"wrote {len(result.new) + len(result.baselined)} "
+              f"finding(s) to {opts.baseline} — JUSTIFY each entry")
+        return 0
+
+    if opts.as_json:
+        print(json.dumps({
+            "new": [f.to_json() for f in result.new],
+            "baselined": [f.to_json() for f in result.baselined],
+            "stale_baseline": result.stale_baseline,
+            "rules": sorted(core.RULES),
+        }, indent=1))
+    else:
+        for f in result.new:
+            print(f.text())
+        for fp in result.stale_baseline:
+            print(f"STALE baseline entry (finding fixed — remove it): "
+                  f"{fp}")
+        n_base = len(result.baselined)
+        if result.failed:
+            print(f"ktlint: {len(result.new)} new finding(s), "
+                  f"{len(result.stale_baseline)} stale baseline "
+                  f"entr(ies) ({n_base} grandfathered)",
+                  file=sys.stderr)
+        else:
+            print(f"ktlint: clean ({len(core.RULES)} rules, "
+                  f"{n_base} grandfathered finding(s))")
+    return 1 if result.failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
