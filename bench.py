@@ -74,6 +74,20 @@ def _joint_quality(n_nodes: int = 500, n_pods: int = 6000) -> dict:
     }
 
 
+def _xray_summary():
+    """{'hash', 'programs'} of the committed kt-xray shape manifest
+    (tools/shape_manifest.json) — stamped into BENCH/SOAK artifacts so a
+    compile-surface change is visible in the perf trajectory, and
+    ratcheted by tools/check_bench.py check_xray: a hash change between
+    consecutive artifacts without a manifest regeneration in the same
+    commit fails tier-1."""
+    try:
+        from kubernetes_tpu.analysis.xray import manifest_summary
+        return manifest_summary()
+    except Exception:  # noqa: BLE001 — stamping is additive
+        return None
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--profile-dir", default="",
@@ -258,6 +272,7 @@ def main(argv=None) -> None:
         from kubernetes_tpu.perf import soak as soak_mod
         try:
             soak = soak_mod.collect(quiet=True)
+            soak["xray"] = _xray_summary()
             soak_path = os.environ.get("BENCH_SOAK_OUT", "SOAK_r07.json")
             with open(soak_path, "w") as f:
                 json.dump(soak, f, indent=1)
@@ -344,6 +359,9 @@ def main(argv=None) -> None:
         # ratchet (tools/check_bench.py) re-baselines rather than
         # comparing p50 seconds across different devices.
         "backend": jax.default_backend(),
+        # Compile-surface manifest stamp (hash + program count): the
+        # perf row's provenance — which compile surface produced it.
+        "xray": _xray_summary(),
         "value": round(result.pods_per_second, 1),
         "unit": "pods/s",
         "vs_baseline": round(result.pods_per_second / baseline, 1),

@@ -10,14 +10,20 @@ at tier-1 time, before any chip is touched:
 * :mod:`kubernetes_tpu.analysis.core` — the framework: rule registry,
   per-line ``# ktlint: disable=RULE`` suppressions, committed baseline
   for grandfathered findings, text/JSON output;
-* :mod:`kubernetes_tpu.analysis.rules_device` — D01..D04 (import
-  layering, readback routing, jit purity, knob discipline);
+* :mod:`kubernetes_tpu.analysis.rules_device` — D01..D05 (import
+  layering, readback routing, jit purity, knob discipline, implicit
+  host syncs);
 * :mod:`kubernetes_tpu.analysis.rules_concurrency` — C01..C03 (static
   lock-order graph + cycle detection, the locktrace runtime companion,
-  thread-start registration).
+  thread-start registration);
+* :mod:`kubernetes_tpu.analysis.xray` — X01..X04, the semantic half:
+  the abstract-interpreted compile-surface manifest (NOT imported
+  here — it imports jax; its consumers are ``tools/ktxray.py``,
+  ``tools/check_manifest.py`` and tests/test_xray.py).
 
-Driver: ``python -m tools.ktlint`` (tests/test_ktlint.py runs it in
-tier-1 with a zero-new-findings ratchet).
+Drivers: ``python -m tools.ktlint`` and ``python -m tools.ktxray``
+(tests/test_ktlint.py / tests/test_xray.py run them in tier-1 with
+zero-new-findings ratchets).
 """
 
 from kubernetes_tpu.analysis.core import (Finding, Project, RULES,  # noqa: F401

@@ -1,4 +1,4 @@
-"""Device-discipline rules D01..D04.
+"""Device-discipline rules D01..D05.
 
 The PR 10 guarantee — "forward progress with NO device participation"
 when the breaker is open — and the PR 4/PR 8 warm-path guarantees — "no
@@ -18,12 +18,16 @@ from kubernetes_tpu.analysis.core import Module, Rule
 # — scheduler daemon, cache, apiserver, clients, controllers, tenancy
 # policy, the host fallback's callers — must stay importable and
 # runnable on a machine with no accelerator runtime at all.
+# analysis/xray.py is allowlisted for jax.eval_shape/make_jaxpr only:
+# it is imported by tools/tests, never by a daemon, and touches no
+# device (abstract interpretation is its whole point).
 DEVICE_ALLOWED = (
     "kubernetes_tpu/engine/",
     "kubernetes_tpu/ops/",
     "kubernetes_tpu/parallel/",
     "kubernetes_tpu/perf/",
     "kubernetes_tpu/utils/profiling.py",
+    "kubernetes_tpu/analysis/xray.py",
 )
 
 _DEVICE_ROOTS = {"jax", "jaxlib"}
@@ -278,3 +282,87 @@ Rule("D04", "KT_* knobs resolve through the utils/knobs.py registry; "
      doc="Scattered env reads drift from docs and re-read mid-run; "
          "the registry is the single source and hot paths read knobs "
          "only at init.")
+
+
+# D05: implicit host syncs — the dataflow-lite complement to kt-xray's
+# jaxpr rule X01.  X01 proves no callback primitive hides INSIDE a
+# compiled program; D05 catches the host-side half: a device value that
+# escapes the engine and then gets materialized by `.item()`,
+# `bool()/int()/float()`, or `np.asarray()` is a blocking
+# device->host sync outside the accounted/gated readback sites.
+# Tracking is deliberately coarse (names assigned anywhere in the
+# module from a device-returning engine call), which is fine for a
+# tripwire: the engine's public surface returns HOST values, so the
+# real tree is clean, and any future leak trips either the assignment
+# tracker or the unconditional `.item()` check.
+_D05_DEVICE_RETURNING = {
+    "solve_sequential", "solve_sequential_packed", "solve_joint",
+    "_solve_scan", "victim_solve", "device_put", "_planes_kernel",
+    "spread_planes", "select_hosts",
+}
+# evaluate/masks return device arrays only on the DEVICE solver; the
+# host fallback's identically-named surface returns numpy.  Flag them
+# only when the receiver chain names the device solver.
+_D05_SOLVER_METHODS = {"evaluate", "masks"}
+_D05_SINK_CASTS = {"bool", "int", "float"}
+_D05_ASARRAY = {"np.asarray", "numpy.asarray", "jnp.asarray"}
+
+
+def _d05_device_call(name: str) -> bool:
+    if not name:
+        return False
+    parts = name.split(".")
+    if parts[-1] in _D05_DEVICE_RETURNING:
+        return True
+    return parts[-1] in _D05_SOLVER_METHODS and "solver" in parts[:-1]
+
+
+def _check_d05(module: Module) -> list:
+    if _device_allowed(module.path):
+        return []
+    tracked: set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _d05_device_call(core.call_name(node.value)):
+            for tgt in node.targets:
+                elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                    else [tgt]
+                tracked.update(e.id for e in elts
+                               if isinstance(e, ast.Name))
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "item" and not node.args:
+            out.append(module.finding(
+                "D05", node,
+                ".item() is a blocking device->host sync: route "
+                "through engine readback sites (checked_readback / "
+                "devicestats-recorded)"))
+            continue
+        name = core.call_name(node)
+        arg = node.args[0] if node.args else None
+        if not isinstance(arg, ast.Name) or arg.id not in tracked:
+            continue
+        if name in _D05_SINK_CASTS and len(node.args) == 1:
+            out.append(module.finding(
+                "D05", node,
+                f"{name}() on engine-returned device value "
+                f"'{arg.id}': implicit host sync outside "
+                f"checked_readback/devicestats"))
+        elif name in _D05_ASARRAY:
+            out.append(module.finding(
+                "D05", node,
+                f"{name}() on engine-returned device value "
+                f"'{arg.id}': implicit host sync outside "
+                f"checked_readback/devicestats"))
+    return out
+
+
+Rule("D05", "no implicit host syncs on engine-returned device values "
+     "outside engine readback sites", check=_check_d05,
+     doc=".item(), bool()/int()/float(), and np.asarray() on device "
+         "values are unaccounted blocking syncs — the host-side "
+         "complement of kt-xray's X01 jaxpr rule.")

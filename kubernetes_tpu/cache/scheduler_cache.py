@@ -19,11 +19,14 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import TYPE_CHECKING, Callable, Optional
 
 from kubernetes_tpu.api import types as api
 from kubernetes_tpu.features import compiler as fc
 from kubernetes_tpu.utils import locktrace
+
+if TYPE_CHECKING:  # jax-free at runtime: cache stays device-importless
+    from kubernetes_tpu.engine.workloads.preemption import VictimTable
 
 def _locked(fn):
     """Serialize public cache methods on self.lock (cache.go mutex)."""
@@ -173,7 +176,8 @@ class SchedulerCache:
 
     @_locked
     def assume_pods(self, assignments: list[tuple[api.Pod, str]],
-                    strict: bool = True, agg_handoff=None) -> list[str]:
+                    strict: bool = True,
+                    agg_handoff: Optional[tuple] = None) -> list[str]:
         """Bulk AssumePod for a solved batch: same state machine as
         assume_pod, with the tensor updates vectorized (the per-pod path is
         O(pods x numpy-call overhead) at 30k-pod batches).
@@ -255,7 +259,8 @@ class SchedulerCache:
         del self._pod_states[key]
 
     @_locked
-    def forget_pods_matching(self, pred) -> list[str]:
+    def forget_pods_matching(self, pred: Callable[[api.Pod], bool]
+                             ) -> list[str]:
         """Forget every ASSUMED pod whose object matches ``pred`` — the
         shard-handoff release (scheduler/shards.py): an incarnation that
         lost a shard's lease drops its optimistic assumes there in one
@@ -519,14 +524,15 @@ class SchedulerCache:
                     out[i][dom] = out[i].get(dom, 0) + 1
         return out
 
-    def topo_domain_counts(self, namespace: str, selector,
+    def topo_domain_counts(self, namespace: str, selector: object,
                            key_col: int) -> dict[int, int]:
         """Single-term convenience over the bulk walk."""
         return self.topo_domain_counts_bulk(
             [(namespace, selector, key_col)])[0]
 
     @_locked
-    def victim_table(self, max_victims: int, exclude: frozenset = frozenset()):
+    def victim_table(self, max_victims: int,
+                     exclude: frozenset = frozenset()) -> "VictimTable":
         """Per-node victim candidates for the preemption solve: every
         tracked pod (assumed or confirmed — both hold capacity), sorted
         ascending by (priority, key) so the kernel's prefix-k IS the k
@@ -584,7 +590,7 @@ class SchedulerCache:
                 for key, st in self._pod_states.items()]
 
     @_locked
-    def recompute_aggregates(self):
+    def recompute_aggregates(self) -> tuple:
         """Rebuild (requested, nonzero) from scratch out of the tracked
         pod set — the ground truth the incremental assume/forget deltas
         must equal.  Returns (requested, nonzero) numpy arrays aligned

@@ -35,6 +35,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -66,7 +67,8 @@ class Verifier:
     returning the apiserver's pod dicts (the factory passes
     ``lambda: store.list("pods")[0]``)."""
 
-    def __init__(self, cache, resident=None, truth=None,
+    def __init__(self, cache: object, resident: object = None,
+                 truth: Optional[Callable[[], list]] = None,
                  sample: int = DEFAULT_SAMPLE, heal: bool = True,
                  grace_s: float = APISERVER_GRACE_S, seed: int = 0):
         self.cache = cache

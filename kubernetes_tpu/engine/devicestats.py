@@ -46,6 +46,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import traceback
+from typing import Callable, Iterator
 
 from kubernetes_tpu.utils import metrics
 from kubernetes_tpu.utils.logging import get_logger
@@ -63,7 +64,7 @@ _tls = threading.local()    # .path — the live path compiling right now
 
 # -- transfer accounting -----------------------------------------------------
 
-def nbytes(tree) -> int:
+def nbytes(tree: object) -> int:
     """Total array bytes of a pytree-ish value (NamedTuple / list /
     tuple / dict of numpy or jax arrays)."""
     if tree is None:
@@ -233,7 +234,7 @@ def post_prewarm_compiles() -> int:
 
 
 @contextlib.contextmanager
-def watchdog_window():
+def watchdog_window() -> Iterator[Callable[[], int]]:
     """Arm for the duration of a measured window (benches, tests) and
     yield a callable returning the compiles observed inside it."""
     before = post_prewarm_compiles()
@@ -247,7 +248,7 @@ def watchdog_window():
 
 
 @contextlib.contextmanager
-def live_path(name: str):
+def live_path(name: str) -> Iterator[None]:
     """Declare the live path (stream/oneshot/joint/single_pod/...) for
     compiles fired from this thread — the watchdog's ``path`` label."""
     prev = getattr(_tls, "path", None)

@@ -15,6 +15,7 @@ import os
 import sys
 import time
 from dataclasses import dataclass, field
+from typing import Iterator, Optional
 
 import jax
 import jax.numpy as jnp
@@ -591,7 +592,7 @@ class GenericScheduler:
         names = nt.names
         return [names[c] if c >= 0 else None for c in rows]
 
-    def take_agg_handoff(self):
+    def take_agg_handoff(self) -> Optional[tuple]:
         """One-shot: the (generation, requested, nonzero) handoff from the
         last schedule_batch, if any (see assume_pods)."""
         h = getattr(self, "_agg_handoff", None)
@@ -760,7 +761,7 @@ class GenericScheduler:
 
     def schedule_batch_stream(self, pods: list[api.Pod],
                               chunk_size: int = 2048,
-                              defer_readback: bool = False):
+                              defer_readback: bool = False) -> Iterator:
         """Pipelined batched drain: one host compile, then the scan runs in
         equal-shaped chunks with device-carried state (identical choices to
         ``schedule_batch`` — each chunk continues the previous chunk's

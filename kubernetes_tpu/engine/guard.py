@@ -50,6 +50,7 @@ import contextlib
 import os
 import threading
 import time
+from typing import Callable, Iterator, Optional
 
 import numpy as np
 
@@ -137,7 +138,8 @@ class DeviceGuard:
     rejected-batch memory).  Thread-safe: the drain thread, the commit
     worker, and the single-pod path all cross it."""
 
-    def __init__(self, evict_fn=None, ladder_fn=None):
+    def __init__(self, evict_fn: Optional[Callable[[], None]] = None,
+                 ladder_fn: Optional[Callable[[], list[int]]] = None):
         self.enabled = knobs.get_bool("KT_GUARD")
         # Consecutive same-kind faults before the breaker trips to host.
         self.breaker_threshold = knobs.get_int("KT_GUARD_BREAKER")
@@ -309,7 +311,7 @@ class DeviceGuard:
     # -- the solve-site wrapper -------------------------------------------
 
     @contextlib.contextmanager
-    def suppressed(self):
+    def suppressed(self) -> Iterator[None]:
         """Turn chaos injection off for a scope.  The prewarm ladder
         runs the SAME solve sites as live drains but has no recovery
         ladder above it — a KT_CHAOS_DEVICE cadence firing mid-warmup
@@ -324,7 +326,7 @@ class DeviceGuard:
             self._suppress = prev
 
     @contextlib.contextmanager
-    def watch(self, path: str, inject: bool = True):
+    def watch(self, path: str, inject: bool = True) -> Iterator[None]:
         """Wrap one device interaction: chaos injection on entry (only
         at the solve LAUNCH sites — ``inject=False`` marks
         compile/readback wrappers that classify real faults but don't
@@ -362,9 +364,12 @@ class DeviceGuard:
 
     # -- the post-solve sanity gate ---------------------------------------
 
-    def checked_readback(self, path: str, rows, n_nodes: int,
-                         live=None, alloc=None, requests=None,
-                         keys_fn=None, spot_k: int = 16) -> np.ndarray:
+    def checked_readback(self, path: str, rows: np.ndarray, n_nodes: int,
+                         live: Optional[np.ndarray] = None,
+                         alloc: Optional[np.ndarray] = None,
+                         requests: Optional[np.ndarray] = None,
+                         keys_fn: Optional[Callable[[], list[str]]] = None,
+                         spot_k: int = 16) -> np.ndarray:
         """Validate an assignment readback before anything commits.
 
         ``rows`` is the choices vector (or the packed vector's choices
@@ -429,7 +434,8 @@ class DeviceGuard:
                     self._rejected_keys.difference_update(keys_fn())
         return choices.astype(np.int32, copy=False)
 
-    def checked_scores(self, path: str, feasible, scores):
+    def checked_scores(self, path: str, feasible: object,
+                       scores: object) -> tuple:
         """The single-pod gate: evaluation planes must be finite (a NaN
         score would argmax into garbage)."""
         if not self.enabled:

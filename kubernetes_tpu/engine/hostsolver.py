@@ -40,6 +40,10 @@ than the device scan at density scale, and always available.
 from __future__ import annotations
 
 import numpy as np
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:
+    from kubernetes_tpu.engine import solver as sv
 
 from kubernetes_tpu.features.compiler import RES_CPU, RES_MEM, RES_PODS
 
@@ -83,7 +87,7 @@ class HostSolver:
     TRACKED_PRIORITIES = ("LeastRequestedPriority", "MostRequestedPriority",
                           "BalancedResourceAllocation")
 
-    def __init__(self, solver):
+    def __init__(self, solver: "sv.Solver"):
         self.solver = solver  # the compiled-policy Solver (names/weights)
 
     # -- predicate masks (batch-start state) -------------------------------
@@ -168,7 +172,8 @@ class HostSolver:
         ok = (total <= f32(max_volumes)) & ~node_err[None, :]
         return (new[:, None] == 0) | ok
 
-    def masks(self, b, c) -> dict[str, np.ndarray]:
+    def masks(self, b: "sv.DeviceBatch", c: "sv.DeviceCluster"
+              ) -> dict[str, np.ndarray]:
         """Per-predicate [P,N] masks against batch-start state (the
         FitError / failure-detail surface, mirroring Solver.masks)."""
         n = int(np.asarray(c.alloc).shape[0])
@@ -295,7 +300,8 @@ class HostSolver:
 
     # -- the evaluate / solve surface ---------------------------------------
 
-    def evaluate(self, b, c) -> tuple[np.ndarray, np.ndarray]:
+    def evaluate(self, b: "sv.DeviceBatch", c: "sv.DeviceCluster"
+                 ) -> tuple[np.ndarray, np.ndarray]:
         """(feasible [P,N], scores [P,N]) against batch-start state —
         the host mirror of Solver.evaluate."""
         n = int(np.asarray(c.alloc).shape[0])
@@ -332,8 +338,11 @@ class HostSolver:
         score = _trunc(10.0 - np.abs(cf - mf) * 10.0)
         return np.where((cf >= 1.0) | (mf >= 1.0), 0.0, score)
 
-    def solve_greedy(self, b, c, last_node_index: int,
-                     live=None, extra_mask=None, score_bias=None
+    def solve_greedy(self, b: "sv.DeviceBatch", c: "sv.DeviceCluster",
+                     last_node_index: int,
+                     live: Optional[np.ndarray] = None,
+                     extra_mask: Optional[np.ndarray] = None,
+                     score_bias: Optional[np.ndarray] = None
                      ) -> tuple[np.ndarray, int]:
         """Sequential greedy placement with in-batch visibility for the
         tracked families — the host mirror of ``Solver._solve_scan``'s

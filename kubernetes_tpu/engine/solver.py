@@ -175,7 +175,7 @@ class BatchFlags(NamedTuple):
     any_saa: bool             # saa_src content (placements move peer counts)
 
 
-def batch_flags(b) -> BatchFlags:
+def batch_flags(b: "PodBatch | DeviceBatch") -> BatchFlags:
     """Derive BatchFlags from a PodBatch (host numpy — call before
     device transfer; also works on a DeviceBatch at the cost of syncs)."""
     a, vs = b.aff, b.volsvc
@@ -346,7 +346,7 @@ class ResidentCluster:
         return self.dc is not None and self._epoch == epoch and \
             self._sig == self.signature(nt, space)
 
-    def readback_rows(self, idx) -> dict:
+    def readback_rows(self, idx: "np.ndarray | list[int]") -> dict:
         """Device→host readback of the verifier's sampled rows: the four
         resource-truth fields the dirty-row protocol must keep equal to
         the host arrays.  One gather per field, k rows each — cheap at
@@ -376,8 +376,30 @@ class ResidentCluster:
                 return DeviceCluster(*[arr.at[idx].set(new)
                                        for arr, new in zip(c, rows)])
 
+            # kt-xray: no-donate(prior DeviceCluster may be aliased by an
+            # in-flight drain; see the comment above)
             self._scatter = jax.jit(scatter)
         return self._scatter
+
+    @staticmethod
+    def scatter_buckets(n: int, max_rows: int | None = None) -> list[int]:
+        """The pow2 dirty-row buckets the scatter kernel can compile at
+        for an ``n``-row cluster — reachability is bounded by ``sync``'s
+        own rule (dirty * FULL_FRACTION >= n takes the full upload), so
+        this is the exact shape set ``prewarm_scatter`` traces AND the
+        set the kt-xray manifest must cover (one definition, two
+        consumers — they cannot drift)."""
+        limit = (max(n - 1, 1)) // ResidentCluster.FULL_FRACTION
+        if limit < 1:
+            return []
+        limit = 1 << (limit - 1).bit_length() if limit > 1 else 1
+        if max_rows is not None:
+            limit = min(limit, max_rows)
+        out, k = [], 1
+        while k <= limit:
+            out.append(k)
+            k <<= 1
+        return out
 
     def prewarm_scatter(self, max_rows: int | None = None) -> int:
         """Trace the dirty-row scatter kernel at EVERY reachable pow2
@@ -395,17 +417,11 @@ class ResidentCluster:
             return 0
         n = int(self.dc.alloc.shape[0])
         # sync() only scatters when dirty * FULL_FRACTION < N; larger
-        # dirty sets take the full upload, so their shapes are unreachable.
-        limit = (max(n - 1, 1)) // self.FULL_FRACTION
-        if limit < 1:
-            return 0
-        limit = 1 << (limit - 1).bit_length() if limit > 1 else 1
-        if max_rows is not None:
-            limit = min(limit, max_rows)
+        # dirty sets take the full upload, so their shapes are
+        # unreachable (ResidentCluster.scatter_buckets is that rule).
         scatter = self._scatter_fn()
         traced = 0
-        k = 1
-        while k <= limit:
+        for k in self.scatter_buckets(n, max_rows):
             idx = np.zeros(k, np.int32)
             rows = DeviceCluster(*[
                 np.repeat(np.asarray(arr[:1]), k, axis=0)
@@ -413,7 +429,6 @@ class ResidentCluster:
             idx_d, rows_d = jax.device_put((idx, rows))
             scatter(self.dc, idx_d, rows_d).alloc.block_until_ready()
             traced += 1
-            k <<= 1
         return traced
 
     def sync(self, nt: NodeTensors, agg: NodeAggregates,
@@ -659,6 +674,8 @@ class Solver:
 
     # -- one-shot batched evaluation ------------------------------------
 
+    # kt-xray: no-donate(inputs are the resident cluster + a batch the
+    # caller re-reads for evaluate in the same decision)
     @functools.partial(jax.jit, static_argnums=(0,))
     def masks(self, b: DeviceBatch, c: DeviceCluster) -> dict[str, jnp.ndarray]:
         """Per-predicate [P,N] masks (for Filter verbs / failure reporting)."""
@@ -666,6 +683,8 @@ class Solver:
         return {name: _predicate_mask(name, b, c, n, self.extra)
                 for name in self.predicate_names}
 
+    # kt-xray: no-donate(c is the shared resident cluster; b is re-used
+    # by the failure-detail masks pass)
     @functools.partial(jax.jit, static_argnums=(0, 3))
     def evaluate(self, b: DeviceBatch, c: DeviceCluster,
                  flags: BatchFlags = ALL_ON_FLAGS
@@ -754,6 +773,8 @@ class Solver:
             vol_any=final.get("vol_any", c.vol_any),
             vol_rw=final.get("vol_rw", c.vol_rw))
 
+    # kt-xray: no-donate(c and the carry alias the resident mirror and
+    # the previous chunk's state, both read by overlapping chunks)
     @functools.partial(jax.jit, static_argnums=(0, 5))
     def _solve_scan(self, b: DeviceBatch, c: DeviceCluster,
                     last_node_index: jnp.ndarray, score_bias: jnp.ndarray,
@@ -1070,6 +1091,8 @@ class Solver:
 
     # -- joint batched assignment (the LP-relaxed global solve) ----------
 
+    # kt-xray: no-donate(b/c flow on into the repair scan of the same
+    # joint solve)
     @functools.partial(jax.jit, static_argnums=(0, 3))
     def _price_iterate(self, b: DeviceBatch, c: DeviceCluster,
                        n_iters: int,
@@ -1132,6 +1155,8 @@ class Solver:
             (20.0 * score_span) + jnp.where(jnp.isfinite(regret), regret, 0.0)
         return -cost, key
 
+    # kt-xray: no-donate(c is the shared resident cluster; donation
+    # would invalidate it for the next drain's scatter)
     @functools.partial(jax.jit, static_argnums=(0, 7, 8))
     def _solve_joint_jit(self, b: DeviceBatch, c: DeviceCluster,
                          last_node_index: jnp.ndarray,

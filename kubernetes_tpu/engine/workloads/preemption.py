@@ -66,6 +66,8 @@ class PreemptionDecision:
     prio_cost: int = 0
 
 
+# kt-xray: no-donate(alloc/requested/victim tables are host-built per
+# decision and re-read by the next decision's overlay)
 @functools.partial(jax.jit)
 def victim_solve(alloc: jnp.ndarray, requested: jnp.ndarray,
                  base_ok: jnp.ndarray, vic_req: jnp.ndarray,

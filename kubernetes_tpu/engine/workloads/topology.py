@@ -77,7 +77,7 @@ def spread_topology_keys(pods: Sequence) -> set[str]:
     return keys
 
 
-def compile_terms(pods: Sequence, nt, space,
+def compile_terms(pods: Sequence, nt: object, space: object,
                   domain_counts_bulk: Callable[[list],
                                                list[dict[int, int]]]
                   ) -> Optional[SpreadTerms]:
@@ -146,6 +146,8 @@ def compile_terms(pods: Sequence, nt, space,
                        any_soft=bool((~hard[: len(rows)]).any()))
 
 
+# kt-xray: no-donate(topo_dom is a column of the shared resident
+# cluster; term tables are host numpy re-used across solve paths)
 @functools.partial(jax.jit)
 def _planes_kernel(key_col: jnp.ndarray, max_skew: jnp.ndarray,
                    hard: jnp.ndarray, counts: jnp.ndarray,
@@ -185,7 +187,7 @@ def spread_planes(terms: SpreadTerms, topo_dom: jnp.ndarray
             score if terms.any_soft else None)
 
 
-def spread_planes_host(terms: SpreadTerms, topo_dom
+def spread_planes_host(terms: SpreadTerms, topo_dom: "np.ndarray"
                        ) -> tuple[Optional["np.ndarray"],
                                   Optional["np.ndarray"]]:
     """``spread_planes`` in pure NumPy — the host fallback engine

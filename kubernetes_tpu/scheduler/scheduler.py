@@ -59,6 +59,61 @@ log = get_logger("daemon")
 DEFAULT_SCHEDULER_NAME = api.DEFAULT_SCHEDULER_NAME
 
 
+def bucket_ladder(floor: int, stream_threshold: int, pad_limit: int,
+                  stream_chunk: int = 0) -> list[int]:
+    """The fixed set of chunk sizes a daemon's drains can compile at,
+    as a pure function of its configuration — shared by the live
+    ``Scheduler.effective_ladder`` and the kt-xray compile-surface
+    manifest (analysis/xray.py), so the static proof and the runtime
+    warmup can never disagree about the ladder.  Two sources: the
+    stream chunk, included only when the chunked path is reachable
+    (``stream_threshold`` set — at its unset sentinel every large drain
+    takes the one-shot path); and the small-drain buckets: the floor
+    itself (possibly non-pow2) plus each pow2 strictly above it up to
+    the pow2 ceiling of the largest small drain."""
+    ladder: set[int] = set()
+    if stream_threshold < (1 << 62):
+        ladder.add(stream_chunk or min(stream_threshold, 8192))
+    small_top = min(stream_threshold, pad_limit)
+    if small_top > 1:
+        floor = max(floor, 1)
+        # pow2 ceiling of the largest small drain (small_top - 1).
+        top_bucket = 1 << max(small_top - 2, 0).bit_length()
+        ladder.add(floor)
+        # Mintable buckets are max(pow2ceil(len), floor): the floor,
+        # then pow2 values strictly above it — doubling the floor
+        # itself would trace unreachable shapes when it is not a
+        # power of two (floor=300 mints {300, 512, ...}, never 600).
+        b = 1 << floor.bit_length()  # smallest pow2 > floor
+        while b <= top_bucket:
+            ladder.add(b)
+            b <<= 1
+    return sorted(ladder)
+
+
+def prewarm_plan(ladder: list[int], scatter_rows: list[int],
+                 joint: bool = True, preempt: bool = True,
+                 topo: bool = True) -> list[str]:
+    """The static trace plan: every program key ``prewarm()`` traces
+    for a given ladder, WITHOUT touching a device.  kt-xray's X04 rule
+    pins the committed shape manifest's warmed-program set against the
+    canonical instantiation of this plan, which makes "no live drain
+    compiles after prewarm" a parse-time theorem (the PR 9 recompile
+    watchdog stays armed as the runtime backstop).  Program keys match
+    ``kubernetes_tpu/analysis/xray.py`` program names."""
+    progs = [f"scan_first@{b}" for b in ladder]
+    progs += [f"scan_carry@{b}" for b in ladder]
+    progs += ["single_evaluate@1", "select_hosts@1"]
+    progs += [f"scatter@{r}" for r in scatter_rows]
+    if preempt:
+        progs.append("victim_solve")
+    if topo and ladder:
+        progs += ["topo_planes", f"oneshot_topo@{min(ladder)}"]
+    if joint and ladder:
+        progs.append(f"joint@{min(ladder)}")
+    return sorted(progs)
+
+
 @dataclass
 class SchedulerConfig:
     """The reference's scheduler.Config (scheduler.go:46-77)."""
@@ -532,25 +587,32 @@ class Scheduler:
         at or below it pads to it) plus each pow2 ABOVE the floor up to
         the pow2 ceiling of the largest such drain (4096 included: a
         2049-4095-pod drain legally mints it even when the stream chunk
-        is smaller)."""
-        ladder = set()
-        if self.STREAM_THRESHOLD < (1 << 62):
-            ladder.add(self.stream_chunk_size())
-        small_top = min(self.STREAM_THRESHOLD, self._PAD_LIMIT)
-        if small_top > 1:
-            floor = max(self.stream_min_bucket, 1)
-            # pow2 ceiling of the largest small drain (small_top - 1).
-            top_bucket = 1 << max(small_top - 2, 0).bit_length()
-            ladder.add(floor)
-            # Mintable buckets are max(pow2ceil(len), floor): the floor,
-            # then pow2 values strictly above it — doubling the floor
-            # itself would trace unreachable shapes when it is not a
-            # power of two (floor=300 mints {300, 512, ...}, never 600).
-            b = 1 << floor.bit_length()  # smallest pow2 > floor
-            while b <= top_bucket:
-                ladder.add(b)
-                b <<= 1
-        return sorted(ladder)
+        is smaller).  The computation itself is the module-level
+        ``bucket_ladder`` so the kt-xray manifest shares it."""
+        return bucket_ladder(self.stream_min_bucket, self.STREAM_THRESHOLD,
+                             self._PAD_LIMIT, self.stream_chunk)
+
+    def prewarm_plan(self) -> list[str]:
+        """The program keys ``prewarm()`` will trace for THIS daemon's
+        configuration — static introspection, no device, no compile.
+        Mirrors ``prewarm()``'s own no-op conditions (StreamingDrain
+        gate off, extenders configured, empty cluster -> []), so the
+        report is honest exactly where the watchdog matters.  kt-xray
+        compares the canonical-config instantiation against the
+        committed shape manifest (rule X04); this instance method is
+        the live-daemon view (tests pin it against the manifest for
+        the default config)."""
+        from kubernetes_tpu.engine.solver import ResidentCluster
+        from kubernetes_tpu.utils.featuregate import DEFAULT_FEATURE_GATE
+        alg = self.config.algorithm
+        if not DEFAULT_FEATURE_GATE.enabled("StreamingDrain") or \
+                alg.extenders or not alg.cache.nodes():
+            return []
+        ladder = self.effective_ladder()
+        return prewarm_plan(
+            ladder, ResidentCluster.scatter_buckets(len(alg.cache.nodes())),
+            joint=DEFAULT_FEATURE_GATE.enabled("JointSolver"),
+            preempt=DEFAULT_FEATURE_GATE.enabled("Preemption"))
 
     def prewarm(self, sample_pods: Optional[list] = None) -> dict:
         """Trace the full bucket ladder before the queue opens, so no

@@ -9,6 +9,7 @@ import queue as _queue
 import threading
 import time
 from dataclasses import dataclass
+from typing import Callable, Optional
 
 
 @dataclass(frozen=True)
@@ -21,7 +22,8 @@ class Event:
 
 
 class EventRecorder:
-    def __init__(self, max_events: int = 4096, sink=None):
+    def __init__(self, max_events: int = 4096,
+                 sink: Optional[Callable] = None):
         self._events: collections.deque[Event] = collections.deque(
             maxlen=max_events)
         self._lock = threading.Lock()
@@ -61,7 +63,8 @@ class EventRecorder:
 _SINK_CLOSED = object()
 
 
-def async_sink(sink, max_pending: int = 8192, batch_sink=None):
+def async_sink(sink: Optional[Callable], max_pending: int = 8192,
+               batch_sink: Optional[Callable] = None) -> Callable:
     """Wrap a sink so posting never blocks the scheduling loop: events go
     through a bounded queue drained by one background thread, and overflow
     is DROPPED — the reference's event broadcaster behaves exactly this

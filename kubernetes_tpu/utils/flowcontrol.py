@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 import time
+from typing import Callable
 
 
 class TokenBucketRateLimiter:
@@ -22,7 +23,8 @@ class TokenBucketRateLimiter:
     """
 
     def __init__(self, qps: float, burst: int,
-                 now=time.monotonic, sleep=time.sleep):
+                 now: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
         self.qps = qps
         self.burst = max(burst, 1)
         self._now = now

@@ -89,7 +89,7 @@ class APIResourceLock:
     in the ``control-plane.alpha.kubernetes.io/leader`` annotation of an
     Endpoints object, CAS'd on resourceVersion."""
 
-    def __init__(self, client, kind: str = "endpoints",
+    def __init__(self, client: object, kind: str = "endpoints",
                  name: str = "kube-scheduler",
                  namespace: str = "kube-system"):
         # Endpoints is a namespaced kind: the lock object lives at

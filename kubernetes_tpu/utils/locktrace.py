@@ -256,6 +256,7 @@ def make_lock(name: str, hold_ms: Optional[float] = None) -> LockLike:
         else threading.Lock()
 
 
-def make_rlock(name: str, hold_ms: Optional[float] = None):
+def make_rlock(name: str, hold_ms: Optional[float] = None
+               ) -> "threading.RLock | TracedRLock":
     return TracedRLock(name, hold_ms=hold_ms) if _enabled \
         else threading.RLock()

@@ -12,6 +12,7 @@ from __future__ import annotations
 import logging
 import os
 import sys
+import typing
 
 VERBOSE = 5  # below DEBUG(10): glog V>=5 territory
 logging.addLevelName(VERBOSE, "VERBOSE")
@@ -20,7 +21,8 @@ _ROOT = "kubernetes_tpu"
 _configured = False
 
 
-def configure(v: int | None = None, stream=sys.stderr) -> None:
+def configure(v: int | None = None,
+              stream: typing.TextIO = sys.stderr) -> None:
     """Wire the package root logger once (the daemon entry calls this;
     library users configure logging themselves)."""
     global _configured

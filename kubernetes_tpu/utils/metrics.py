@@ -29,7 +29,7 @@ from __future__ import annotations
 import threading
 import time
 from bisect import bisect_left
-from typing import Iterable
+from typing import Callable, Iterable, TypeVar
 
 from kubernetes_tpu.utils import locktrace
 
@@ -66,7 +66,7 @@ class _Family:
         self._lock = locktrace.make_lock(
             f"metrics.{type(self).__name__}")
 
-    def labels(self, **kw):
+    def labels(self, **kw: str) -> object:
         """The child metric for this label set (created on first use).
         The steady-state lookup is a lock-free dict read (GIL-atomic) —
         the drain loop resolves a child per stage observation, and a lock
@@ -354,7 +354,7 @@ class Gauge(_Family):
     def _make_child(self, key) -> "Gauge":
         return Gauge(self.name, self.help)
 
-    def set_fn(self, fn) -> None:
+    def set_fn(self, fn: Callable[[], float]) -> None:
         with self._lock:
             self._fn = fn
 
@@ -420,11 +420,13 @@ def exponential_buckets(start: float, factor: float, count: int) -> list[float]:
 # accumulate (the reference's prometheus.MustRegister default-registry
 # shape).
 
+T = TypeVar("T")
+
 _REGISTRY: list = []
 _REGISTRY_LOCK = locktrace.make_lock("metrics.registry")
 
 
-def register(metric):
+def register(metric: "T") -> "T":
     """Add a metric to the default registry; returns it for assignment."""
     with _REGISTRY_LOCK:
         _REGISTRY.append(metric)

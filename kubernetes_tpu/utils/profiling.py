@@ -16,6 +16,7 @@ import contextlib
 import sys
 import threading
 import traceback
+from typing import Iterator
 
 from kubernetes_tpu.utils import knobs
 
@@ -27,7 +28,7 @@ def set_profile_dir(path: str) -> None:
 
 
 @contextlib.contextmanager
-def device_trace(label: str):
+def device_trace(label: str) -> Iterator[None]:
     """jax.profiler trace around a device solve when profiling is enabled
     (no-op — zero overhead — otherwise)."""
     if not _PROFILE_DIR[0]:

@@ -45,7 +45,7 @@ DEFAULT_PERIOD_S = 5.0
 DEFAULT_CAPACITY = 720
 
 
-def flatten(metric) -> dict[str, float]:
+def flatten(metric: object) -> dict[str, float]:
     """One metric object -> {exposition-style sample name: value}.
     Histograms flatten to ``_count``/``_sum`` (bucket vectors belong to
     /metrics; the ring charts trends, and mean latency per tick falls

@@ -36,6 +36,7 @@ import os
 import random
 import threading
 import time
+from typing import Iterator
 from collections import deque
 
 logger = logging.getLogger("kubernetes_tpu.trace")
@@ -113,7 +114,7 @@ def current_context() -> tuple[str, str, bool] | None:
 
 
 @contextlib.contextmanager
-def use_context(ctx: tuple[str, str, bool] | None):
+def use_context(ctx: tuple[str, str, bool] | None) -> Iterator[None]:
     """Install a captured context in this thread (cross-thread parenting)."""
     prev = getattr(_tls, "ctx", None)
     _tls.ctx = ctx
@@ -170,7 +171,8 @@ class _SpanHandle:
                  "_ts", "_t0", "_prev", "_done")
 
     def __init__(self, name: str, trace_id: str, span_id: str,
-                 parent_id: str, attrs: dict, prev, t0: float):
+                 parent_id: str, attrs: dict,
+                 prev: tuple[str, str, bool] | None, t0: float):
         self.name = name
         self.trace_id = trace_id
         self.span_id = span_id
@@ -200,7 +202,7 @@ class _NoopSpan:
         pass
 
     @property
-    def trace_id(self):  # uniform access for callers stashing ids
+    def trace_id(self) -> str:  # uniform access for callers stashing ids
         return ""
 
 
@@ -215,7 +217,7 @@ class _UnsampledSpan:
     __slots__ = ("_prev",)
     trace_id = ""
 
-    def __init__(self, prev):
+    def __init__(self, prev: tuple[str, str, bool] | None):
         self._prev = prev
 
     def end(self, **attrs) -> None:
@@ -259,7 +261,7 @@ def begin_span(name: str, start: float | None = None,
 
 
 @contextlib.contextmanager
-def span(name: str, **attrs):
+def span(name: str, **attrs: object) -> Iterator[object]:
     """Record a span around the body.  One branch when tracing is off."""
     if not _enabled:
         yield _NOOP
@@ -295,7 +297,7 @@ def record_server_span(name: str, traceparent_header: str,
 # -- hot-loop stages -------------------------------------------------------
 
 @contextlib.contextmanager
-def stage(name: str, **attrs):
+def stage(name: str, **attrs: object) -> Iterator[object]:
     """A named pipeline stage: a span (when tracing is on) AND an
     observation in the per-stage labeled histogram (always — metrics are
     the cheap, always-on layer; spans are the sampled, detailed one).

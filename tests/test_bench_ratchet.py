@@ -629,3 +629,60 @@ def test_soak_capacity_wave_stranded_fails():
 
 def test_soak_without_capacity_section_ratchets_nothing():
     assert cb.check_soak([("SOAK_r11.json", _soak())]) == []
+
+
+# -- compile-surface provenance (kt-xray, ISSUE 14 satellite) ----------------
+
+def _xray(h):
+    return {"hash": f"sha256:{h}", "programs": 18}
+
+
+def test_repo_artifacts_pass_the_xray_ratchet():
+    assert cb.check_xray() == []
+
+
+def test_xray_hash_change_with_regeneration_passes():
+    arts = [("BENCH_r11.json", dict(_parsed(p50=1.0), xray=_xray("aa"))),
+            ("BENCH_r12.json", dict(_parsed(p50=1.0), xray=_xray("bb")))]
+    assert cb.check_xray(arts, soak_artifacts=[],
+                         manifest=_xray("bb")) == []
+
+
+def test_xray_hash_change_without_regeneration_fails():
+    arts = [("BENCH_r11.json", dict(_parsed(p50=1.0), xray=_xray("aa"))),
+            ("BENCH_r12.json", dict(_parsed(p50=1.0), xray=_xray("bb")))]
+    problems = cb.check_xray(arts, soak_artifacts=[],
+                             manifest=_xray("aa"))
+    assert len(problems) == 1 and "without a manifest regeneration" \
+        in problems[0]
+
+
+def test_xray_stable_hash_ignores_committed_manifest_evolution():
+    # The manifest legitimately regenerates between benches; only a
+    # CHANGE between consecutive stamps demands the committed hash.
+    arts = [("BENCH_r11.json", dict(_parsed(p50=1.0), xray=_xray("aa"))),
+            ("BENCH_r12.json", dict(_parsed(p50=1.0), xray=_xray("aa")))]
+    assert cb.check_xray(arts, soak_artifacts=[],
+                         manifest=_xray("zz")) == []
+
+
+def test_xray_soak_stamp_ratchets_too():
+    soaks = [("SOAK_r13.json", dict(_soak(), xray=_xray("aa"))),
+             ("SOAK_r14.json", dict(_soak(), xray=_xray("bb")))]
+    problems = cb.check_xray([], soak_artifacts=soaks,
+                             manifest=_xray("aa"))
+    assert len(problems) == 1 and "SOAK" in problems[0]
+
+
+def test_xray_hash_change_with_no_committed_manifest_fails():
+    arts = [("BENCH_r11.json", dict(_parsed(p50=1.0), xray=_xray("aa"))),
+            ("BENCH_r12.json", dict(_parsed(p50=1.0), xray=_xray("bb")))]
+    problems = cb.check_xray(arts, soak_artifacts=[], manifest=None)
+    assert len(problems) == 1 and "not committed" in problems[0]
+
+
+def test_xray_unstamped_artifacts_ratchet_nothing():
+    arts = [("BENCH_r05.json", _parsed(p50=1.0)),
+            ("BENCH_r11.json", dict(_parsed(p50=1.0), xray=_xray("aa")))]
+    assert cb.check_xray(arts, soak_artifacts=[],
+                         manifest=None) == []

@@ -19,7 +19,8 @@ from kubernetes_tpu.analysis import rules_concurrency  # noqa: E402,F401
 from kubernetes_tpu.analysis import rules_device  # noqa: E402,F401
 from kubernetes_tpu.utils import knobs  # noqa: E402
 
-EXPECTED_RULES = {"D01", "D02", "D03", "D04", "C01", "C02", "C03"}
+EXPECTED_RULES = {"D01", "D02", "D03", "D04", "D05",
+                  "C01", "C02", "C03"}
 
 
 def _module(src: str, path: str) -> core.Module:
@@ -185,6 +186,48 @@ def test_d04_flags_hot_path_reads_even_via_knobs():
                       "kubernetes_tpu/scheduler/scheduler.py")
 
 
+# -- D05: implicit host syncs (the X01 complement) ----------------------
+
+_D05_SRC = """
+import numpy as np
+
+class Daemon:
+    def drain(self):
+        choices, counter, final = self.engine.solver.solve_joint(b, c, k)
+        rows = np.asarray(choices)
+        ok = bool(counter)
+        n = int(final)
+        plain = np.asarray(untracked)
+"""
+
+
+def test_d05_flags_sinks_on_engine_returned_values():
+    found = _check("D05", _D05_SRC, "kubernetes_tpu/scheduler/foo.py")
+    msgs = [f.message for f in found]
+    assert any("'choices'" in m for m in msgs)
+    assert any("'counter'" in m for m in msgs)
+    assert any("'final'" in m for m in msgs)
+    # Untracked values are not findings (dataflow-lite, not a flood).
+    assert not any("untracked" in m for m in msgs)
+    assert len(found) == 3
+
+
+def test_d05_engine_modules_exempt_and_item_always_flagged():
+    assert not _check("D05", _D05_SRC, "kubernetes_tpu/engine/foo.py")
+    src = "x = some_value.item()\n"
+    found = _check("D05", src, "kubernetes_tpu/scheduler/foo.py")
+    assert found and "host sync" in found[0].message
+    assert not _check("D05", src, "kubernetes_tpu/perf/foo.py")
+
+
+def test_d05_host_solver_not_tracked():
+    src = ("import numpy as np\n"
+           "def f(self):\n"
+           "    feas, scores = self.host_solver.evaluate(b, c)\n"
+           "    arr = np.asarray(feas)\n")
+    assert not _check("D05", src, "kubernetes_tpu/scheduler/foo.py")
+
+
 # -- C01: lock-order cycles ---------------------------------------------
 
 def _project_of(src: str, path: str) -> core.Project:
@@ -317,14 +360,11 @@ def test_baseline_grandfathers_and_goes_stale(tmp_path):
                         "__init__.py")
     # Synthesize a baseline for a finding, then verify run_project
     # splits new vs baselined vs stale correctly on a tiny tree.
+    from kubernetes_tpu.analysis.rules_device import DEVICE_ALLOWED
     finding = core.Finding("D01", "kubernetes_tpu/scheduler/x.py", 1,
-                           "import jax: device imports are allowed "
-                           "only under kubernetes_tpu/engine/, "
-                           "kubernetes_tpu/ops/, "
-                           "kubernetes_tpu/parallel/, "
-                           "kubernetes_tpu/perf/, "
-                           "kubernetes_tpu/utils/profiling.py — the "
-                           "host fallback guarantee is structural")
+                           f"import jax: device imports are allowed "
+                           f"only under {', '.join(DEVICE_ALLOWED)} — "
+                           f"the host fallback guarantee is structural")
     bl = tmp_path / "baseline.json"
     bl.write_text(json.dumps(
         {"findings": {finding.fingerprint: "synthetic test entry"}}))

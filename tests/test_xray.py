@@ -1,0 +1,376 @@
+"""kt-xray in tier-1: the committed compile-surface manifest matches
+the code (zero drift, X01–X04 clean or justified, 100% ladder
+coverage), the X-rule inventory cannot be silently deleted, and the
+rule detectors trip on synthetic kernels (a widening kernel -> X02, a
+pure_callback kernel -> X01, a donation mismatch -> X03, an
+unregistered jit entrypoint / a coverage gap -> X04)."""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from kubernetes_tpu.analysis import core as lint_core  # noqa: E402
+from kubernetes_tpu.analysis import xray  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def built():
+    """One abstract manifest build shared by the module (a few seconds
+    of tracing; no device, no XLA compile)."""
+    manifest, jaxprs = xray.build_manifest()
+    return manifest, jaxprs
+
+
+# -- the tier-1 ratchet -------------------------------------------------
+
+def test_committed_manifest_is_clean():
+    """Zero drift, zero unjustified findings, zero stale
+    justifications against tools/shape_manifest.json at HEAD."""
+    spec = importlib.util.spec_from_file_location(
+        "check_manifest", os.path.join(REPO, "tools",
+                                       "check_manifest.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    found = mod.problems()
+    assert found == [], "\n".join(found)
+
+
+def test_committed_manifest_internal_consistency():
+    data = xray.load_manifest()
+    assert data is not None, "tools/shape_manifest.json missing"
+    assert data["hash"] == xray.manifest_hash(data["programs"])
+    assert data["canonical"] == xray.CANON
+    # Acceptance: findings fixed or justified — never blanket-baselined
+    # (every justification entry must name a single finding, and none
+    # may carry the placeholder).
+    for fp, why in (data.get("justifications") or {}).items():
+        assert why and "JUSTIFY" not in why, fp
+    summary = xray.manifest_summary()
+    assert summary == {"hash": data["hash"],
+                       "programs": len(data["programs"])}
+
+
+# -- rule-inventory self-check (kt-lint protocol for X-rules) -----------
+
+def test_xrule_inventory_pinned():
+    assert set(xray.XRULES) == {"X01", "X02", "X03", "X04"}
+    for rule in xray.XRULES.values():
+        assert rule.title and rule.doc
+
+
+def test_xrule_inventory_in_architecture_md():
+    with open(os.path.join(REPO, "ARCHITECTURE.md")) as f:
+        text = f.read()
+    section = text.split("## Static analysis & concurrency discipline",
+                         1)[1].split("\n## ", 1)[0]
+    for rule_id in list(xray.XRULES) + ["D05"]:
+        assert f"`{rule_id}`" in section, \
+            f"rule {rule_id} missing from the ARCHITECTURE.md inventory"
+    assert "## Compile-surface manifest" in text
+
+
+# -- X01: host-sync primitives ------------------------------------------
+
+def test_x01_trips_on_pure_callback_kernel():
+    def kernel(x):
+        y = jax.pure_callback(
+            lambda v: np.asarray(v),
+            jax.ShapeDtypeStruct((4,), np.float32), x)
+        return y * 2.0
+
+    jaxpr = jax.make_jaxpr(kernel)(
+        jax.ShapeDtypeStruct((4,), np.float32))
+    found = xray.check_x01("synthetic", jaxpr)
+    assert len(found) == 1 and "pure_callback" in found[0].message
+    assert found[0].rule == "X01"
+
+
+def test_x01_clean_on_pure_math():
+    jaxpr = jax.make_jaxpr(lambda x: jnp.sum(x * 2.0))(
+        jax.ShapeDtypeStruct((4,), np.float32))
+    assert xray.check_x01("synthetic", jaxpr) == []
+
+
+def test_x01_sees_through_nested_jit():
+    inner = jax.jit(lambda x: jax.pure_callback(
+        lambda v: np.asarray(v),
+        jax.ShapeDtypeStruct((4,), np.float32), x))
+
+    def kernel(x):
+        return inner(x) + 1.0
+
+    jaxpr = jax.make_jaxpr(kernel)(
+        jax.ShapeDtypeStruct((4,), np.float32))
+    assert xray.check_x01("synthetic", jaxpr)
+
+
+# -- X02: dtype widening ------------------------------------------------
+
+def test_x02_trips_on_widening_kernel():
+    def widen(x):
+        return x.astype(jnp.float32) * 2.0
+
+    jaxpr = jax.make_jaxpr(widen)(
+        jax.ShapeDtypeStruct((4,), np.float16))
+    found = xray.check_x02("synthetic", jaxpr,
+                           feature_bits={"float": 16, "int": 32})
+    assert len(found) == 1 and "float32" in found[0].message
+    # Under the CURRENT declared width (32 bits) the same convert is
+    # legal — the bound ratchets down with the narrowing work.
+    assert xray.check_x02("synthetic", jaxpr) == []
+
+
+def test_x02_int_widening_and_scan_bodies():
+    def kernel(x):
+        def step(c, v):
+            return c + v.astype(jnp.int32), v
+
+        out, _ = jax.lax.scan(step, jnp.int32(0), x)
+        return out
+
+    jaxpr = jax.make_jaxpr(kernel)(
+        jax.ShapeDtypeStruct((8,), np.int16))
+    found = xray.check_x02("synthetic", jaxpr,
+                           feature_bits={"float": 32, "int": 16})
+    assert found and "int32" in found[0].message
+
+
+# -- X03: donation annotations ------------------------------------------
+
+def _engine_module(src: str) -> lint_core.Module:
+    return lint_core.Module(path="kubernetes_tpu/engine/fake.py",
+                            src=src, tree=ast.parse(src))
+
+
+def test_x03_unannotated_jit_site_trips():
+    src = ("import jax, functools\n"
+           "@functools.partial(jax.jit, static_argnums=(0,))\n"
+           "def solve(s, x):\n"
+           "    return x\n")
+    found = xray.check_x03([_engine_module(src)])
+    assert len(found) == 1 and "no '# kt-xray:" in found[0].message
+    assert found[0].program == "kubernetes_tpu/engine/fake.py:solve"
+
+
+def test_x03_donation_mismatch_trips_both_ways():
+    src = ("import jax\n"
+           "# kt-xray: no-donate(mirror aliased)\n"
+           "fn = jax.jit(impl, donate_argnums=(0,))\n")
+    found = xray.check_x03([_engine_module(src)])
+    assert len(found) == 1 and "annotated no-donate but" \
+        in found[0].message
+    src2 = ("import jax\n"
+            "# kt-xray: donate(argnums 0)\n"
+            "fn = jax.jit(impl)\n")
+    found2 = xray.check_x03([_engine_module(src2)])
+    assert len(found2) == 1 and "annotated donate but" \
+        in found2[0].message
+
+
+def test_x03_matching_annotations_clean():
+    src = ("import jax\n"
+           "# kt-xray: donate(argnums 0 — the carry is ours)\n"
+           "a = jax.jit(impl, donate_argnums=(0,))\n"
+           "# kt-xray: no-donate(aliased by in-flight drains; the\n"
+           "# reason wraps onto a second comment line)\n"
+           "b = jax.jit(impl2)\n")
+    assert xray.check_x03([_engine_module(src)]) == []
+
+
+def test_discover_jit_sites_records_donation_spec():
+    """The manifest's donate_argnums column comes from the SOURCE (the
+    trace goes through .__wrapped__, where donation is invisible) — a
+    site that donates must surface its kwarg text."""
+    src = ("import jax\n"
+           "# kt-xray: donate(the carry is ours)\n"
+           "fn = jax.jit(impl, donate_argnums=(0, 1))\n")
+    sites = xray.discover_jit_sites(_engine_module(src))
+    assert len(sites) == 1 and sites[0].donates
+    assert sites[0].donate_spec == "donate_argnums=(0, 1)"
+    plain = xray.discover_jit_sites(_engine_module(
+        "import jax\nfn = jax.jit(impl)\n"))
+    assert plain[0].donate_spec == "" and not plain[0].donates
+
+
+def test_x03_outside_engine_is_out_of_scope():
+    src = "import jax\nfn = jax.jit(impl)\n"
+    module = lint_core.Module(path="kubernetes_tpu/perf/fake.py",
+                              src=src, tree=ast.parse(src))
+    assert xray.check_x03([module]) == []
+
+
+# -- X04: ladder coverage -----------------------------------------------
+
+def test_x04_real_tree_is_fully_covered(built):
+    manifest, _ = built
+    found = xray.check_x04(manifest["programs"],
+                           xray.engine_modules())
+    assert found == [], [f.text() for f in found]
+
+
+def test_x04_coverage_gap_trips(built):
+    manifest, _ = built
+    programs = dict(manifest["programs"])
+    victims = [k for k in programs if k.startswith("scan_first@")]
+    del programs[victims[0]]
+    found = xray.check_x04(programs, xray.engine_modules())
+    assert any("ladder coverage gap" in f.message for f in found)
+
+
+def test_x04_unmanifested_jit_entrypoint_trips(built):
+    manifest, _ = built
+    rogue = _engine_module(
+        "import jax\n@jax.jit\ndef rogue_kernel(x):\n    return x\n")
+    found = xray.check_x04(manifest["programs"],
+                           xray.engine_modules() + [rogue])
+    assert any("unmanifested jit entrypoint" in f.message and
+               "rogue_kernel" in f.program for f in found)
+
+
+def test_x04_unreachable_warmed_program_trips(built):
+    manifest, _ = built
+    programs = dict(manifest["programs"])
+    fake = dict(programs["scan_first@256"])
+    fake["warmed"] = True
+    programs["scan_first@999"] = fake
+    found = xray.check_x04(programs, xray.engine_modules())
+    assert any("unreachable-from-prewarm" in f.message for f in found)
+
+
+# -- ladder-coverage regression: effective_ladder <-> manifest ----------
+
+def test_canonical_ladder_matches_scheduler_defaults():
+    """The manifest's canonical constants ARE the daemon defaults: a
+    default-config change must force a deliberate manifest regen."""
+    from kubernetes_tpu.scheduler.scheduler import (Scheduler,
+                                                    bucket_ladder)
+    assert xray.CANON["floor"] == Scheduler.STREAM_MIN_BUCKET
+    assert xray.CANON["pad_limit"] == Scheduler._PAD_LIMIT
+    assert xray.canonical_ladder() == bucket_ladder(
+        Scheduler.STREAM_MIN_BUCKET, 1 << 62, Scheduler._PAD_LIMIT, 0)
+    from kubernetes_tpu.utils import knobs
+    assert xray.CANON["victims"] == int(
+        knobs.REGISTRY["KT_PREEMPT_MAX_VICTIMS"].default)
+
+
+def test_committed_warmed_programs_equal_prewarm_plan():
+    data = xray.load_manifest()
+    warmed = sorted(k for k, p in data["programs"].items()
+                    if p["warmed"])
+    assert warmed == xray.canonical_plan()
+
+
+def test_prewarm_plan_shapes():
+    from kubernetes_tpu.scheduler.scheduler import prewarm_plan
+    plan = prewarm_plan([256, 512], [1, 2], joint=False, preempt=False)
+    assert "scan_first@256" in plan and "scan_carry@512" in plan
+    assert "scatter@2" in plan and "single_evaluate@1" in plan
+    assert not any(p.startswith("joint") or p == "victim_solve"
+                   for p in plan)
+    full = prewarm_plan([256], [1])
+    assert "victim_solve" in full and "joint@256" in full and \
+        "oneshot_topo@256" in full
+
+
+# -- mechanics ----------------------------------------------------------
+
+def test_aval_str_and_fingerprint_stability():
+    assert xray.aval_str(jax.ShapeDtypeStruct((3, 4), np.float32)) \
+        == "f32[3x4]"
+    assert xray.aval_str(jax.ShapeDtypeStruct((), np.uint32)) == "u32[]"
+    j1 = jax.make_jaxpr(lambda x: x * 2)(
+        jax.ShapeDtypeStruct((4,), np.float32))
+    j2 = jax.make_jaxpr(lambda x: x * 2)(
+        jax.ShapeDtypeStruct((4,), np.float32))
+    assert xray.jaxpr_fingerprint(j1) == xray.jaxpr_fingerprint(j2)
+    j3 = jax.make_jaxpr(lambda x: x * 3)(
+        jax.ShapeDtypeStruct((4,), np.float32))
+    assert xray.jaxpr_fingerprint(j1) != xray.jaxpr_fingerprint(j3)
+
+
+def test_canonical_jaxpr_has_no_addresses_or_print_sharing():
+    """The fingerprint base must not depend on the pretty-printer's
+    sub-jaxpr sharing (it flips with jax's tracing-cache object
+    identity — measured live as a cross-process 'drift') nor embed
+    function reprs with memory addresses (pure_callback params)."""
+    def kernel(x):
+        y = jax.pure_callback(
+            lambda v: np.asarray(v),
+            jax.ShapeDtypeStruct((4,), np.float32), x)
+        return jnp.where(y > 0, y, -y)
+
+    jaxpr = jax.make_jaxpr(kernel)(
+        jax.ShapeDtypeStruct((4,), np.float32))
+    canon = xray.canonical_jaxpr(jaxpr)
+    assert "0x" not in canon
+    assert "fn:" in canon        # the callback param, by name only
+    # Two structurally-identical but object-distinct traces serialize
+    # identically (str() may not — that was the live bug).
+    jaxpr2 = jax.make_jaxpr(kernel)(
+        jax.ShapeDtypeStruct((4,), np.float32))
+    assert xray.canonical_jaxpr(jaxpr2) == canon
+
+
+def test_resize_pod_axis_touches_only_pod_axis_fields():
+    ctx = xray.build_context()
+    big = xray.resize_pod_axis(ctx.batch1, 512)
+    assert big.request.shape[0] == 512
+    assert big.aff.aff_need.shape[0] == 512
+    # Node-axis tables are untouched.
+    assert big.aff.node_dom.shape == ctx.batch1.aff.node_dom.shape
+    assert big.volsvc.vz_mask.shape == ctx.batch1.volsvc.vz_mask.shape
+
+
+def test_build_is_deterministic_in_process(built):
+    manifest, _ = built
+    again, _ = xray.build_manifest()
+    assert again["programs"] == manifest["programs"]
+    assert again["hash"] == manifest["hash"]
+
+
+def test_write_manifest_preserves_justifications(tmp_path):
+    path = str(tmp_path / "manifest.json")
+    m1 = xray.write_manifest(path)
+    assert m1["justifications"] == {}  # clean tree: nothing to justify
+    # Seed a justification for a finding that doesn't exist: a regen
+    # must DROP it (stale reasons rot the ratchet).
+    data = json.loads(open(path).read())
+    data["justifications"]["X01:ghost:gone"] = "stale reason"
+    with open(path, "w") as f:
+        json.dump(data, f)
+    m2 = xray.write_manifest(path)
+    assert "X01:ghost:gone" not in m2["justifications"]
+
+
+def test_drift_detection(built):
+    manifest, _ = built
+    committed = {k: dict(v) for k, v in manifest["programs"].items()}
+    assert xray.diff_programs(committed, manifest["programs"]) == []
+    committed["joint@256"]["fingerprint"] = "sha256:tampered"
+    drift = xray.diff_programs(committed, manifest["programs"])
+    assert any("joint@256: fingerprint drifted" in d for d in drift)
+    del committed["victim_solve"]
+    drift = xray.diff_programs(committed, manifest["programs"])
+    assert any("victim_solve: new program" in d for d in drift)
+
+
+def test_entrypoint_registry_surface():
+    from kubernetes_tpu.engine import entrypoints
+    names = {e.name for e in entrypoints.ENTRYPOINTS}
+    assert {"scan_first", "scan_carry", "joint", "single_evaluate",
+            "single_masks", "select_hosts", "scatter", "victim_solve",
+            "topo_planes", "oneshot_topo"} == names
+    claimed = entrypoints.claimed_jit_entrypoints()
+    assert "kubernetes_tpu/engine/solver.py:_solve_scan" in claimed
+    for e in entrypoints.ENTRYPOINTS:
+        assert e.doc and e.dispatch_site

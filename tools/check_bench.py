@@ -622,6 +622,68 @@ def check_device(artifacts: list[tuple[str, dict]],
     return problems
 
 
+def committed_manifest_summary() -> dict | None:
+    """{'hash', 'programs'} of tools/shape_manifest.json — plain JSON
+    read (no jax, no tracing; the full drift check is
+    tools/check_manifest.py's job)."""
+    path = os.path.join(REPO, "tools", "shape_manifest.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        data = json.load(f)
+    return {"hash": data.get("hash"),
+            "programs": len(data.get("programs") or {})}
+
+
+_COMMITTED = object()  # check_xray sentinel: read the committed file
+
+
+def check_xray(artifacts: list[tuple[str, dict]] | None = None,
+               soak_artifacts: list[tuple[str, dict]] | None = None,
+               manifest: object = _COMMITTED) -> list[str]:
+    """Compile-surface provenance ratchet: BENCH/SOAK artifacts carry
+    the kt-xray manifest stamp (hash + program count, bench.py
+    ``_xray_summary``), and a stamp change between consecutive
+    artifacts must come WITH a manifest regeneration — the newest
+    artifact's hash must then match the committed
+    tools/shape_manifest.json (a bench that measured a compile surface
+    the manifest never recorded is an unaccounted perf-trajectory
+    jump).  Artifacts predating the stamp ratchet nothing.  Pass
+    ``manifest=None`` to mean "no committed manifest" (the default
+    sentinel reads tools/shape_manifest.json)."""
+    problems: list[str] = []
+    committed = committed_manifest_summary() \
+        if manifest is _COMMITTED else manifest
+    families = (
+        ("BENCH", artifacts if artifacts is not None
+         else committed_artifacts()),
+        ("SOAK", soak_artifacts if soak_artifacts is not None
+         else committed_soak_artifacts()),
+    )
+    for family, arts in families:
+        stamped = [(name, parsed["xray"]) for name, parsed in arts
+                   if parsed.get("xray")]
+        if len(stamped) < 2:
+            continue
+        (prev_name, prev_x), (new_name, new_x) = stamped[-2], stamped[-1]
+        if prev_x.get("hash") == new_x.get("hash"):
+            continue
+        if committed is None:
+            problems.append(
+                f"{family} manifest stamp changed ({prev_name} -> "
+                f"{new_name}) but tools/shape_manifest.json is not "
+                f"committed")
+        elif committed.get("hash") != new_x.get("hash"):
+            problems.append(
+                f"{family} compile-surface hash changed ({prev_name} "
+                f"{str(prev_x.get('hash'))[:19]}… -> {new_name} "
+                f"{str(new_x.get('hash'))[:19]}…) without a manifest "
+                f"regeneration in the same commit (committed manifest "
+                f"is {str(committed.get('hash'))[:19]}… — run "
+                f"`python -m tools.ktxray --write-manifest`)")
+    return problems
+
+
 def check(artifacts: list[tuple[str, dict]] | None = None,
           tolerance: float = TOLERANCE) -> list[str]:
     """Problems with the newest artifact vs its predecessor (empty =
@@ -692,6 +754,7 @@ def main() -> int:
     problems += check_ha()
     problems += check_serving()
     problems += check_tenancy()
+    problems += check_xray()
     artifacts = committed_artifacts()
     if len(artifacts) < 2:
         print("bench ratchet: fewer than two committed BENCH artifacts; "

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Tier-1 gate over the kt-xray compile-surface manifest.
+
+Rebuilds the manifest abstractly (jax.eval_shape over the canonical
+ladder — no device, no compile) and fails on:
+
+* **drift** — programs added/removed, or any committed program whose
+  jaxpr fingerprint / avals / dispatch metadata no longer match the
+  code (regenerate with ``python -m tools.ktxray --write-manifest`` in
+  the same commit as the compile-surface change);
+* **new rule findings** — X01 (host-sync primitive in a solve body),
+  X02 (dtype widening past the declared feature width), X03 (engine
+  jit site without a matching donation annotation), X04 (ladder
+  coverage gap / unmanifested jit entrypoint / dead dispatch site) —
+  unless justified in the manifest's ``justifications`` section;
+* **stale justifications** — an entry whose finding was fixed must be
+  removed (kt-lint's ratchet-rot rule), and the ``JUSTIFY``
+  placeholder never counts as a justification.
+
+Run by tests/test_xray.py.  Usage: ``python tools/check_manifest.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def problems(manifest_path: str | None = None) -> list[str]:
+    from kubernetes_tpu.analysis import xray
+    result = xray.run_check(manifest_path or xray.DEFAULT_MANIFEST)
+    out = [f"DRIFT: {line}" for line in result.drift]
+    out += [f.text() for f in result.new]
+    out += [f"STALE justification: {fp}"
+            for fp in result.stale_justifications]
+    committed = xray.load_manifest(manifest_path or
+                                   xray.DEFAULT_MANIFEST) or {}
+    for fp, why in sorted((committed.get("justifications") or {})
+                          .items()):
+        if not why or "JUSTIFY" in why:
+            out.append(f"justification entry without a real reason: "
+                       f"{fp}")
+    return out
+
+
+def main(argv=None) -> int:
+    found = problems()
+    for line in found:
+        print(line)
+    if found:
+        print(f"check_manifest: {len(found)} problem(s) — fix the "
+              f"finding, or regenerate with `python -m tools.ktxray "
+              f"--write-manifest` and justify what remains",
+              file=sys.stderr)
+        return 1
+    print("check_manifest: compile surface matches the committed "
+          "manifest; X01–X04 clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

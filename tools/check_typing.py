@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Typed-core gate: the public surfaces of ``utils/``, ``engine/`` and
+``cache/`` are annotated, with a committed zero-new-errors baseline
+(kt-lint's ratchet protocol; tier-1 via tests/test_typing.py).
+
+Two layers:
+
+* **Structural** (always on): every public function/method in the core
+  packages — module-level defs and class methods whose name does not
+  start with ``_`` (plus ``__init__``, the public constructor surface)
+  — must annotate every named parameter (self/cls and ``*args`` /
+  ``**kwargs`` exempt) and its return type (``__init__`` exempt from
+  the return).  Findings are fingerprinted ``untyped:<path>:<qualname>``
+  and ratcheted against ``tools/typing_baseline.json``: new findings
+  fail, stale entries fail, every baseline entry needs a real
+  justification.
+* **mypy** (armed when available): when the ``mypy`` module is
+  importable AND the baseline sets ``"arm_mypy": true``, ``mypy`` runs
+  over the three packages and its error fingerprints ratchet against
+  the baseline's ``mypy_errors`` section the same way.  The container
+  this repo currently builds in has no mypy; the structural gate keeps
+  the annotation discipline honest until it lands, and arming is a
+  one-line baseline edit once it does.
+
+Usage:
+    python tools/check_typing.py                  # exit 1 on new findings
+    python tools/check_typing.py --list           # print every finding
+    python tools/check_typing.py --write-baseline # grandfather current
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "typing_baseline.json")
+
+# The typed core: the packages whose public surfaces every other layer
+# builds on.  (Daemons/servers/controllers are orchestration — typing
+# them is welcome but not gated.)
+PACKAGES = (
+    "kubernetes_tpu/utils",
+    "kubernetes_tpu/engine",
+    "kubernetes_tpu/cache",
+)
+
+
+def _iter_files(root: str = REPO) -> list[str]:
+    out = []
+    for pkg in PACKAGES:
+        base = os.path.join(root, pkg)
+        for dirpath, dirnames, files in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def _public(name: str) -> bool:
+    return name == "__init__" or not name.startswith("_")
+
+
+def _missing_annotations(fn: ast.FunctionDef | ast.AsyncFunctionDef
+                         ) -> list[str]:
+    missing = []
+    args = fn.args
+    named = list(args.posonlyargs) + list(args.args) + \
+        list(args.kwonlyargs)
+    for i, a in enumerate(named):
+        if i == 0 and a.arg in ("self", "cls"):
+            continue
+        if a.annotation is None:
+            missing.append(f"param '{a.arg}'")
+    if fn.returns is None and fn.name != "__init__":
+        missing.append("return")
+    return missing
+
+
+def structural_findings(root: str = REPO) -> list[tuple[str, str]]:
+    """[(fingerprint, message)] for every under-annotated public
+    function in the typed core."""
+    out: list[tuple[str, str]] = []
+    for path in _iter_files(root):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path) as f:
+            try:
+                tree = ast.parse(f.read(), filename=rel)
+            except SyntaxError as err:
+                raise SystemExit(f"check_typing: cannot parse {rel}: "
+                                 f"{err}")
+
+        def visit(node: ast.AST, qual: str, depth: int) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, f"{qual}{child.name}.", depth)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    # Nested defs (closures) are not public surface.
+                    if depth == 0 and _public(child.name):
+                        missing = _missing_annotations(child)
+                        if missing:
+                            out.append((
+                                f"untyped:{rel}:{qual}{child.name}",
+                                f"{rel}:{child.lineno}: public "
+                                f"{qual}{child.name} missing "
+                                f"{', '.join(missing)}"))
+                    visit(child, f"{qual}{child.name}.", depth + 1)
+
+        visit(tree, "", 0)
+    return out
+
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> dict:
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def mypy_findings(root: str = REPO) -> list[tuple[str, str]] | None:
+    """mypy error fingerprints, or None when mypy is unavailable."""
+    try:
+        from mypy import api as mypy_api
+    except ImportError:
+        return None
+    targets = [os.path.join(root, p) for p in PACKAGES]
+    stdout, _stderr, _code = mypy_api.run(
+        ["--ignore-missing-imports", "--follow-imports=silent",
+         "--no-error-summary", *targets])
+    out = []
+    for line in stdout.splitlines():
+        # path:line: error: message  [code]
+        parts = line.split(":", 2)
+        if len(parts) == 3 and "error" in parts[2]:
+            rel = os.path.relpath(parts[0], root).replace(os.sep, "/")
+            msg = parts[2].split("error:", 1)[-1].strip()
+            out.append((f"mypy:{rel}:{msg}", line.strip()))
+    return out
+
+
+def problems(baseline_path: str = DEFAULT_BASELINE,
+             root: str = REPO) -> list[str]:
+    baseline = load_baseline(baseline_path)
+    grand = dict(baseline.get("findings") or {})
+    found = structural_findings(root)
+    out = [msg for fp, msg in found if fp not in grand]
+    seen = {fp for fp, _ in found}
+    mypy_found = None
+    if baseline.get("arm_mypy"):
+        mypy_found = mypy_findings(root)
+        if mypy_found is None:
+            out.append("arm_mypy is set but mypy is not importable — "
+                       "install it or disarm the baseline")
+        else:
+            mypy_grand = dict(baseline.get("mypy_errors") or {})
+            out += [msg for fp, msg in mypy_found
+                    if fp not in mypy_grand]
+            seen |= {fp for fp, _ in mypy_found}
+            grand.update(mypy_grand)
+    for fp in sorted(grand):
+        if fp not in seen and (fp.startswith("untyped:") or
+                               mypy_found is not None):
+            out.append(f"STALE baseline entry (finding fixed — remove "
+                       f"it): {fp}")
+    for fp, why in sorted(grand.items()):
+        if not why or "JUSTIFY" in why:
+            out.append(f"baseline entry without a real justification: "
+                       f"{fp}")
+    return out
+
+
+def write_baseline(path: str = DEFAULT_BASELINE,
+                   root: str = REPO) -> int:
+    existing = load_baseline(path)
+    old = dict(existing.get("findings") or {})
+    found = structural_findings(root)
+    data = {
+        "comment": "Typed-core gate baseline (tools/check_typing.py). "
+                   "Every entry needs a justification; fixing the "
+                   "finding must remove the entry.  Set arm_mypy true "
+                   "once mypy is in the image to ratchet mypy errors "
+                   "in mypy_errors the same way.",
+        "arm_mypy": bool(existing.get("arm_mypy", False)),
+        "findings": {fp: old.get(
+            fp, "JUSTIFY: why this surface stays unannotated")
+            for fp, _ in found},
+        "mypy_errors": dict(existing.get("mypy_errors") or {}),
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return len(found)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="typed-core gate")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--list", action="store_true",
+                    help="print every structural finding (ignoring "
+                         "the baseline)")
+    opts = ap.parse_args(argv)
+    if opts.list:
+        for _fp, msg in structural_findings():
+            print(msg)
+        return 0
+    if opts.write_baseline:
+        n = write_baseline(opts.baseline)
+        print(f"wrote {n} finding(s) to {opts.baseline} — JUSTIFY "
+              f"each entry")
+        return 0
+    found = problems(opts.baseline)
+    for line in found:
+        print(line)
+    if found:
+        print(f"check_typing: {len(found)} problem(s) — annotate the "
+              f"surface or justify in {opts.baseline}",
+              file=sys.stderr)
+        return 1
+    print("check_typing: typed core clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

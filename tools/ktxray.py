@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""kt-xray driver: the abstract-interpreted compile-surface manifest.
+
+Enumerates every jitted live-path entrypoint
+(kubernetes_tpu/engine/entrypoints.py), abstractly traces each via
+``jax.eval_shape`` / ``jax.make_jaxpr`` over ShapeDtypeStruct inputs
+derived from the canonical bucket ladder — no device, no XLA compile —
+and maintains the committed ``tools/shape_manifest.json``.
+
+Usage:
+    python -m tools.ktxray                  # check (text), exit 1 on fail
+    python -m tools.ktxray --json           # machine-readable report
+    python -m tools.ktxray --rules          # X-rule inventory
+    python -m tools.ktxray --summary        # committed hash + count
+    python -m tools.ktxray --write-manifest # regenerate the manifest
+
+Regeneration workflow: a deliberate compile-surface change (new
+program, shape change, solver edit that moves a jaxpr) fails the drift
+check; rerun with ``--write-manifest`` in the SAME commit, then justify
+any remaining X-findings in the manifest's ``justifications`` section
+(the JUSTIFY placeholder fails tier-1 until edited).  tier-1 runs the
+equivalent check through tools/check_manifest.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from kubernetes_tpu.analysis import xray  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="abstract-interpreted compile-surface manifest")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--manifest", default=xray.DEFAULT_MANIFEST)
+    ap.add_argument("--write-manifest", action="store_true")
+    ap.add_argument("--rules", action="store_true")
+    ap.add_argument("--summary", action="store_true")
+    opts = ap.parse_args(argv)
+
+    if opts.rules:
+        for rid in sorted(xray.XRULES):
+            print(f"{rid} {xray.XRULES[rid].title}")
+        return 0
+
+    if opts.summary:
+        summary = xray.manifest_summary(opts.manifest)
+        print(json.dumps(summary, indent=1))
+        return 0 if summary else 1
+
+    if opts.write_manifest:
+        manifest = xray.write_manifest(opts.manifest)
+        pending = [fp for fp, why in manifest["justifications"].items()
+                   if "JUSTIFY" in why]
+        print(f"wrote {len(manifest['programs'])} program(s) to "
+              f"{opts.manifest} (hash {manifest['hash'][:19]}…)")
+        for fp in pending:
+            print(f"  needs justification: {fp}")
+        return 0
+
+    result = xray.run_check(opts.manifest)
+    if opts.as_json:
+        print(json.dumps({
+            "drift": result.drift,
+            "new": [f.fingerprint for f in result.new],
+            "justified": [f.fingerprint for f in result.justified],
+            "stale_justifications": result.stale_justifications,
+            "programs": sorted(result.programs),
+            "rules": sorted(xray.XRULES),
+        }, indent=1))
+    else:
+        for line in result.drift:
+            print(f"DRIFT: {line}")
+        for f in result.new:
+            print(f.text())
+        for fp in result.stale_justifications:
+            print(f"STALE justification (finding fixed — remove it): "
+                  f"{fp}")
+        if result.failed:
+            print(f"ktxray: {len(result.drift)} drift line(s), "
+                  f"{len(result.new)} new finding(s), "
+                  f"{len(result.stale_justifications)} stale "
+                  f"justification(s)", file=sys.stderr)
+        else:
+            print(f"ktxray: clean ({len(result.programs)} programs, "
+                  f"{len(result.justified)} justified finding(s))")
+    return 1 if result.failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
