@@ -142,14 +142,26 @@ def main(argv=None) -> None:
     # (util.go:46-74, :63-64).  BENCH_WIRE=0 skips.
     wire = None
     wire_all = []
+    wire_zero_bound = 0
+    wire_failures = 0
     if os.environ.get("BENCH_WIRE", "1") != "0":
         from kubernetes_tpu.apiserver.native import native_binary
-        from kubernetes_tpu.perf.harness import density_wire
+        from kubernetes_tpu.perf.harness import ZeroBoundError, density_wire
         runs = int(os.environ.get("BENCH_WIRE_RUNS", "3"))
         for _ in range(runs):
             try:
                 r = density_wire(n_nodes, n_pods, profile=profile)
+            except ZeroBoundError as err:
+                # A zero-bound run is a FAILED run, counted — never a
+                # 0.0 pods/s sample for the median to absorb (the
+                # BENCH_r11 flake) — and never silently dropped either:
+                # check_bench fails the artifact when this is nonzero.
+                wire_zero_bound += 1
+                print(f"wire run FAILED (zero-bound): {err}",
+                      file=sys.stderr)
+                continue
             except Exception as err:  # noqa: BLE001 — wire is additive
+                wire_failures += 1
                 print(f"wire phase failed: {err}", file=sys.stderr)
                 break
             wire_all.append(r)
@@ -404,6 +416,14 @@ def main(argv=None) -> None:
         }
     if fleet is not None:
         out["fleet"] = fleet
+    if wire is None and (wire_zero_bound or wire_failures):
+        # EVERY wire run failed (zero-bound or otherwise): the artifact
+        # must still carry the failure counts (check_bench.check_wire
+        # fails on either) — omitting the wire section entirely would
+        # silently retire both the zero-bound check and the throughput
+        # ratchet for exactly the fully-broken-rig case.
+        out["wire"] = {"zero_bound_runs": wire_zero_bound,
+                       "failed_runs": wire_failures, "runs": []}
     if wire is not None:
         vals = sorted(r.pods_per_second for r in wire_all)
         out["wire"] = {
@@ -420,9 +440,15 @@ def main(argv=None) -> None:
             "warm_compile_s": round(wire.warm_s, 1),
             "runs": [round(v, 1) for v in vals],
             "median_pods_per_second": round(vals[len(vals) // 2], 1),
+            # Failed-run accounting (ratcheted: any zero-bound run
+            # fails check_bench.check_wire).
+            "zero_bound_runs": wire_zero_bound,
             # The wire shape's own stage breakdown: diffed against the
             # in-process one above, it says where the 5x wire gap lives.
             "stages": wire.stages,
+            # Pre-clock warm attribution: pre-intern wall + prewarm's
+            # per-signature cache hit/miss/seconds audit.
+            "warm_breakdown": wire.warm_breakdown,
         }
     if serving is not None:
         trickle = serving["workloads"]["poisson_trickle"]

@@ -78,6 +78,17 @@ class Event:
             object.__setattr__(self, "_oj", cached)
         return cached
 
+    def wire_json(self) -> bytes:
+        """The bare ``{"type":...,"object":...}`` envelope (no trailing
+        newline) — the unit the framed watch encoding joins into one
+        length-prefixed ``{"items":[...]}`` batch."""
+        cached = self.__dict__.get("_env")
+        if cached is None:
+            cached = (b'{"type":"' + self.type.encode() +
+                      b'","object":' + self._obj_json() + b'}')
+            object.__setattr__(self, "_env", cached)
+        return cached
+
     def wire_line(self) -> bytes:
         """The NDJSON watch-wire form, serialized once and shared by every
         HTTP watch stream carrying this event (the same Event instance is
@@ -85,56 +96,91 @@ class Event:
         re-serialization was a measurable slice of apiserver GIL time."""
         cached = self.__dict__.get("_wire")
         if cached is None:
-            cached = (b'{"type":"' + self.type.encode() +
-                      b'","object":' + self._obj_json() + b'}\n')
+            cached = self.wire_json() + b"\n"
             object.__setattr__(self, "_wire", cached)
         return cached
 
     def as_type(self, etype: str) -> "Event":
         """This event re-typed for a fielded watcher: shares the object
-        AND its cached serialization; only the tiny envelope differs."""
-        ev = Event(etype, self.kind, self.key, self.object, self.rv,
-                   self.prev)
-        oj = self.__dict__.get("_oj")
-        if oj is not None:
-            object.__setattr__(ev, "_oj", oj)
+        AND its cached serialization; only the tiny envelope differs.
+        Re-typed instances are memoized per target type, so N watchers
+        sharing a field selector (HA shards) also share the re-typed
+        event's serialized envelope — the watch-cache leg that kept
+        each stream re-serializing the same DELETED at density rates."""
+        memo = self.__dict__.get("_retyped")
+        if memo is None:
+            memo = {}
+            object.__setattr__(self, "_retyped", memo)
+        ev = memo.get(etype)
+        if ev is None:
+            ev = Event(etype, self.kind, self.key, self.object, self.rv,
+                       self.prev)
+            oj = self.__dict__.get("_oj")
+            if oj is not None:
+                object.__setattr__(ev, "_oj", oj)
+            memo[etype] = ev
         return ev
+
+
+_DROP = object()  # classification-cache sentinel: "not for this set"
 
 
 class Watcher:
     def __init__(self, store: "MemStore", kinds: tuple[str, ...],
-                 selector=None):
+                 selector=None, selector_key: Optional[str] = None):
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._store = store
         self.kinds = kinds
         self.selector = selector  # fielded watch predicate (or None)
+        # Watch-cache key: watchers opened with the same field-selector
+        # STRING share one set-transition classification per event (N
+        # HA shards watching ``spec.nodeName=`` classify once, not N
+        # times).  None = uncacheable local callable.
+        self.selector_key = selector_key
 
-    def _deliver(self, ev: Event) -> None:
-        """Called under the store lock.  An unfielded watcher forwards the
-        shared event; a fielded one classifies the set transition
-        (cacher.go watchCache semantics):
+    def _classify(self, ev: Event) -> "Event | None":
+        """The set-transition classification (cacher.go watchCache):
 
         * entered the set  -> ADDED
         * stayed in        -> event as-is
         * left the set     -> DELETED (carrying the new object state)
-        * never in         -> dropped
+        * never in         -> None (dropped)
         """
         sel = self.selector
-        if sel is None:
-            self._q.put(ev)
-            return
         m_new = sel(ev.object)
         m_prev = ev.prev is not None and sel(ev.prev)
         if ev.type == "DELETED":
-            if m_prev or m_new:
-                self._q.put(ev)
-        elif ev.type == "ADDED":
-            if m_new:
-                self._q.put(ev)
-        elif m_new:
-            self._q.put(ev if m_prev else ev.as_type("ADDED"))
-        elif m_prev:
-            self._q.put(ev.as_type("DELETED"))
+            return ev if (m_prev or m_new) else None
+        if ev.type == "ADDED":
+            return ev if m_new else None
+        if m_new:
+            return ev if m_prev else ev.as_type("ADDED")
+        if m_prev:
+            return ev.as_type("DELETED")
+        return None
+
+    def _deliver(self, ev: Event) -> None:
+        """Called under the store lock.  An unfielded watcher forwards
+        the shared event; a fielded one classifies the set transition —
+        through the per-event memo when the selector has a cache key."""
+        if self.selector is None:
+            self._q.put(ev)
+            return
+        if self.selector_key is not None:
+            memo = ev.__dict__.get("_cls")
+            if memo is None:
+                memo = {}
+                object.__setattr__(ev, "_cls", memo)
+            out = memo.get(self.selector_key)
+            if out is None:
+                out = self._classify(ev)
+                memo[self.selector_key] = _DROP if out is None else out
+            if out is not _DROP and out is not None:
+                self._q.put(out)
+            return
+        out = self._classify(ev)
+        if out is not None:
+            self._q.put(out)
 
     def next(self, timeout: Optional[float] = None) -> Optional[Event]:
         try:
@@ -479,15 +525,18 @@ class MemStore:
     # -- watch -----------------------------------------------------------
 
     def watch(self, kinds: Iterable[str], from_rv: int,
-              selector=None) -> Watcher:
+              selector=None, selector_key: Optional[str] = None) -> Watcher:
         """``selector``: a fielded-watch predicate (api.fieldsel.matcher)
         applied server-side with set-transition semantics — see
-        Watcher._deliver."""
+        Watcher._deliver.  ``selector_key`` (the selector's source
+        string) lets watchers sharing one selector share the per-event
+        classification (the watch cache)."""
         with self._lock:
             if self._events and from_rv < self._events[0].rv - 1 and \
                     from_rv < self._rv - len(self._events):
                 raise TooOldError(f"rv {from_rv} too old")
-            w = Watcher(self, tuple(kinds), selector=selector)
+            w = Watcher(self, tuple(kinds), selector=selector,
+                        selector_key=selector_key)
             for ev in self._events:
                 if ev.rv > from_rv and ev.kind in w.kinds:
                     w._deliver(ev)

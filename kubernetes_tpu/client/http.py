@@ -474,17 +474,29 @@ class APIClient:
         return items, rv
 
     def watch(self, kind: str, from_rv: int,
-              field_selector: str = "") -> "HTTPWatcher":
+              field_selector: str = "",
+              frames: Optional[bool] = None) -> "HTTPWatcher":
         """Open a chunked watch stream; TooOldError on 410 forces relist.
         With ``field_selector`` the server applies set-transition
-        semantics (an object leaving the set arrives as DELETED)."""
+        semantics (an object leaving the set arrives as DELETED).
+        ``frames`` requests the framed multi-event encoding (default
+        from the KT_WATCH_FRAMES knob): servers that support it batch
+        queued events
+        into one length-prefixed JSON doc per write; servers that don't
+        ignore the parameter and the NDJSON decode path still applies."""
         self.limiter.accept()
         url = (f"{self.base_url}/api/v1/{kind}?watch=1"
                f"&resourceVersion={from_rv}")
         if field_selector:
             url += "&fieldSelector=" + urllib.parse.quote(field_selector)
+        if frames if frames is not None else WATCH_FRAMES:
+            url += "&frames=1"
         return HTTPWatcher(url, kind, token=self.token, tls=self.tls)
 
+
+# Framed multi-event watch encoding requested by default (read once at
+# import — the per-drain env read is the D04 hot-path rule).
+WATCH_FRAMES = knobs.get_bool("KT_WATCH_FRAMES")
 
 # A healthy watch stream carries a server heartbeat every ~10 s
 # (apiserver/server.py WATCH_HEARTBEAT_PERIOD); a read deadline several
@@ -536,6 +548,18 @@ class HTTPWatcher:
         try:
             q_put = self._q.put
             kind = self.kind
+
+            def emit(d: dict) -> None:
+                obj = d.get("object") or {}
+                meta = obj.get("metadata") or {}
+                ns = meta.get("namespace")
+                key = f"{ns}/{meta.get('name')}" if ns \
+                    else meta.get("name")
+                q_put(Event(
+                    type=d.get("type", ""), kind=kind, key=key or "",
+                    object=obj,
+                    rv=int(meta.get("resourceVersion", "0") or "0")))
+
             buf = bytearray()
             while True:
                 chunk = self._resp.read1(65536)
@@ -544,6 +568,26 @@ class HTTPWatcher:
                 buf += chunk
                 start = 0
                 while True:
+                    # Framed batch: '=<len>\n' then exactly len bytes of
+                    # {"items":[...]} and a closing newline.  ONE
+                    # json.loads decodes the whole batch, and the length
+                    # prefix slices it without rescanning a large buffer
+                    # for newlines.
+                    if start < len(buf) and buf[start] == 0x3d:  # '='
+                        nl = buf.find(b"\n", start)
+                        if nl < 0:
+                            break
+                        n = int(bytes(memoryview(buf)[start + 1:nl]))
+                        body_start = nl + 1
+                        if len(buf) < body_start + n + 1:
+                            break  # frame body still in flight
+                        d = json.loads(
+                            bytes(memoryview(buf)[body_start:
+                                                  body_start + n]))
+                        start = body_start + n + 1
+                        for item in d.get("items") or ():
+                            emit(item)
+                        continue
                     nl = buf.find(b"\n", start)
                     if nl < 0:
                         break
@@ -553,16 +597,7 @@ class HTTPWatcher:
                     start = nl + 1
                     if not line:
                         continue  # heartbeat
-                    d = json.loads(line)
-                    obj = d.get("object") or {}
-                    meta = obj.get("metadata") or {}
-                    ns = meta.get("namespace")
-                    key = f"{ns}/{meta.get('name')}" if ns \
-                        else meta.get("name")
-                    q_put(Event(
-                        type=d.get("type", ""), kind=kind, key=key or "",
-                        object=obj,
-                        rv=int(meta.get("resourceVersion", "0") or "0")))
+                    emit(json.loads(line))
                 if start:
                     del buf[:start]
         except Exception:  # noqa: BLE001 — stream died: deliver EOF

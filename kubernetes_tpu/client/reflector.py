@@ -64,8 +64,12 @@ class Reflector:
 
     def _open_watch(self, rv: int):
         if isinstance(self.source, MemStore):
-            return self.source.watch([self.kind], rv,
-                                     selector=self._fs_match)
+            # selector_key joins the store's watch cache: reflectors
+            # sharing one field-selector string (HA shards) share the
+            # per-event set-transition classification.
+            return self.source.watch(
+                [self.kind], rv, selector=self._fs_match,
+                selector_key=self.field_selector or None)
         return self.source.watch(self.kind, rv,
                                  field_selector=self.field_selector)
 

@@ -63,12 +63,15 @@ ENTRYPOINTS: tuple[EntrySpec, ...] = (
         "scan_first", "stream", (f"{_SOLVER}:_solve_scan",),
         f"{_GS}:schedule_batch_stream", True,
         "First stream chunk / one-shot sequential solve: the scan with "
-        "no carried state, live-mask padded to a ladder bucket."),
+        "no carried state, live-mask padded to a ladder bucket (the "
+        "fused body under KT_FUSED — packed aggregates, template "
+        "score planes, fused select; the canonical manifest records "
+        "the fused jaxpr)."),
     EntrySpec(
         "scan_carry", "stream", (f"{_SOLVER}:_solve_scan",),
         f"{_GS}:schedule_batch_stream", True,
         "Later stream chunks: the same scan continuing the previous "
-        "chunk's carried aggregate state."),
+        "chunk's carried (donated) state."),
     EntrySpec(
         "oneshot_topo", "oneshot", (f"{_SOLVER}:_solve_scan",),
         f"{_GS}:schedule_batch", True,

@@ -522,7 +522,7 @@ class GenericScheduler:
             log.debug("schedule_batch: %d pods (%d templates) x %d nodes, "
                       "joint=%s flags=%s", len(pods),
                       len({getattr(p, "_tpl_key", None) for p in pods}),
-                      dc.alloc.shape[0], joint, flags)
+                      sv.cluster_nodes(dc), joint, flags)
         self._agg_handoff = None
         from kubernetes_tpu.utils.profiling import device_trace
         if joint:
@@ -540,7 +540,7 @@ class GenericScheduler:
                     choices_np = np.asarray(choices)
                 devicestats.record_transfer("readback", choices_np.nbytes)
                 choices_np = self.guard.checked_readback(
-                    "joint", choices_np, dc.alloc.shape[0], live=live_np,
+                    "joint", choices_np, sv.cluster_nodes(dc), live=live_np,
                     alloc=nt.alloc, requests=np.asarray(batch.request),
                     keys_fn=lambda: [pd.key for pd in pods[:real_p]])
                 rows = choices_np[:real_p].tolist()
@@ -549,7 +549,7 @@ class GenericScheduler:
             # One packed device->host fetch for the whole drain (each fetch
             # is a full RTT on a tunneled chip): choices + tie counter +
             # final aggregates.
-            p, n = len(pods), dc.alloc.shape[0]
+            p, n = len(pods), sv.cluster_nodes(dc)
             with devicestats.live_path("oneshot"), \
                     device_trace("solve_sequential"), \
                     self.guard.watch("oneshot"), \
@@ -827,7 +827,7 @@ class GenericScheduler:
             print(f"stream-debug compile({len(all_pods)} pods): "
                   f"{time.perf_counter() - t_c0:.3f}s flags={tuple(flags)} "
                   f"shapes={shapes}", file=sys.stderr)
-        n = dc.alloc.shape[0]
+        n = sv.cluster_nodes(dc)
         counter = jnp.uint32(self.last_node_index)
         carry = None
         live_np = np.zeros(padded, bool)

@@ -27,13 +27,14 @@ from __future__ import annotations
 import functools
 import os
 import threading
-from typing import Any, NamedTuple
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from kubernetes_tpu.utils import knobs
+from kubernetes_tpu.engine import fused as fused_mod
 from kubernetes_tpu.api.policy import (DEFAULT_MAX_EBS_VOLUMES,
                                        DEFAULT_MAX_GCE_PD_VOLUMES, Policy,
                                        canonical_predicate_name,
@@ -72,6 +73,13 @@ PASSTHROUGH_PRIORITIES = ()
 # amortizing loop control and xs slicing.  Compile time scales with the
 # factor; 4 is the knee.
 SCAN_UNROLL = knobs.get_int("KT_SCAN_UNROLL")
+# Fused scan-step default (KT_FUSED; per-Solver override for tests).
+FUSED_DEFAULT = knobs.get_bool("KT_FUSED")
+# Resident-plane dtype policy: "narrow" = range-gated int16 wire/HBM
+# planes (mem columns stay int32), "wide" = the pre-r15 all-int32 form.
+FEATURE_DTYPE = knobs.get_str("KT_FEATURE_DTYPE")
+# Cap on distinct nonzero-request templates factored out of the scan.
+DYN_TEMPLATE_CAP = knobs.get_int("KT_DYN_TEMPLATES")
 
 
 class DeviceAffinity(NamedTuple):
@@ -151,6 +159,8 @@ class DeviceBatch(NamedTuple):
     node_zone_id: jnp.ndarray
     avoid_group: jnp.ndarray
     avoid_rows: jnp.ndarray
+    nz_tmpl_idx: jnp.ndarray
+    nz_templates: jnp.ndarray
     aff: DeviceAffinity
     volsvc: DeviceVolSvc
 
@@ -227,6 +237,126 @@ class DeviceCluster(NamedTuple):
     # spread kernels consume expand from these ids on device; the ids ride
     # the same dirty-row scatter protocol as every other cluster column.
     topo_dom: jnp.ndarray       # [N,K] int32
+
+
+class NarrowCluster(NamedTuple):
+    """The wire/residency form of DeviceCluster under the narrow dtype
+    policy (KT_FEATURE_DTYPE=narrow): the int32 resource planes are
+    re-laid as a range-gated int16 matrix plus an always-int32 memory
+    matrix (node memory in MiB routinely exceeds int16 — 32 GiB is
+    already 32768), the three pressure/taint bits pack into one uint8
+    plane, and the id planes (topology domains, image KiB) narrow to
+    int16 when their value ranges allow.  ``widen_cluster`` reconstructs
+    the exact DeviceCluster at the top of every jitted entrypoint, so
+    all solve arithmetic stays int32 — the narrowing changes transfer
+    bytes and HBM residency, never a decision."""
+
+    schedulable: jnp.ndarray    # [N] bool
+    res16: jnp.ndarray          # [N,7] i16 (range-gated; else i32):
+    #                             alloc cpu/gpu/pods, requested
+    #                             cpu/gpu/pods, nonzero cpu
+    mem32: jnp.ndarray          # [N,3] i32: alloc/requested/nonzero MiB
+    ports_used: jnp.ndarray     # [N,C] bool
+    vol_any: jnp.ndarray        # [N,W] bool
+    vol_rw: jnp.ndarray         # [N,W] bool
+    taints_nosched: jnp.ndarray  # [N,T] bool
+    taints_prefer: jnp.ndarray   # [N,T] bool
+    flags8: jnp.ndarray         # [N] u8: bit0 has_taints, bit1
+    #                             mem_pressure, bit2 disk_pressure
+    image_kib: jnp.ndarray      # [N,I] i16 (range-gated; else i32)
+    topo_dom: jnp.ndarray       # [N,K] i16 (range-gated; else i32)
+
+
+class DtypePolicy(NamedTuple):
+    """Per-signature storage dtypes for the narrow cluster planes —
+    chosen from actual value ranges so int16 can never wrap (the
+    overflow-guard tests pin the fallback at the limits)."""
+
+    res: str    # "int16" | "int32"
+    img: str
+    topo: str
+
+
+# Gate threshold: int16 max minus the largest single-step aggregate
+# delta the scan can commit (one pod's nonzero default); values proven
+# below this can accumulate one more placement without wrapping.
+_I16_GATE = 32000
+
+
+def narrow_policy(nt: "NodeTensors", agg: "NodeAggregates",
+                  space: "FeatureSpace",
+                  mode: Optional[str] = None) -> Optional[DtypePolicy]:
+    """The dtype policy for THIS host state, or None when the wide
+    policy is configured.  Range checks read the live arrays (cheap
+    numpy maxima), so adversarial states — overcommitted aggregates
+    ingested from a relist, a 64-core node — fall back to int32 for
+    that signature instead of wrapping.  ``mode`` overrides the
+    KT_FEATURE_DTYPE default (kt-xray's canonical build must not read
+    the environment)."""
+    if (mode or FEATURE_DTYPE) != "narrow":
+        return None
+    cols = [nt.alloc[:, (0, 2, 3)], agg.requested[:, (0, 2, 3)],
+            agg.nonzero[:, :1]]
+    res_max = max(int(a.max()) if a.size else 0 for a in cols)
+    res_min = min(int(a.min()) if a.size else 0 for a in cols)
+    res = "int16" if 0 <= res_min and res_max < _I16_GATE else "int32"
+    img_max = int(nt.image_kib.max()) if nt.image_kib.size else 0
+    img = "int16" if img_max < _I16_GATE else "int32"
+    topo = "int16" if len(space.topo_vals) < _I16_GATE else "int32"
+    return DtypePolicy(res=res, img=img, topo=topo)
+
+
+def narrow_cluster(c: "DeviceCluster", policy: DtypePolicy
+                   ) -> NarrowCluster:
+    """Re-lay a (host numpy) DeviceCluster into the narrow wire form.
+    Shared by the full upload and the dirty-row gather, so the two
+    paths cannot encode differently."""
+    res16 = np.concatenate(
+        [np.asarray(c.alloc)[:, (0, 2, 3)],
+         np.asarray(c.requested)[:, (0, 2, 3)],
+         np.asarray(c.nonzero)[:, :1]], axis=1).astype(policy.res)
+    mem32 = np.stack(
+        [np.asarray(c.alloc)[:, 1], np.asarray(c.requested)[:, 1],
+         np.asarray(c.nonzero)[:, 1]], axis=1).astype(np.int32)
+    flags8 = (np.asarray(c.has_taints).astype(np.uint8)
+              | (np.asarray(c.mem_pressure).astype(np.uint8) << 1)
+              | (np.asarray(c.disk_pressure).astype(np.uint8) << 2))
+    return NarrowCluster(
+        schedulable=c.schedulable, res16=res16, mem32=mem32,
+        ports_used=c.ports_used, vol_any=c.vol_any, vol_rw=c.vol_rw,
+        taints_nosched=c.taints_nosched, taints_prefer=c.taints_prefer,
+        flags8=flags8, image_kib=np.asarray(c.image_kib)
+        .astype(policy.img), topo_dom=np.asarray(c.topo_dom)
+        .astype(policy.topo))
+
+
+def widen_cluster(c: "DeviceCluster | NarrowCluster") -> "DeviceCluster":
+    """The exact int32 DeviceCluster back from the narrow wire form —
+    idempotent (a wide cluster passes through), traced at the top of
+    every jitted entrypoint so the widening fuses into the solve."""
+    if isinstance(c, DeviceCluster):
+        return c
+    r = c.res16.astype(jnp.int32)
+    m = c.mem32
+    return DeviceCluster(
+        schedulable=c.schedulable,
+        alloc=jnp.stack([r[:, 0], m[:, 0], r[:, 1], r[:, 2]], axis=1),
+        requested=jnp.stack([r[:, 3], m[:, 1], r[:, 4], r[:, 5]],
+                            axis=1),
+        nonzero=jnp.stack([r[:, 6], m[:, 2]], axis=1),
+        ports_used=c.ports_used, vol_any=c.vol_any, vol_rw=c.vol_rw,
+        taints_nosched=c.taints_nosched, taints_prefer=c.taints_prefer,
+        has_taints=(c.flags8 & 1) > 0,
+        mem_pressure=(c.flags8 & 2) > 0,
+        disk_pressure=(c.flags8 & 4) > 0,
+        image_kib=c.image_kib.astype(jnp.int32),
+        topo_dom=c.topo_dom.astype(jnp.int32))
+
+
+def cluster_nodes(c: "DeviceCluster | NarrowCluster") -> int:
+    """Node count of either cluster form (the host-side dispatch sites
+    must not widen just to read a shape)."""
+    return int(c.schedulable.shape[0])
 
 
 def _pad_cols(a: np.ndarray, width: int, fill=0) -> np.ndarray:
@@ -320,7 +450,7 @@ class ResidentCluster:
     FULL_FRACTION = 4  # dirty rows > N/4 -> full upload wins
 
     def __init__(self):
-        self.dc: DeviceCluster | None = None
+        self.dc: DeviceCluster | NarrowCluster | None = None
         self._sig = None
         self._epoch = None
         self._scatter = None
@@ -330,21 +460,29 @@ class ResidentCluster:
         self.dc = None
 
     @staticmethod
-    def signature(nt: "NodeTensors", space: "FeatureSpace") -> tuple:
+    def signature(nt: "NodeTensors", space: "FeatureSpace",
+                  policy: Optional[DtypePolicy] = None) -> tuple:
         """The shape signature a resident copy was uploaded at; any
-        component moving means the arrays cannot be patched in place."""
+        component moving — including the narrow dtype policy (a value
+        crossing the int16 gate widens the plane) — means the arrays
+        cannot be patched in place."""
         return (nt.alloc.shape[0], space.ports.capacity,
                 space.volumes.capacity, nt.taints_nosched.shape[1],
-                space.images.capacity, space.topo_keys.capacity)
+                space.images.capacity, space.topo_keys.capacity,
+                policy)
 
     def in_sync(self, nt: "NodeTensors", space: "FeatureSpace",
                 epoch: int) -> bool:
         """True when the resident copy mirrors THIS host state's row
         identity (same epoch, same shape signature) — the precondition
         for the invariant checker's row readback to be meaningful (a
-        mirror awaiting a full re-upload legitimately differs)."""
+        mirror awaiting a full re-upload legitimately differs).  The
+        dtype-policy component is excluded: it needs the aggregates to
+        recompute, and a pending policy flip re-uploads on the next
+        ``sync`` anyway."""
         return self.dc is not None and self._epoch == epoch and \
-            self._sig == self.signature(nt, space)
+            self._sig is not None and \
+            self._sig[:-1] == self.signature(nt, space)[:-1]
 
     def readback_rows(self, idx: "np.ndarray | list[int]") -> dict:
         """Device→host readback of the verifier's sampled rows: the four
@@ -353,10 +491,16 @@ class ResidentCluster:
         verifier cadence."""
         from kubernetes_tpu.engine import devicestats
         i = jnp.asarray(np.asarray(idx, np.int32))
-        out = {"schedulable": np.asarray(self.dc.schedulable[i]),
-               "alloc": np.asarray(self.dc.alloc[i]),
-               "requested": np.asarray(self.dc.requested[i]),
-               "nonzero": np.asarray(self.dc.nonzero[i])}
+        # Gather the k sampled rows of every plane, then decode through
+        # widen_cluster — the ONE authoritative narrow->wide layout
+        # (hand-stacking columns here would be a third copy of the
+        # res16/mem32 packing that could silently drift from the
+        # encode/decode pair).  Identity for a wide mirror.
+        rows = widen_cluster(type(self.dc)(*[arr[i] for arr in self.dc]))
+        out = {"schedulable": np.asarray(rows.schedulable),
+               "alloc": np.asarray(rows.alloc),
+               "requested": np.asarray(rows.requested),
+               "nonzero": np.asarray(rows.nonzero)}
         devicestats.record_transfer("readback", devicestats.nbytes(out))
         return out
 
@@ -371,10 +515,12 @@ class ResidentCluster:
             # device-side copy of the cluster arrays per scatter,
             # HBM-to-HBM, micro-seconds at 5k nodes — still nothing like
             # the host->device transfer this mirror exists to avoid.
-            def scatter(c: DeviceCluster, idx: jnp.ndarray,
-                        rows: DeviceCluster) -> DeviceCluster:
-                return DeviceCluster(*[arr.at[idx].set(new)
-                                       for arr, new in zip(c, rows)])
+            def scatter(c: "DeviceCluster | NarrowCluster",
+                        idx: jnp.ndarray,
+                        rows: "DeviceCluster | NarrowCluster"
+                        ) -> "DeviceCluster | NarrowCluster":
+                return type(c)(*[arr.at[idx].set(new)
+                                 for arr, new in zip(c, rows)])
 
             # kt-xray: no-donate(prior DeviceCluster may be aliased by an
             # in-flight drain; see the comment above)
@@ -415,7 +561,7 @@ class ResidentCluster:
         a no-op on the data.  Returns the number of shapes traced."""
         if self.dc is None:
             return 0
-        n = int(self.dc.alloc.shape[0])
+        n = int(self.dc.schedulable.shape[0])
         # sync() only scatters when dirty * FULL_FRACTION < N; larger
         # dirty sets take the full upload, so their shapes are
         # unreachable (ResidentCluster.scatter_buckets is that rule).
@@ -423,26 +569,33 @@ class ResidentCluster:
         traced = 0
         for k in self.scatter_buckets(n, max_rows):
             idx = np.zeros(k, np.int32)
-            rows = DeviceCluster(*[
+            rows = type(self.dc)(*[
                 np.repeat(np.asarray(arr[:1]), k, axis=0)
                 for arr in self.dc])
             idx_d, rows_d = jax.device_put((idx, rows))
-            scatter(self.dc, idx_d, rows_d).alloc.block_until_ready()
+            scatter(self.dc, idx_d,
+                    rows_d).schedulable.block_until_ready()
             traced += 1
         return traced
 
     def sync(self, nt: NodeTensors, agg: NodeAggregates,
              space: FeatureSpace, dirty: set[int],
-             epoch: int) -> DeviceCluster:
+             epoch: int) -> "DeviceCluster | NarrowCluster":
         """The current cluster state on device: scatter ``dirty`` rows
         into the resident arrays, or re-upload everything when the
-        resident copy cannot be patched (see class docstring)."""
+        resident copy cannot be patched (see class docstring).  Under
+        the narrow dtype policy both the upload and the scattered rows
+        travel in the NarrowCluster wire form; the jitted entrypoints
+        widen on device."""
         from kubernetes_tpu.engine import devicestats
         n = nt.alloc.shape[0]
-        sig = self.signature(nt, space)
+        policy = narrow_policy(nt, agg, space)
+        sig = self.signature(nt, space, policy)
         if self.dc is None or self._sig != sig or self._epoch != epoch \
                 or len(dirty) * self.FULL_FRACTION >= max(n, 1):
-            self.dc = device_cluster(nt, agg, space)
+            host = _host_cluster(nt, agg, space)
+            self.dc = jax.device_put(
+                host if policy is None else narrow_cluster(host, policy))
             self._sig = sig
             self._epoch = epoch
             self.stats["full_syncs"] += 1
@@ -484,11 +637,13 @@ class ResidentCluster:
             image_kib=_pad_cols(nt.image_kib[idx], space.images.capacity),
             topo_dom=_pad_cols(nt.topo_val[idx],
                                space.topo_keys.capacity, fill=-1))
+        if policy is not None:
+            rows = narrow_cluster(rows, policy)
         pad = 1 << (len(dirty) - 1).bit_length()
         if pad > len(dirty):
             extra = pad - len(dirty)
             idx = np.concatenate([idx, np.repeat(idx[:1], extra)])
-            rows = DeviceCluster(*[
+            rows = type(rows)(*[
                 np.concatenate([arr, np.repeat(arr[:1], extra, axis=0)])
                 for arr in rows])
         idx_d, rows_d = jax.device_put((idx, rows))
@@ -627,7 +782,7 @@ class Solver:
     def for_policy(cls, policy: Policy) -> "Solver":
         candidate = cls(policy)
         key = (candidate.predicate_names, candidate.priority_specs,
-               tuple(sorted(candidate.extra.items())))
+               tuple(sorted(candidate.extra.items())), candidate._fused)
         with cls._registry_lock:
             existing = cls._registry.get(key)
             if existing is not None:
@@ -635,8 +790,20 @@ class Solver:
             cls._registry[key] = candidate
             return candidate
 
-    def __init__(self, policy: Policy):
+    def __init__(self, policy: Policy,
+                 fused: Optional[bool] = None):
         self.policy = policy
+        # Fused scan-step selection, resolved once per Solver (KT_FUSED
+        # default; tests pass fused=False to pin the legacy body).  The
+        # select kernel implementation (Pallas on TPU, XLA elsewhere)
+        # resolves with it — never per drain.
+        self._fused = FUSED_DEFAULT if fused is None else fused
+        self._select = fused_mod.impl()
+        # Half-width encoded-score dtype (resolved once with the
+        # backend): bf16 on TPU, f16 — wider mantissa, so a larger
+        # exact-integer range — elsewhere.
+        self._half_dtype = jnp.bfloat16 \
+            if jax.default_backend() == "tpu" else jnp.float16
         # Canonical names: argument-carrying entries resolve to their
         # builtin regardless of the user-chosen policy name (plugins.go).
         self.predicate_names = tuple(canonical_predicate_name(p)
@@ -679,6 +846,7 @@ class Solver:
     @functools.partial(jax.jit, static_argnums=(0,))
     def masks(self, b: DeviceBatch, c: DeviceCluster) -> dict[str, jnp.ndarray]:
         """Per-predicate [P,N] masks (for Filter verbs / failure reporting)."""
+        c = widen_cluster(c)
         n = c.alloc.shape[0]
         return {name: _predicate_mask(name, b, c, n, self.extra)
                 for name in self.predicate_names}
@@ -695,6 +863,7 @@ class Solver:
         provably cannot trigger — an all-pass mask or all-zero plane — which
         matters because per-kernel dispatch overhead, not FLOPs, dominates
         small-batch evaluation."""
+        c = widen_cluster(c)
         n = c.alloc.shape[0]
         skip_preds = set()
         if not flags.any_ports:
@@ -760,22 +929,38 @@ class Solver:
         choices, counter, final = self._solve_scan(
             b, c, last_node_index, score_bias, flags, None, live,
             extra_mask)
+        requested, nonzero = self._final_aggregates(final)
         return jnp.concatenate([
             choices, counter.astype(jnp.int32)[None],
-            final["requested"].ravel(), final["nonzero"].ravel()])
+            requested.ravel(), nonzero.ravel()])
 
     @staticmethod
-    def _carry_cluster(c: DeviceCluster, final: dict) -> DeviceCluster:
+    def _final_aggregates(final: dict) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """(requested [N,4], nonzero [N,2]) from a scan's final state —
+        the fused body carries them as one packed [N,6] matrix (a single
+        scatter-add per step), the legacy body as two planes."""
+        if "packed" in final:
+            return final["packed"][:, :4], final["packed"][:, 4:6]
+        return final["requested"], final["nonzero"]
+
+    @staticmethod
+    def _carry_cluster(c: "DeviceCluster | NarrowCluster",
+                       final: dict) -> DeviceCluster:
         """Fold a scan's final dynamic state back into a DeviceCluster."""
-        return c._replace(
-            requested=final["requested"], nonzero=final["nonzero"],
+        requested, nonzero = Solver._final_aggregates(final)
+        return widen_cluster(c)._replace(
+            requested=requested, nonzero=nonzero,
             ports_used=final.get("ports_used", c.ports_used),
             vol_any=final.get("vol_any", c.vol_any),
             vol_rw=final.get("vol_rw", c.vol_rw))
 
-    # kt-xray: no-donate(c and the carry alias the resident mirror and
-    # the previous chunk's state, both read by overlapping chunks)
-    @functools.partial(jax.jit, static_argnums=(0, 5))
+    # kt-xray: donate(donate_argnums=(6,) — the carry: each chunk's
+    # final state is consumed exactly once, by the next chunk's launch;
+    # nothing else aliases it (choices ride separate buffers), so the
+    # scan updates the carried aggregates in place instead of minting a
+    # fresh copy of every state plane per chunk.  c and b stay
+    # non-donated: they alias the resident mirror / the sliced batch.)
+    @functools.partial(jax.jit, static_argnums=(0, 5), donate_argnums=(6,))
     def _solve_scan(self, b: DeviceBatch, c: DeviceCluster,
                     last_node_index: jnp.ndarray, score_bias: jnp.ndarray,
                     flags: BatchFlags = ALL_ON_FLAGS,
@@ -793,6 +978,7 @@ class Solver:
         an additional hard feasibility plane (workload constraints —
         topology spread's DoNotSchedule terms); None compiles it away.
         Returns (choices [P], counter, final state dict)."""
+        c = widen_cluster(c)
         n = c.alloc.shape[0]
         p = b.request.shape[0]
         a = b.aff
@@ -870,6 +1056,19 @@ class Solver:
         fits_pods_alloc = c.alloc[:, RES_PODS]
         zone_ids = b.node_zone_id  # [N]
         f32 = jnp.float32
+
+        if self._fused:
+            return self._fused_scan(
+                b, c, last_node_index, static_mask, static_score, carry,
+                live, score_bias is not None, dict(
+                    use_resources=use_resources, use_ports=use_ports,
+                    use_volumes=use_volumes, use_interpod=use_interpod,
+                    use_max_ebs=use_max_ebs, use_max_gce=use_max_gce,
+                    track_affinity=track_affinity,
+                    track_spread=track_spread,
+                    track_spread_zones=track_spread_zones,
+                    track_saa=track_saa),
+                dynamic_prios)
 
         def step(state, xs):
             counter = state["counter"]
@@ -1089,6 +1288,382 @@ class Solver:
         final, choices = jax.lax.scan(step, init, xs, unroll=SCAN_UNROLL)
         return choices, final["counter"], final
 
+    # Dynamic priorities whose pod-dependence is ONLY the nonzero-request
+    # row: their per-step [N] score plane is a pure function of
+    # (template, carried aggregates), so the scan can carry one
+    # [templates, N] plane and update a single column per placement
+    # instead of recomputing the whole chain every step.
+    _TEMPLATE_PRIOS = ("LeastRequestedPriority", "MostRequestedPriority",
+                       "BalancedResourceAllocation")
+
+    def _template_col(self, tmpl_prios: tuple, templates: jnp.ndarray,
+                      nz_j: jnp.ndarray, alloc_j: jnp.ndarray
+                      ) -> jnp.ndarray:
+        """[T] — the template-factored score column for one node, from
+        its (new) nonzero aggregates.  EXACTLY the per-step formulas of
+        the legacy scan body, evaluated at a single node."""
+        col = jnp.zeros(templates.shape[0], jnp.float32)
+        for name, weight, _aux in tmpl_prios:
+            w = jnp.float32(weight)
+            if name == "LeastRequestedPriority":
+                col += w * prio.least_requested(
+                    templates, nz_j[None], alloc_j[None])[:, 0]
+            elif name == "MostRequestedPriority":
+                col += w * prio.most_requested(
+                    templates, nz_j[None], alloc_j[None])[:, 0]
+            elif name == "BalancedResourceAllocation":
+                col += w * prio.balanced_resource_allocation(
+                    templates, nz_j[None], alloc_j[None])[:, 0]
+        return col
+
+    def _fused_scan(self, b: DeviceBatch, c: DeviceCluster,
+                    last_node_index: jnp.ndarray,
+                    static_mask: jnp.ndarray, static_score: jnp.ndarray,
+                    carry: dict | None, live: jnp.ndarray | None,
+                    has_bias: bool, fams: dict, dynamic_prios: tuple
+                    ) -> tuple[jnp.ndarray, jnp.ndarray, dict]:
+        """The fused scan body (KT_FUSED, the default) — decision-parity
+        identical to the legacy ``step`` (pinned by
+        tests/test_fused_solver.py against legacy, oracle and the host
+        engine), restructured for per-step cost:
+
+        * the hoisted mask/score planes merge into ONE encoded plane
+          (``-inf`` = statically infeasible), so each step slices one
+          row and folds dynamic feasibility with a single ``where``;
+        * ``requested``+``nonzero`` carry as one packed [N,6] matrix
+          committed by a single one-row scatter-add (the legacy body
+          re-materialized every plane every step);
+        * spread/zone counts commit by one-column scatter-adds;
+          port/volume/PD planes by one-row updates;
+        * the nz-only dynamic priorities (least/most-requested,
+          balanced) are template-factored: a carried [T,N] plane is
+          row-gathered per pod and recomputed for ONE column per
+          placement (``_template_col``);
+        * mask -> score -> tie-break -> select runs through the fused
+          select kernel (engine/fused.py; Pallas on TPU, XLA fused
+          elsewhere) — three node-axis reductions per step.
+
+        ``live`` and ``extra_mask`` are already folded into
+        ``static_mask`` by the caller."""
+        del live  # folded into static_mask by _solve_scan
+        n = c.alloc.shape[0]
+        a = b.aff
+        f32 = jnp.float32
+        neg = f32(-jnp.inf)
+        zone_ids = b.node_zone_id
+        fits_pods_alloc = c.alloc[:, RES_PODS]
+        alloc3 = c.alloc[:, :3]
+        select = self._select
+        use_resources = fams["use_resources"]
+        use_ports = fams["use_ports"]
+        use_volumes = fams["use_volumes"]
+        use_interpod = fams["use_interpod"]
+        use_max_ebs = fams["use_max_ebs"]
+        use_max_gce = fams["use_max_gce"]
+        track_affinity = fams["track_affinity"]
+        track_spread = fams["track_spread"]
+        track_spread_zones = fams["track_spread_zones"]
+        track_saa = fams["track_saa"]
+
+        tmpl_prios = tuple(sp for sp in dynamic_prios
+                           if sp[0] in self._TEMPLATE_PRIOS)
+        other_prios = tuple(sp for sp in dynamic_prios
+                            if sp[0] not in self._TEMPLATE_PRIOS)
+        use_templates = bool(tmpl_prios) and b.nz_templates.shape[0] > 0
+        if not use_templates:
+            other_prios = dynamic_prios
+            tmpl_prios = ()
+
+        # The encoded static plane: score where statically feasible,
+        # -inf elsewhere — one xs row per step instead of mask + score.
+        # Narrow score accumulation: when the greedy score is provably
+        # small-integral (no joint price bias; the policy's summed
+        # weight x MAX_PRIORITY bound fits the half-width mantissa
+        # exactly), the plane stores at half width — halving the
+        # dominant hoisted-plane bytes and the per-step row read — and
+        # every step widens its one row back to f32 before the reduce
+        # (the "bf16 accumulate, f32 final reduce" policy: bf16 on TPU,
+        # f16 — wider mantissa — elsewhere; -inf encodes exactly in
+        # both).  Values are integers well inside the exact range, so
+        # tie sets cannot move (parity-pinned).
+        enc = jnp.where(static_mask, static_score, neg)
+        weight_bound = sum(abs(w) for _n, w, _a in self.priority_specs) \
+            * prio.MAX_PRIORITY
+        if not has_bias:
+            exact = 256 if self._half_dtype is jnp.bfloat16 else 2048
+            if weight_bound < exact:
+                enc = enc.astype(self._half_dtype)
+
+        def step(state, xs):
+            counter = state["counter"]
+            packed = state["packed"]
+            masked = xs["enc"].astype(f32)
+
+            # Dynamic score families (identical formulas to the legacy
+            # body; template-factored ones come from the carried plane).
+            if use_templates:
+                masked = masked + state["D"][xs["tmpl"]]
+            for name, weight, aux in other_prios:
+                w = f32(weight)
+                if name == "LeastRequestedPriority":
+                    masked = masked + w * prio.least_requested(
+                        xs["nz"][None], packed[:, 4:6], c.alloc)[0]
+                elif name == "MostRequestedPriority":
+                    masked = masked + w * prio.most_requested(
+                        xs["nz"][None], packed[:, 4:6], c.alloc)[0]
+                elif name == "BalancedResourceAllocation":
+                    masked = masked + w * prio.balanced_resource_allocation(
+                        xs["nz"][None], packed[:, 4:6], c.alloc)[0]
+                elif name in ("SelectorSpreadPriority",
+                              "ServiceSpreadingPriority"):
+                    # Reduction-free selector spread: the per-step max
+                    # reductions of prio.selector_spread are replaced by
+                    # CARRIED per-group maxima (sp_maxn over schedulable
+                    # nodes, sp_maxz over zones) — counts only grow, and
+                    # only at the placed column, so the maxima update in
+                    # O(S) at commit.  Term-for-term the same float
+                    # expressions as selector_spreading.go via
+                    # prio.selector_spread (parity-pinned).
+                    g = xs["sgroup"]
+                    counts_g = state["sp_node"][g]          # [N]
+                    maxn_g = state["sp_maxn"][g]
+                    fsc = jnp.where(
+                        maxn_g > 0,
+                        10.0 * ((maxn_g - counts_g)
+                                / jnp.maximum(maxn_g, 1e-9)), 10.0)
+                    if track_spread_zones:
+                        zc_g = state["sp_zone"][g]          # [Z]
+                        maxz_g = state["sp_maxz"][g]
+                        zs_z = 10.0 * ((maxz_g - zc_g)
+                                       / jnp.maximum(maxz_g, 1e-9))
+                        node_has_zone = zone_ids >= 0
+                        zs_n = jnp.where(
+                            node_has_zone,
+                            zs_z[jnp.clip(zone_ids, 0)],
+                            10.0 * (maxz_g
+                                    / jnp.maximum(maxz_g, 1e-9)))
+                        blended = fsc * (1.0 - 2.0 / 3.0) + \
+                            (2.0 / 3.0) * zs_n
+                        fsc = jnp.where(
+                            b.spread_has_zones[g] & node_has_zone
+                            & (maxz_g > 0), blended, fsc)
+                    masked = masked + w * prio._trunc(fsc)
+                elif name == "InterPodAffinityPriority":
+                    counts = interpod.priority_counts(
+                        xs["pref_w"][None], state["match_cnt"],
+                        xs["sym_match"][None], a.sym_w, state["sym_cnt"])
+                    masked = masked + w * interpod.priority_score(
+                        counts, c.schedulable, prio._trunc)[0]
+                elif name == "ServiceAntiAffinityPriority":
+                    masked = masked + w * saa_plane(
+                        state["saa_cnt"][aux][xs["saa_g"]][None],
+                        state["saa_num"][xs["saa_g"]][None, None],
+                        b.volsvc.saa_dom[aux],
+                        b.volsvc.saa_labeled[aux])[0]
+
+            # Dynamic predicates folded into the encoded plane by one
+            # where (legacy: per-family boolean ANDs into `feasible`).
+            dyn_ok = None
+
+            def also(cond):
+                return cond if dyn_ok is None else (dyn_ok & cond)
+
+            if use_resources:
+                fits_pods = (packed[:, RES_PODS] + 1) <= fits_pods_alloc
+                free = alloc3 - packed[:, :3]
+                fits_res = jnp.all(xs["req"][None, :3] <= free, axis=-1)
+                dyn_ok = also(fits_pods & (xs["zero"] | fits_res))
+            if use_ports:
+                port_conflict = jnp.einsum(
+                    "c,nc->n", xs["ports"].astype(f32),
+                    state["ports_used"].astype(f32)) > 0
+                dyn_ok = also(~port_conflict)
+            if use_volumes:
+                vol_conflict = (
+                    jnp.einsum("w,nw->n", xs["vrw"].astype(f32),
+                               state["vol_any"].astype(f32)) +
+                    jnp.einsum("w,nw->n", xs["vro"].astype(f32),
+                               state["vol_rw"].astype(f32))) > 0
+                dyn_ok = also(~vol_conflict)
+            for fam in ("ebs", "gce") if (use_max_ebs or use_max_gce) \
+                    else ():
+                if (fam == "ebs" and not use_max_ebs) or \
+                        (fam == "gce" and not use_max_gce):
+                    continue
+                pd_node = state[f"pd_{fam}"]
+                pod_row = xs[f"pd_pod_{fam}"].astype(f32)
+                overlap = jnp.einsum("w,nw->n", pod_row,
+                                     pd_node.astype(f32))
+                new = jnp.sum(pod_row) + xs[f"pd_extra_{fam}"].astype(f32)
+                node_extra = getattr(b.volsvc, f"pd_node_extra_{fam}")
+                node_err = getattr(b.volsvc, f"pd_node_err_{fam}")
+                total = jnp.sum(pd_node.astype(f32), axis=1) + \
+                    node_extra.astype(f32) + new - overlap
+                ok = (total <= f32(self.extra[f"max_{fam}"])) & ~node_err
+                dyn_ok = also((new == 0) | ok)
+            if track_affinity:
+                reach = state["match_cnt"] > 0.0  # [Sm, N]
+            if use_interpod:
+                live_need = xs["aff_need"] & ~(
+                    xs["aff_self"] & (state["match_total"] == 0.0))
+                viol = (jnp.einsum("s,sn->n", live_need.astype(f32),
+                                   (~reach).astype(f32)) +
+                        jnp.einsum("s,sn->n", xs["anti_need"].astype(f32),
+                                   reach.astype(f32)) +
+                        jnp.einsum("s,sn->n", xs["decl_match"].astype(f32),
+                                   state["decl_reach"].astype(f32))) > 0
+                dyn_ok = also(~viol)
+            if dyn_ok is not None:
+                masked = jnp.where(dyn_ok, masked, neg)
+
+            # Fused selectHost (generic_scheduler.go:124-141).
+            choice, any_feasible = select(masked, counter)
+
+            # Commit (the batched AssumePod, cache.go:107) — one-row /
+            # one-column scatters instead of full-plane rewrites.
+            placed = choice >= 0
+            j = jnp.clip(choice, 0)
+            pi = placed.astype(jnp.int32)
+            pf = placed.astype(f32)
+            new_state = dict(state)
+            req6 = jnp.concatenate([xs["req"], xs["nz"]])
+            new_packed = packed.at[j].add(req6 * pi)
+            new_state["packed"] = new_packed
+            if use_templates:
+                new_state["D"] = state["D"].at[:, j].set(
+                    self._template_col(tmpl_prios, b.nz_templates,
+                                       new_packed[j, 4:6], c.alloc[j]))
+            if use_ports:
+                new_state["ports_used"] = state["ports_used"].at[j].set(
+                    state["ports_used"][j] | (xs["ports"] & placed))
+            if use_volumes:
+                new_state["vol_any"] = state["vol_any"].at[j].set(
+                    state["vol_any"][j] |
+                    ((xs["vrw"] | xs["vro"]) & placed))
+                new_state["vol_rw"] = state["vol_rw"].at[j].set(
+                    state["vol_rw"][j] | (xs["vrw"] & placed))
+            if track_spread:
+                incr_f = xs["incr"].astype(f32) * pf          # [S]
+                new_col = state["sp_node"][:, j] + incr_f
+                new_state["sp_node"] = state["sp_node"].at[:, j].set(
+                    new_col)
+                # The placed node is feasible hence schedulable, so the
+                # max-over-schedulable can only move through its column;
+                # unplaced steps must NOT fold column 0 (clip target) of
+                # a possibly-unschedulable node into the maximum.
+                new_state["sp_maxn"] = jnp.where(
+                    placed, jnp.maximum(state["sp_maxn"], new_col),
+                    state["sp_maxn"])
+                if track_spread_zones:
+                    zid = zone_ids[j]
+                    zc = jnp.clip(zid, 0)
+                    zval = incr_f * (zid >= 0).astype(f32)
+                    new_zcol = state["sp_zone"][:, zc] + zval
+                    new_state["sp_zone"] = state["sp_zone"] \
+                        .at[:, zc].set(new_zcol)
+                    new_state["sp_maxz"] = jnp.where(
+                        placed & (zid >= 0),
+                        jnp.maximum(state["sp_maxz"], new_zcol),
+                        state["sp_maxz"])
+            if use_max_ebs:
+                new_state["pd_ebs"] = state["pd_ebs"].at[j].set(
+                    state["pd_ebs"][j] | (xs["pd_pod_ebs"] & placed))
+            if use_max_gce:
+                new_state["pd_gce"] = state["pd_gce"].at[j].set(
+                    state["pd_gce"][j] | (xs["pd_pod_gce"] & placed))
+            if track_saa:
+                src = xs["saa_src"].astype(f32) * pf          # [Gy]
+                new_state["saa_num"] = state["saa_num"] + src
+                dom_j = b.volsvc.saa_dom[:, j]                # [L]
+                lab_j = b.volsvc.saa_labeled[:, j] & placed   # [L]
+                n_dom = state["saa_cnt"].shape[2]
+                domoh = ((jnp.arange(n_dom, dtype=jnp.int32)[None, :]
+                          == dom_j[:, None]) & lab_j[:, None]).astype(f32)
+                new_state["saa_cnt"] = state["saa_cnt"] + \
+                    domoh[:, None, :] * src[None, :, None]
+            if track_affinity:
+                (new_state["match_cnt"], new_state["match_total"],
+                 new_state["decl_reach"], new_state["sym_cnt"]) = \
+                    interpod.place_update(
+                        a.node_dom, a.match_key, state["match_cnt"],
+                        state["match_total"], xs["match_src"],
+                        a.decl_key, state["decl_reach"], xs["decl_src"],
+                        a.sym_key, state["sym_cnt"], xs["sym_src"],
+                        choice, placed)
+            new_state["counter"] = counter + \
+                jnp.where(any_feasible, jnp.uint32(1), jnp.uint32(0))
+            return new_state, choice
+
+        init = {
+            "packed": jnp.concatenate([c.requested, c.nonzero], axis=1),
+            "counter": last_node_index,
+        }
+        xs = {
+            "req": b.request, "zero": b.zero_request, "nz": b.nonzero,
+            "enc": enc,
+        }
+        if use_templates:
+            D0 = jnp.zeros((b.nz_templates.shape[0], n), f32)
+            for name, weight, _aux in tmpl_prios:
+                w = f32(weight)
+                if name == "LeastRequestedPriority":
+                    D0 = D0 + w * prio.least_requested(
+                        b.nz_templates, c.nonzero, c.alloc)
+                elif name == "MostRequestedPriority":
+                    D0 = D0 + w * prio.most_requested(
+                        b.nz_templates, c.nonzero, c.alloc)
+                elif name == "BalancedResourceAllocation":
+                    D0 = D0 + w * prio.balanced_resource_allocation(
+                        b.nz_templates, c.nonzero, c.alloc)
+            init["D"] = D0
+            xs["tmpl"] = b.nz_tmpl_idx
+        if use_ports:
+            init["ports_used"] = c.ports_used
+            xs["ports"] = b.ports
+        if use_volumes:
+            init["vol_any"] = c.vol_any
+            init["vol_rw"] = c.vol_rw
+            xs["vro"] = b.vol_ro
+            xs["vrw"] = b.vol_rw
+        if track_spread:
+            init["sp_node"] = b.spread_node_counts
+            init["sp_zone"] = b.spread_zone_counts
+            # Carried maxima, seeded exactly like the per-step
+            # reductions they replace (selector_spreading.go's
+            # countsByNodeName max spans the ready node list; the zone
+            # max spans all zones).
+            init["sp_maxn"] = jnp.max(
+                jnp.where(c.schedulable[None, :],
+                          b.spread_node_counts, 0.0), axis=1)
+            init["sp_maxz"] = jnp.max(b.spread_zone_counts, axis=1)
+            xs["sgroup"] = b.spread_group
+            xs["incr"] = b.spread_incr
+        if track_affinity:
+            init.update(match_cnt=a.match_cnt, match_total=a.match_total,
+                        decl_reach=a.decl_reach, sym_cnt=a.sym_cnt)
+            xs.update(aff_need=a.aff_need, aff_self=a.aff_self,
+                      anti_need=a.anti_need, decl_match=a.decl_match,
+                      match_src=a.match_src, decl_src=a.decl_src,
+                      pref_w=a.pref_w, sym_match=a.sym_match,
+                      sym_src=a.sym_src)
+        if track_saa:
+            init["saa_cnt"] = b.volsvc.saa_cnt
+            init["saa_num"] = b.volsvc.saa_num
+            xs["saa_g"] = b.volsvc.saa_group
+            xs["saa_src"] = b.volsvc.saa_src
+        if use_max_ebs:
+            init["pd_ebs"] = b.volsvc.pd_node_ebs
+            xs["pd_pod_ebs"] = b.volsvc.pd_pod_ebs
+            xs["pd_extra_ebs"] = b.volsvc.pd_extra_ebs
+        if use_max_gce:
+            init["pd_gce"] = b.volsvc.pd_node_gce
+            xs["pd_pod_gce"] = b.volsvc.pd_pod_gce
+            xs["pd_extra_gce"] = b.volsvc.pd_extra_gce
+        if carry is not None:
+            init.update({k: v for k, v in carry.items() if k in init})
+        final, choices = jax.lax.scan(step, init, xs, unroll=SCAN_UNROLL)
+        return choices, final["counter"], final
+
     # -- joint batched assignment (the LP-relaxed global solve) ----------
 
     # kt-xray: no-donate(b/c flow on into the repair scan of the same
@@ -1111,6 +1686,7 @@ class Solver:
 
         Returns (score_bias [P, N] = -price cost, repair-order key [P]).
         """
+        c = widen_cluster(c)
         feasible, scores = self.evaluate(b, c)
         if extra_mask is not None:
             feasible &= extra_mask
@@ -1174,6 +1750,7 @@ class Solver:
         cache could amortize as a unit.  One trace means one XLA program,
         persisted once, deserialized on every later start
         (tests/test_joint_solver.py pins the cold-vs-warm gap)."""
+        c = widen_cluster(c)
         bias, key = self._price_iterate(b, c, n_iters, extra_mask)
         if score_bias is not None:
             bias = bias + score_bias
@@ -1215,7 +1792,8 @@ class Solver:
 _POD_AXIS_FIELDS = ("request", "zero_request", "nonzero", "best_effort",
                     "host_idx", "ports", "vol_ro", "vol_rw", "tol_nosched",
                     "tol_prefer", "has_tolerations", "images", "sel_group",
-                    "spread_group", "spread_incr", "avoid_group")
+                    "spread_group", "spread_incr", "avoid_group",
+                    "nz_tmpl_idx")
 _AFF_POD_AXIS_FIELDS = ("match_src", "aff_need", "aff_self", "anti_need",
                         "pref_w", "decl_match", "decl_src", "sym_match",
                         "sym_src")

@@ -58,6 +58,9 @@ class PodBatch:
     node_zone_id: np.ndarray   # [N] int32 — compact zone id, -1 = no zone
     avoid_group: np.ndarray    # [P] int32 — controller-signature group
     avoid_rows: np.ndarray     # [G, N] bool — NodePreferAvoidPods hit
+    nz_tmpl_idx: np.ndarray    # [P] int32 into nz_templates
+    nz_templates: np.ndarray   # [T, 2] int32 distinct nonzero rows
+    #                            (T=0: above cap, in-scan score path)
     aff: AffinityTensors       # inter-pod (anti-)affinity sig tables
     volsvc: VolSvcTensors      # volume counts/zones + service (anti-)affinity
 
@@ -250,6 +253,21 @@ def _node_zone_ids(nt: fc.NodeTensors, space: fc.FeatureSpace) -> np.ndarray:
         _, inv = np.unique(packed[has], return_inverse=True)
         ids[has] = inv.astype(np.int32)
     return ids
+
+
+_DEFAULT_NZ_ROW: Optional[np.ndarray] = None
+
+
+def _default_nz_row() -> np.ndarray:
+    """[2] int32 — the nonzero row of a request-less pod, computed once
+    through ``fc.pod_nonzero_row`` (the exact encoder pad/inert pods
+    use) so the always-present template row can never diverge from what
+    a pad pod actually contributes."""
+    global _DEFAULT_NZ_ROW
+    if _DEFAULT_NZ_ROW is None:
+        _DEFAULT_NZ_ROW = fc.pod_nonzero_row(
+            api.Pod(name="__nz-default", namespace="__nz__"))
+    return _DEFAULT_NZ_ROW
 
 
 def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
@@ -496,6 +514,32 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
         else:
             volsvc = empty_volsvc(p, n)
 
+    # Nonzero-request templates for the fused scan's template-factored
+    # score planes (engine/solver.py _fused_scan): the distinct nonzero
+    # rows, pow2-row-padded (padcap's "b_nztmpl" axis keeps the bucket
+    # monotonic across batches).  Above the cap the table compiles away
+    # (shape 0) and the scan keeps its in-step score path.
+    from kubernetes_tpu.engine.solver import DYN_TEMPLATE_CAP
+    # The default nonzero row (a request-less pod's non_zero_request) is
+    # ALWAYS in the table: chunk/gang pad pods carry exactly it, and a
+    # live padded batch must not grow the template table past what the
+    # prewarm batches (which are never padded) traced — that cap bump
+    # minted an unwarmed scan shape on the wire clock.  Derived through
+    # the SAME row encoder the pad pods go through (not re-derived
+    # constants), so the two can never diverge.
+    nz_uniq, nz_inv = np.unique(
+        np.concatenate([nonzero, _default_nz_row()[None]]), axis=0,
+        return_inverse=True)
+    if 0 < len(nz_uniq) <= DYN_TEMPLATE_CAP:
+        # Row floor of 8 bounds tiny-batch wobble to one shape.
+        rows = max(_pow2(len(nz_uniq)), 8)
+        nz_templates = np.zeros((rows, 2), np.int32)
+        nz_templates[:len(nz_uniq)] = nz_uniq
+        nz_tmpl_idx = nz_inv[:-1].astype(np.int32)[tpl_idx]
+    else:
+        nz_templates = np.zeros((0, 2), np.int32)
+        nz_tmpl_idx = np.zeros(p, np.int32)
+
     return PodBatch(
         pods=list(pods), request=request[tpl_idx],
         zero_request=zero_req[tpl_idx], nonzero=nonzero[tpl_idx],
@@ -511,6 +555,7 @@ def compile_batch(pods: Sequence[api.Pod], nt: fc.NodeTensors,
         spread_has_zones=sp_hz, spread_incr=spread_incr[tpl_idx],
         node_zone_id=node_zone_id, avoid_group=avoid_group[tpl_idx],
         avoid_rows=_pad_rows_pow2(np.stack(avoid_rows)),
+        nz_tmpl_idx=nz_tmpl_idx, nz_templates=nz_templates,
         aff=aff, volsvc=volsvc)
 
 

@@ -53,7 +53,13 @@ AXES: dict[str, list[tuple[str, str, int, object]]] = {
                  ("", "spread_has_zones", 0, False),
                  ("", "spread_incr", 1, False)],
     "b_avoid": [("", "avoid_rows", 0, False)],
+    "b_nztmpl": [("", "nz_templates", 0, 0)],
 }
+
+# Axes where an EMPTY table is a semantic sentinel (feature disabled for
+# this batch — the fused scan's over-cap fallback), not a size-0 count:
+# padding it up would fabricate live rows.
+SKIP_EMPTY_AXES = frozenset({"b_nztmpl"})
 
 
 def pow2(x: int) -> int:
@@ -106,6 +112,8 @@ def apply_caps(batch, caps: dict[str, int]):
         container0, field0, axis0, _ = fields[0]
         src0 = batch if container0 == "" else getattr(batch, container0)
         current = getattr(src0, field0).shape[axis0]
+        if current == 0 and axis_name in SKIP_EMPTY_AXES:
+            continue
         cap = max(caps.get(axis_name, 1), current)
         caps[axis_name] = cap
         if cap == current:

@@ -43,25 +43,31 @@ def make_mesh(n_devices: Optional[int] = None, batch: int = 1) -> Mesh:
 
 # Which DeviceCluster fields carry the node axis as dim 0 (all of them).
 _CLUSTER_NODE_FIELDS = set(DeviceCluster._fields)
-# DeviceBatch fields whose dim 0 is the pod axis.
-_BATCH_POD_FIELDS = {"request", "zero_request", "nonzero", "best_effort",
-                     "host_idx", "ports", "vol_ro", "vol_rw", "tol_nosched",
-                     "tol_prefer", "has_tolerations", "images", "sel_group",
-                     "spread_group", "spread_incr", "avoid_group"}
+# DeviceBatch fields whose dim 0 is the pod axis — the solver's
+# slice/permute registry IS the authority (a field added there, like
+# nz_tmpl_idx, must shard here too; a hand-copied set silently
+# diverged once).
+from kubernetes_tpu.engine.solver import _POD_AXIS_FIELDS as \
+    _BATCH_POD_FIELDS_TUPLE  # noqa: E402 — registry import, not a cycle
+_BATCH_POD_FIELDS = set(_BATCH_POD_FIELDS_TUPLE)
 # Group tables etc. whose last/only meaningful axis is nodes.
 _BATCH_NODE_LAST_FIELDS = {"sel_required", "sel_pref_counts",
                            "spread_node_counts", "avoid_rows"}
-_BATCH_REPLICATED_FIELDS = {"spread_zone_counts", "spread_has_zones"}
+_BATCH_REPLICATED_FIELDS = {"spread_zone_counts", "spread_has_zones",
+                            "nz_templates"}
 _BATCH_NODE_VEC_FIELDS = {"node_zone_id"}
 
 
 def shard_cluster(c: DeviceCluster, mesh: Mesh) -> DeviceCluster:
-    """Place every cluster tensor with its node axis sharded over the mesh."""
+    """Place every cluster tensor with its node axis sharded over the
+    mesh.  Form-generic: the narrow wire form (solver.NarrowCluster)
+    also carries the node axis as dim 0 of every plane, so both
+    resident layouts shard under the same rule."""
     out = {}
-    for name, arr in zip(DeviceCluster._fields, c):
+    for name, arr in zip(type(c)._fields, c):
         spec = P(NODE_AXIS) if arr.ndim == 1 else P(NODE_AXIS, None)
         out[name] = jax.device_put(arr, NamedSharding(mesh, spec))
-    return DeviceCluster(**out)
+    return type(c)(**out)
 
 
 # DeviceAffinity: [S, N] row tables shard over nodes, [P, S] incidence over

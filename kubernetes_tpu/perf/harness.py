@@ -79,8 +79,23 @@ def _make_daemon(num_nodes: int, profile: str = "uniform",
         if dest is not None:
             pod.node_name = dest
             sched.cache.add_pod(pod)
-    return Scheduler(SchedulerConfig(algorithm=sched, binder=InMemoryBinder(),
-                                     async_bind=False))
+    daemon = Scheduler(SchedulerConfig(algorithm=sched,
+                                       binder=InMemoryBinder(),
+                                       async_bind=False))
+    from kubernetes_tpu.utils import knobs
+    import jax as _jax
+    if _jax.default_backend() != "tpu" and \
+            not knobs.get_int("KT_STREAM_CHUNK"):
+        # The density rig streams the avalanche in pipelined 4096-pod
+        # chunks on local backends (the wire rig's discipline): the
+        # one-shot 30k-step scan slices its hoisted planes out of a
+        # ~600 MB array with measurably worse locality (~278 vs
+        # ~225 µs/step at 30k x 5k), produces zero readback progress
+        # until the whole queue solves, and compiles a queue-length
+        # shape the ladder can't pre-trace.  A tunneled chip keeps the
+        # one-shot default: each launch is a full RTT there.
+        daemon.STREAM_THRESHOLD = 4096
+    return daemon
 
 
 def density(num_nodes: int, num_pods: int, profile: str = "uniform",
@@ -251,6 +266,13 @@ def warm_start_compile_s(num_nodes: int, num_pods: int,
     return time.perf_counter() - t0
 
 
+class ZeroBoundError(RuntimeError):
+    """A wire run bound NOTHING before the stall detector fired — a
+    rig/daemon fault, not a throughput sample.  BENCH_r11 medianed one
+    of these away as 0.0 pods/s; now the run fails loudly and bench.py
+    accounts it as a failed run instead of a sample."""
+
+
 @dataclass
 class WireDensityResult:
     num_nodes: int
@@ -266,6 +288,13 @@ class WireDensityResult:
     # Per-stage wall-time breakdown (daemon-side stages of the timed
     # window; apiserver-side time shows up as bind wall time).
     stages: dict = None
+    # Where the pre-clock warm wall actually went: the prewarm audit's
+    # per-signature {hits, misses, seconds} (scheduler.prewarm_cache_
+    # stats) plus the vocabulary pre-intern pass — BENCH_r11's "warm
+    # compile 40-49s" was mostly ladder EXECUTION (tracing a whole-queue
+    # bucket runs a 2x30720-step scan), not cache-dodging compiles; the
+    # hit/miss counters pin that attribution.
+    warm_breakdown: dict = None
 
 
 def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
@@ -360,31 +389,48 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         # fixed shape — so the whole run compiles exactly one device
         # program, no matter what sizes the arrival race produces.
         daemon.STREAM_THRESHOLD = 1
-        # On a tunneled chip each executable launch costs a full RTT
-        # (~250 ms) and dependent launches cannot pipeline (the scan
-        # carry serializes them), so the fastest wire drain is ONE
-        # launch: accumulate the arrival burst into a single chunk
-        # covering the whole queue.  Measured r5: 4,700 -> 6,300 pods/s
-        # over the 4096-chunk pipeline at 30k/5k.  KT_WIRE_CHUNK /
-        # KT_WIRE_ACCUM expose the space for measurement.
+        # Chunking policy is backend-shaped.  On a TUNNELED chip each
+        # executable launch costs a full RTT (~250 ms) and dependent
+        # launches cannot pipeline (the scan carry serializes them), so
+        # the fastest drain is ONE whole-queue launch (measured r5:
+        # 4,700 -> 6,300 pods/s over the 4096-chunk pipeline at 30k/5k)
+        # with a seconds-scale accumulation window.  On a local backend
+        # launches are cheap and the single-chunk drain is actively
+        # harmful twice over: binds make zero progress for the whole
+        # scan (BENCH_r11's zero-bound flake was the stall detector
+        # firing just before a ~15 s single chunk produced its first
+        # bind), and the pipeline cannot overlap solve with assume/bind.
+        # 4096-pod chunks keep one compiled shape, stream binds
+        # continuously, and halve the warm ladder's execution wall
+        # (tracing a whole-queue bucket runs a 2x-queue-length scan).
+        # KT_WIRE_CHUNK / KT_WIRE_ACCUM (ms) expose the space.
+        import jax as _jax
+        tunneled = _jax.default_backend() == "tpu"
         daemon.stream_chunk = knobs.get_int(
-            "KT_WIRE_CHUNK", default=(num_pods + 2047) // 2048 * 2048)
+            "KT_WIRE_CHUNK",
+            default=(num_pods + 2047) // 2048 * 2048 if tunneled
+            else min(4096, (num_pods + 2047) // 2048 * 2048))
         # Coalesce the arrival race into full chunks through the batch
         # former's deadline (scheduler/batchformer.py): a trickle-fed
         # drain otherwise pays a full padded scan (plus per-launch tunnel
         # overhead) for every fragment the creators happen to land.  The
         # former exits early once arrivals go idle, so the deadline is a
-        # ceiling, not a tax.
-        daemon.pipeline.former.deadline_s = \
-            knobs.get_float("KT_WIRE_ACCUM")
-        # Start the adaptive target at the wire chunk: this rig WANTS
-        # whole-burst accumulation (one launch beats chunking on a
-        # tunneled chip), not the serving default of growing up from
-        # the floor bucket.
+        # ceiling, not a tax.  The knob is in MILLISECONDS (its declared
+        # contract — the r11 rig read it as seconds, a mislabeled-units
+        # bug that silently parked every drain 3 s); default: whole-burst
+        # accumulation on a tunneled chip, chunk-sized batching locally.
+        daemon.pipeline.former.deadline_s = knobs.get_float(
+            "KT_WIRE_ACCUM", default=3000.0 if tunneled else 20.0) / 1e3
+        # Start the adaptive target at the wire chunk rather than the
+        # serving default of growing up from the floor bucket.
         daemon.pipeline.former._target = daemon.stream_chunk_size()
 
         # Warm before the clock (the reference excludes apiserver warmup
-        # the same way); the cold-compile cost is reported, not hidden.
+        # the same way); the cold-compile cost is reported, not hidden —
+        # and ATTRIBUTED: warm_breakdown carries the pre-intern wall
+        # plus prewarm's per-signature {hits, misses, seconds}, so a
+        # cache-dodging signature (misses on a warm start) is visible
+        # instead of folded into one mislabeled "warm compile" number.
         t_warm = time.perf_counter()
         pods = synth.make_pods(num_pods, profile=profile)
         # Pre-intern the LIVE pod set's vocabulary (ports/volumes/taints/
@@ -392,17 +438,24 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         # mid-run would re-specialize the scan on the clock (measured
         # ~10 s of XLA recompiles on the first live drain otherwise).
         factory.algorithm._compile(pods, device=False)
+        t_intern = time.perf_counter() - t_warm
         # Trace the full bucket ladder (floor -> wire chunk), both jit
         # signatures per bucket: the arrival race can legally drain any
         # ladder bucket, and any shape first seen mid-run would
         # XLA-compile on the clock (~5 s).  With the persistent compile
-        # cache populated this whole pass deserializes in well under a
-        # second; cold, it IS the once-per-machine compile tax.
+        # cache populated, compiles deserialize — the remaining warm
+        # wall is ladder EXECUTION (each bucket trace runs a real
+        # 2x-bucket scan), which scales with the wire chunk.
         warm_pods = synth.make_pods(
             min(num_pods, 2 * daemon.stream_chunk_size()),
             profile=profile, name_prefix="warm")
         daemon.prewarm(sample_pods=warm_pods)
         warm_s = time.perf_counter() - t_warm
+        warm_breakdown = {
+            "pre_intern_s": round(t_intern, 3),
+            "prewarm": {str(k): v for k, v in
+                        daemon.prewarm_cache_stats.items()},
+        }
 
         pod_jsons = [pod_to_json(pod) for pod in pods]
 
@@ -467,6 +520,13 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         last_change = time.perf_counter()
         stalled = False
         timeline: list[tuple[float, int]] = []
+        # No-progress stall window: must exceed the longest legitimate
+        # bind-silent stretch — a whole-queue single chunk (tunneled-
+        # chip mode) produces its FIRST bind only after the entire scan,
+        # which is exactly how r11's 15 s window manufactured a
+        # zero-bound "run".
+        stall_window = 15.0 if daemon.stream_chunk_size() < num_pods \
+            else max(30.0, timeout_s / 6)
         while time.time() < deadline:
             now_bound = factory.daemon.config.metrics.binding_latency.count
             timeline.append((time.perf_counter() - start, now_bound))
@@ -475,7 +535,7 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
                 last_change = time.perf_counter()
             if bound >= num_pods:
                 break
-            if time.perf_counter() - last_change > 15.0:
+            if time.perf_counter() - last_change > stall_window:
                 stalled = True
                 break
             time.sleep(0.25)
@@ -484,6 +544,15 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         # detection — the tail is idle requeue time of unschedulable pods.
         elapsed = (last_change if stalled else time.perf_counter()) - start
         bound = factory.daemon.config.metrics.binding_latency.count
+        if bound == 0:
+            # A zero-bound run is a rig fault, never a sample: fail the
+            # run loudly instead of returning 0.0 pods/s for a median
+            # to absorb (the BENCH_r11 flake).
+            raise ZeroBoundError(
+                f"density-wire bound 0/{num_pods} pods before the "
+                f"{stall_window:.0f}s stall window (create "
+                f"{create_s:.1f}s, warm {warm_s:.1f}s) — daemon never "
+                f"drained")
         if not quiet:
             print(f"density-wire {num_nodes} nodes x {num_pods} pods: "
                   f"{bound} bound in {elapsed:.3f}s = "
@@ -495,7 +564,8 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
             scheduled=int(bound),
             pods_per_second=int(bound) / max(elapsed, 1e-9),
             create_s=create_s, warm_s=warm_s, timeline=timeline,
-            stages=stage_breakdown(stages_before, _stage_snapshot()))
+            stages=stage_breakdown(stages_before, _stage_snapshot()),
+            warm_breakdown=warm_breakdown)
     finally:
         # Stop the daemon's reflector/scheduler threads on EVERY exit path
         # (left running they'd relist-spin against the dead apiserver).

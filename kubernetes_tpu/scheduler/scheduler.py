@@ -669,7 +669,15 @@ class Scheduler:
                     "seconds": round(dt, 3)}
                 return dt
 
-            for bucket in ladder:
+            # LARGEST bucket first: the monotonic content-axis caps
+            # (padcap — spread groups, nz templates, …) grow while the
+            # ladder traces, and ascending order would trace the small
+            # buckets at a stale cap that the big bucket's richer sample
+            # batch then outgrows — minting unwarmed (bucket, final-cap)
+            # shapes for every small live drain (measured as two
+            # post-prewarm compiles on the wire rig).  Descending order
+            # reaches the cap fixed point on the first trace.
+            for bucket in sorted(ladder, reverse=True):
                 want = 2 * bucket  # both scan signatures (no-carry + carry)
                 if sample_pods:
                     pods = list(sample_pods[:want])

@@ -79,6 +79,19 @@ _knob("KT_PREWARM", "0", "bool",
       "Trace the bucket ladder before the queue opens (perf rigs, prod)")
 _knob("KT_SCAN_UNROLL", "4", "int",
       "Unroll factor of the sequential-greedy placement scan")
+_knob("KT_FUSED", "1", "bool",
+      "Fused solve-scan step (sparse commits, template-factored scores, "
+      "fused select); 0 = the legacy full-plane scan body")
+_knob("KT_FEATURE_DTYPE", "narrow", "str",
+      "Resident cluster plane widths: 'narrow' = range-gated int16 "
+      "planes (mem columns stay int32), 'wide' = all int32")
+_knob("KT_DYN_TEMPLATES", "64", "int",
+      "Max distinct nonzero-request templates factored out of the scan "
+      "body; batches above it keep the in-scan score path")
+_knob("KT_PALLAS", "", "str",
+      "Fused-select kernel backend: '' = auto (Pallas on TPU, XLA "
+      "elsewhere), 'interpret' = Pallas interpret mode (CPU tests), "
+      "'0' = never Pallas")
 _knob("KT_PREEMPT_MAX_VICTIMS", "16", "int",
       "Victim-table depth per node for the preemption solve")
 _knob("KT_STREAM_CHUNK", "0", "int",
@@ -137,6 +150,9 @@ _knob("KT_BIND_CAPACITY", "1", "bool",
       "Server-side bind capacity validation (overcommit binds 409)")
 _knob("KT_NATIVE_APISERVER", "1", "bool",
       "Perf rigs use the native apiserver binary when available")
+_knob("KT_WATCH_FRAMES", "1", "bool",
+      "Clients request the framed (length-prefixed multi-event) watch "
+      "encoding; 0 = per-event NDJSON lines")
 # -- active-active HA ---------------------------------------------------
 _knob("KT_HA_SHARDS", "0", "int",
       "Namespace-hash shard count; >0 enables active-active HA")
@@ -168,9 +184,11 @@ _knob("KT_TENANT_URGENT_MS", "", "float",
       "deadline)")
 # -- perf rigs / tests --------------------------------------------------
 _knob("KT_WIRE_CHUNK", None, "int",
-      "density_wire stream chunk (default: pod count rounded up to 2048)")
-_knob("KT_WIRE_ACCUM", "3.0", "float",
-      "density_wire batch-formation deadline in ms")
+      "density_wire stream chunk (default: whole queue on a tunneled "
+      "chip, 4096 pipelined locally)")
+_knob("KT_WIRE_ACCUM", None, "float",
+      "density_wire batch-formation deadline in ms (default: 3000 on a "
+      "tunneled chip, 20 locally)")
 _knob("KT_PERF_ASSERTS", "1", "bool",
       "Wall-clock assertions in perf-sensitive tests (0 on slow rigs)")
 # -- concurrency discipline (ISSUE 13) ----------------------------------

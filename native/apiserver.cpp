@@ -722,6 +722,8 @@ struct Conn {
   bool is_watch = false;
   std::set<std::string> watch_kinds;
   FieldSelector sel;    // fielded watch (empty = everything)
+  bool frames = false;  // framed multi-event watch encoding (?frames=1)
+  std::string frame_items;  // comma-joined envelopes awaiting one flush
   double last_stream_write = 0;
   bool closing = false;
   bool deferred = false;  // queued for a DeferWrites batch flush
@@ -787,6 +789,26 @@ struct DeferWrites {
     g_defer_writes = false;
     for (Conn* c : g_deferred) {
       c->deferred = false;
+      if (!c->frame_items.empty() && !c->closing) {
+        // Framed flush: everything this scope fanned to a frames
+        // watcher leaves as ONE length-prefixed {"items":[...]} batch
+        // inside one chunk — the client decodes it with a single
+        // json.loads (the deferred per-line form was one per event).
+        std::string body;
+        body.reserve(c->frame_items.size() + 16);
+        body += "{\"items\":[";
+        body += c->frame_items;
+        body += "]}";
+        c->frame_items.clear();
+        std::string payload = "=" + std::to_string(body.size()) + "\n";
+        payload += body;
+        payload += "\n";
+        char hdr[16];
+        int hn = snprintf(hdr, sizeof hdr, "%zx\r\n", payload.size());
+        c->out.append(hdr, hn);
+        c->out += payload;
+        c->out += "\r\n";
+      }
       if (c->closing || c->out.empty()) continue;
       ssize_t w = ::send(c->fd, c->out.data(), c->out.size(), MSG_NOSIGNAL);
       if (w < 0) {
@@ -833,6 +855,19 @@ void Store::emit(const char* etype, const std::string& kind,
         if (!cache) cache = make_line(nt, *obj_json);
         dl = cache.get();
       }
+    }
+    if (c->frames && g_defer_writes) {
+      // Framed path: accumulate the bare envelope (the line minus its
+      // trailing newline); the DeferWrites flush wraps the batch into
+      // one length-prefixed frame per watcher.
+      if (!c->frame_items.empty()) c->frame_items += ',';
+      c->frame_items.append(dl->data(), dl->size() - 1);
+      if (!c->deferred) {
+        c->deferred = true;
+        g_deferred.push_back(c);
+      }
+      c->last_stream_write = now_s();
+      continue;
     }
     // One chunk per event here; the kernel coalesces back-to-back sends,
     // and the chunked framing is per-write anyway.
@@ -1115,7 +1150,7 @@ static void handle_list(Conn* c, const std::string& kind,
 }
 
 static void handle_watch(Conn* c, const std::string& kind, uint64_t from,
-                         const FieldSelector& sel) {
+                         const FieldSelector& sel, bool frames) {
   // Too-old check mirrors memstore.watch: the requested rv must still be
   // inside (or adjacent to) the buffered window.
   if (!g_store.window.empty() && from + 1 < g_store.window.front().rv &&
@@ -1129,6 +1164,7 @@ static void handle_watch(Conn* c, const std::string& kind, uint64_t from,
   c->is_watch = true;
   c->watch_kinds.insert(kind);
   c->sel = sel;
+  c->frames = frames;
   c->last_stream_write = now_s();
   g_store.watchers.push_back(c);
   // Replay buffered events after `from`, with the same set-transition
@@ -1188,6 +1224,11 @@ static void do_create_list(Conn* c, const std::string& kind,
   std::string body = "{\"kind\":\"CreateListResult\",\"created\":";
   std::string results;
   int created = 0;
+  // One flushed write per watcher for the whole batch (and one framed
+  // {"items":[...]} batch for frames watchers) instead of a chunk +
+  // send() attempt per created object per watcher — the create storm
+  // is the wire bench's dominant event volume.
+  DeferWrites defer;
   for (auto& it : items->arr) {
     if (it->type != JValue::Obj) {
       results += "{\"code\":400,\"error\":\"not an object\"},";
@@ -1354,7 +1395,10 @@ static bool dispatch(Conn* c, const std::string& method,
       if (w != params.end() && (w->second == "1" || w->second == "true")) {
         uint64_t from = strtoull(params["resourceVersion"].c_str(),
                                  nullptr, 10);
-        handle_watch(c, kind, from, sel);
+        auto f = params.find("frames");
+        bool frames = f != params.end() &&
+                      (f->second == "1" || f->second == "true");
+        handle_watch(c, kind, from, sel, frames);
         return !c->is_watch ? true : false;
       }
       handle_list(c, kind, sel);

@@ -686,3 +686,78 @@ def test_xray_unstamped_artifacts_ratchet_nothing():
             ("BENCH_r11.json", dict(_parsed(p50=1.0), xray=_xray("aa")))]
     assert cb.check_xray(arts, soak_artifacts=[],
                          manifest=None) == []
+
+
+# -- wire + scatter ratchets (ISSUE 15) ---------------------------------
+
+def _wire_art(median=4000.0, zero=0, backend="cpu", scatter=None):
+    d = _parsed(p50=6.0)
+    d["backend"] = backend
+    d["wire"] = {"median_pods_per_second": median,
+                 "zero_bound_runs": zero}
+    if scatter is not None:
+        d["device"] = _device(scatter=scatter)
+    return d
+
+
+def test_wire_zero_bound_run_fails():
+    problems = cb.check_wire([("BENCH_r15.json", _wire_art(zero=1))])
+    assert problems and "zero-bound" in problems[0]
+
+
+def test_wire_throughput_regression_fails_and_noise_passes():
+    arts = [("BENCH_r11.json", _wire_art(median=4000.0)),
+            ("BENCH_r15.json", _wire_art(median=3000.0))]
+    assert any("wire throughput regressed" in p
+               for p in cb.check_wire(arts))
+    arts[-1] = ("BENCH_r15.json", _wire_art(median=3900.0))
+    assert cb.check_wire(arts) == []
+
+
+def test_wire_ratchet_scans_back_past_other_backends():
+    arts = [("BENCH_r11.json", _wire_art(median=4000.0, backend="cpu")),
+            ("BENCH_r12.json", _wire_art(median=9000.0, backend="tpu")),
+            ("BENCH_r15.json", _wire_art(median=3000.0, backend="cpu"))]
+    assert any("wire throughput regressed" in p
+               for p in cb.check_wire(arts))
+
+
+def test_wire_artifacts_without_wire_section_ratchet_nothing():
+    assert cb.check_wire([("BENCH_r01.json", _parsed(p50=6.0))]) == []
+
+
+def test_scatter_bytes_per_pod_regression_fails():
+    arts = [("BENCH_r11.json", _wire_art(scatter=80.0)),
+            ("BENCH_r15.json", _wire_art(scatter=120.0))]
+    assert any("scatter bytes-per-pod regressed" in p
+               for p in cb.check_scatter_bytes(arts))
+    arts[-1] = ("BENCH_r15.json", _wire_art(scatter=60.0))
+    assert cb.check_scatter_bytes(arts) == []
+
+
+def test_scatter_ratchet_scans_back_same_backend():
+    arts = [("BENCH_r11.json", _wire_art(scatter=80.0, backend="cpu")),
+            ("BENCH_r12.json", _wire_art(scatter=10.0, backend="tpu")),
+            ("BENCH_r15.json", _wire_art(scatter=120.0, backend="cpu"))]
+    assert any("scatter bytes-per-pod regressed" in p
+               for p in cb.check_scatter_bytes(arts))
+
+
+def test_all_runs_zero_bound_still_fails_without_a_median():
+    """A fully-broken rig (every wire run zero-bound) emits a wire
+    section with only the failure count — the check must fire on it."""
+    d = _parsed(p50=6.0)
+    d["backend"] = "cpu"
+    d["wire"] = {"zero_bound_runs": 3, "runs": []}
+    problems = cb.check_wire([("BENCH_r15.json", d)])
+    assert problems and "zero-bound" in problems[0]
+
+
+def test_all_wire_runs_errored_still_fails():
+    """A rig whose every wire run errored before sampling (no runs, no
+    zero-bounds) must fail too — not silently retire the wire ratchet."""
+    d = _parsed(p50=6.0)
+    d["backend"] = "cpu"
+    d["wire"] = {"zero_bound_runs": 0, "failed_runs": 3, "runs": []}
+    problems = cb.check_wire([("BENCH_r15.json", d)])
+    assert problems and "every wire run failed" in problems[0]

@@ -152,9 +152,13 @@ class TestChaosProxy:
         assert ev is not None and ev.key == "n2"
         w.stop()
         # Mid-event cut: one event passes, the second is half-delivered.
+        # Unframed watch: the proxy's event counter is line-granular, and
+        # this test is specifically about cutting BETWEEN NDJSON events
+        # (a cut mid-frame surfaces the same ERROR through the decode
+        # exception path).
         proxy.add_rule(fault="cut-stream", path=r"watch=1",
                        after_events=1, count=1)
-        w = client.watch("nodes", 0)
+        w = client.watch("nodes", 0, frames=False)
         types = []
         for _ in range(4):
             ev = w.next(timeout=2)

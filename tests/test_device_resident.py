@@ -38,13 +38,16 @@ def _rig(n_nodes: int = 40, **daemon_kw):
 
 def _assert_resident_matches_fresh(algo: GenericScheduler) -> None:
     """After a sync, the mirror must be bit-identical to a freshly
-    assembled full snapshot of the current host arrays."""
+    assembled full snapshot of the current host arrays (the narrow wire
+    form widens losslessly — comparing through widen_cluster IS the
+    dtype-policy soundness invariant)."""
     with algo.cache.lock:
         nt, agg, ep, nodes = algo.cache.snapshot()
         res = algo.resident.sync(nt, agg, algo.cache.space,
                                  algo.cache.take_dirty_rows(),
                                  algo.cache.tensor_epoch)
         fresh = sv.device_cluster(nt, agg, algo.cache.space)
+    res = sv.widen_cluster(res)
     for field, a, b in zip(sv.DeviceCluster._fields, fresh, res):
         assert np.array_equal(np.asarray(a), np.asarray(b)), \
             f"resident.{field} diverged from the full snapshot"

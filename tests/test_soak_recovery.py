@@ -316,8 +316,13 @@ class TestVerifier:
                                          algo.cache.space,
                                          algo.cache.tensor_epoch)
             row = min(set(range(6)) - algo.cache._dirty_rows)
-        algo.resident.dc = algo.resident.dc._replace(
-            requested=algo.resident.dc.requested.at[row, 0].add(999))
+        dc = algo.resident.dc
+        if hasattr(dc, "res16"):  # narrow wire form: requested cpu = col 3
+            algo.resident.dc = dc._replace(
+                res16=dc.res16.at[row, 3].add(999))
+        else:
+            algo.resident.dc = dc._replace(
+                requested=dc.requested.at[row, 0].add(999))
         v = Verifier(algo.cache, resident=algo.resident, sample=16)
         viol = v.verify_once()
         assert any(x.kind == "device_row" for x in viol)

@@ -622,6 +622,90 @@ def check_device(artifacts: list[tuple[str, dict]],
     return problems
 
 
+def check_wire(artifacts: list[tuple[str, dict]] | None = None,
+               tolerance: float = TOLERANCE) -> list[str]:
+    """The wire-path ratchet (ISSUE 15): the newest artifact's wire
+    median pods/s must not regress more than ``tolerance`` against the
+    LAST same-backend artifact carrying a wire section (check_ha-style
+    scan-back — a backend change re-baselines, a missing wire phase in
+    one artifact must not retire the comparison), and any recorded
+    zero-bound run fails outright (a zero-bound run is a rig fault the
+    harness now raises on; an artifact carrying one measured a broken
+    rig)."""
+    if artifacts is None:
+        artifacts = committed_artifacts()
+    problems: list[str] = []
+    if not artifacts:
+        return problems
+    new_name, new = artifacts[-1]
+    wire = new.get("wire") or {}
+    if not wire:
+        return problems
+    zero = wire.get("zero_bound_runs")
+    if zero:
+        problems.append(
+            f"{new_name}: {zero} zero-bound wire run(s) — the daemon "
+            f"never drained on a measured run; the artifact sampled a "
+            f"broken rig")
+    if wire.get("failed_runs") and not wire.get("runs"):
+        problems.append(
+            f"{new_name}: every wire run failed "
+            f"({wire['failed_runs']} errored) — the artifact carries "
+            f"no wire sample at all")
+    wired = [(name, parsed) for name, parsed in artifacts
+             if (parsed.get("wire") or {}).get("median_pods_per_second")
+             and parsed.get("backend") == new.get("backend")]
+    if len(wired) < 2 or wired[-1][0] != new_name:
+        return problems
+    prev_name, prev = wired[-2]
+    new_v = float(wire["median_pods_per_second"])
+    prev_v = float(prev["wire"]["median_pods_per_second"])
+    if new_v < prev_v * (1.0 - tolerance):
+        problems.append(
+            f"wire throughput regressed: {new_name} {new_v:,.0f} pods/s "
+            f"median vs {prev_name} {prev_v:,.0f} "
+            f"(-{(1 - new_v / prev_v) * 100:.0f}%, tolerance "
+            f"{tolerance * 100:.0f}%)")
+    return problems
+
+
+def check_scatter_bytes(artifacts: list[tuple[str, dict]] | None = None,
+                        tolerance: float = TOLERANCE) -> list[str]:
+    """Scatter bytes-per-pod ratchet (ISSUE 15 dtype narrowing): the
+    steady-state scatter bytes-per-pod must not regress vs the last
+    same-backend artifact carrying the column (scan-back, not
+    immediate-predecessor — check_device's total-bytes check keeps its
+    adjacent comparison; this row pins the narrowing win
+    specifically)."""
+    if artifacts is None:
+        artifacts = committed_artifacts()
+    problems: list[str] = []
+    if not artifacts:
+        return problems
+    new_name, new = artifacts[-1]
+
+    def scatter_bpp(parsed: dict) -> float | None:
+        v = ((parsed.get("device") or {}).get("bytes_per_pod")
+             or {}).get("scatter")
+        return float(v) if v else None
+
+    rows = [(name, parsed) for name, parsed in artifacts
+            if scatter_bpp(parsed) is not None
+            and parsed.get("backend") == new.get("backend")]
+    if len(rows) < 2 or rows[-1][0] != new_name:
+        return problems
+    prev_name, prev = rows[-2]
+    new_v, prev_v = scatter_bpp(new), scatter_bpp(prev)
+    if new_v > prev_v * (1.0 + tolerance):
+        problems.append(
+            f"scatter bytes-per-pod regressed: {new_name} {new_v:.1f} "
+            f"B/pod vs {prev_name} {prev_v:.1f} B/pod "
+            f"(+{(new_v / prev_v - 1) * 100:.0f}%, tolerance "
+            f"{tolerance * 100:.0f}%) — the narrow wire planes widened "
+            f"back")
+    return problems
+
+
 def committed_manifest_summary() -> dict | None:
     """{'hash', 'programs'} of tools/shape_manifest.json — plain JSON
     read (no jax, no tracing; the full drift check is
@@ -694,6 +778,8 @@ def check(artifacts: list[tuple[str, dict]] | None = None,
     if artifacts is None:
         artifacts = committed_artifacts()
     problems = check_device(artifacts, tolerance)
+    problems += check_wire(artifacts, tolerance)
+    problems += check_scatter_bytes(artifacts, tolerance)
     if len(artifacts) < 2:
         return problems
     (prev_name, prev), (new_name, new) = artifacts[-2], artifacts[-1]
