@@ -259,17 +259,28 @@ class MemStore:
                 for line in f:
                     try:
                         rec = json.loads(line)
-                    except ValueError:
-                        break  # torn final line from a crash mid-append
+                        # Extract EVERY field before counting the record
+                        # good: a tear can land exactly on a line
+                        # boundary and leave valid JSON that is not a
+                        # complete record (e.g. '{"t": "ADDED"}' from a
+                        # truncated buffer flush) — replaying it would
+                        # crash recovery, and counting it would weld
+                        # later appends onto a half-record.
+                        etype, kind, key = rec["t"], rec["k"], rec["key"]
+                        rv, obj = int(rec["rv"]), rec["o"]
+                    except (ValueError, KeyError, TypeError):
+                        break  # torn/partial final record: stop replay
                     good_end += len(line)
                     self._wal_count += 1
-                    kind, key = rec["k"], rec["key"]
                     bucket = self._objects.setdefault(kind, {})
-                    if rec["t"] == "DELETED":
+                    if etype == "DELETED":
                         bucket.pop(key, None)
                     else:
-                        bucket[key] = rec["o"]
-                    self._rv = max(self._rv, rec["rv"])
+                        bucket[key] = obj
+                    # Monotonic: the RV counter never regresses across a
+                    # crash — resumed watches and CAS preconditions rely
+                    # on it.
+                    self._rv = max(self._rv, rv)
             if good_end < os.path.getsize(wal):
                 # Drop the torn tail NOW: appending after it would weld
                 # the next record onto the fragment, and the restart after

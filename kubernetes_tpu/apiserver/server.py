@@ -35,6 +35,7 @@ from urllib.parse import parse_qs, urlparse
 
 from kubernetes_tpu.api import fieldsel
 from kubernetes_tpu.api.types import NAMESPACED_KINDS as _NAMESPACED
+from kubernetes_tpu.apiserver import flowcontrol as apf
 from kubernetes_tpu.apiserver.memstore import (ConflictError, MemStore,
                                                TooOldError)
 from kubernetes_tpu.apiserver.validation import (AdmissionError,
@@ -107,7 +108,8 @@ _STATUS_LINES = {
 }
 
 
-def make_handler(store: MemStore, auth=None, admission_control=None):
+def make_handler(store: MemStore, auth=None, admission_control=None,
+                 flow=None):
     # Store-aware admission chain (--admission-control order; default:
     # NamespaceLifecycle -> ServiceAccount -> anti-affinity veto ->
     # LimitRanger defaulting -> ResourceQuota), built once per server.
@@ -237,17 +239,22 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                 except (BrokenPipeError, ConnectionResetError):
                     return
 
-        def _send_json(self, code: int, obj) -> None:
+        def _send_json(self, code: int, obj, retry_after=None) -> None:
             self._send_raw(code, json.dumps(obj).encode(),
-                           "application/json")
+                           "application/json", retry_after)
 
-        def _send_raw(self, code: int, body: bytes, ctype: str) -> None:
-            """One response-assembly path for every content type."""
+        def _send_raw(self, code: int, body: bytes, ctype: str,
+                      retry_after=None) -> None:
+            """One response-assembly path for every content type.
+            ``retry_after`` (seconds, float ok — our clients parse it as
+            one) rides shed responses as a Retry-After header."""
             self._code = code
+            extra = b"" if retry_after is None else \
+                b"Retry-After: " + f"{retry_after:g}".encode() + b"\r\n"
             self.wfile.write(
                 _STATUS_LINES.get(code, _STATUS_LINES[400])
                 + b"Content-Type: " + ctype.encode()
-                + b"\r\nContent-Length: "
+                + b"\r\n" + extra + b"Content-Length: "
                 + str(len(body)).encode() + b"\r\n\r\n" + body)
             self.wfile.flush()
 
@@ -289,9 +296,26 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                 query.get("watch", ["0"])[0] in ("1", "true")
             t0 = time.perf_counter()
             self._code = 200
+            ticket = None
             try:
+                if flow is not None:
+                    # Priority-level admission BEFORE any routing work:
+                    # shed requests must cost the server nothing but the
+                    # classification and a 429 write.  The ticket spans
+                    # the whole request — for a watch, the whole stream.
+                    sub = parts[6] if len(parts) == 7 else ""
+                    ticket = flow.admit(method, _resource_of(parts),
+                                        is_watch, sub)
+                    if not ticket.ok:
+                        self._send_json(
+                            429, {"error": "the server is overloaded "
+                                  f"({ticket.reason}); retry later"},
+                            retry_after=ticket.retry_after)
+                        return True
                 return self._dispatch_inner(method, parts, query, raw)
             finally:
+                if ticket is not None:
+                    ticket.release()
                 dur = time.perf_counter() - t0
                 verb = "WATCH" if is_watch else (
                     method if method in _METRIC_VERBS else "other")
@@ -366,6 +390,14 @@ def make_handler(store: MemStore, auth=None, admission_control=None):
                 from kubernetes_tpu.utils import telemetry
                 self._send_raw(200, telemetry.dashboard_html().encode(),
                                "text/html; charset=utf-8")
+                return True
+            if parts == ["debug", "vars"]:
+                # Live flow-control state (the scheduler's /debug/vars
+                # idiom): per-level inflight/queue/shed counters — what
+                # the soak overload wave scrapes for its queue-depth
+                # bound.
+                self._send_json(200, {"overload": flow.report()
+                                      if flow is not None else None})
                 return True
             if len(parts) == 3 and parts[:2] == ["api", "v1"]:
                 kind = parts[2]
@@ -731,7 +763,8 @@ class _Server(socketserver.ThreadingTCPServer):
 def serve(store: MemStore, port: int = 0,
           host: str = "127.0.0.1", auth=None,
           tls_cert: str = "", tls_key: str = "",
-          client_ca: str = "", admission_control=None) -> _Server:
+          client_ca: str = "", admission_control=None,
+          flow=None) -> _Server:
     """``auth``: an apiserver.auth.AuthConfig; None = the reference's
     insecure port (no authn/z).
 
@@ -745,8 +778,13 @@ def serve(store: MemStore, port: int = 0,
     # latency registry lands in the ring /debug/timeseries serves.
     from kubernetes_tpu.utils import telemetry
     telemetry.ensure_started()
+    # Priority-level flow control, knobs read once here (never per
+    # request); pass an explicit FlowController to override caps in
+    # tests/rigs.
+    if flow is None:
+        flow = apf.FlowController.from_knobs()
     server = _Server((host, port),
-                     make_handler(store, auth, admission_control))
+                     make_handler(store, auth, admission_control, flow))
     if tls_cert:
         import ssl
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)

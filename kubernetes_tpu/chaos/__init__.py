@@ -15,10 +15,10 @@ from kubernetes_tpu.chaos.proxy import (FAULT_CUT_STREAM, FAULT_ERROR,
                                         ChaosProxy, Rule,
                                         bind_conflict_storm,
                                         heartbeat_drop, node_flap,
-                                        watch_cut_on_relist)
+                                        overload, watch_cut_on_relist)
 
 __all__ = ["ChaosProxy", "Rule", "FAULT_ERROR", "FAULT_RESET",
            "FAULT_LATENCY", "FAULT_CUT_STREAM", "heartbeat_drop",
            "node_flap", "watch_cut_on_relist", "bind_conflict_storm",
-           "DeviceChaos", "DeviceRule", "SimulatedDeviceError",
-           "BindMonitor"]
+           "overload", "DeviceChaos", "DeviceRule",
+           "SimulatedDeviceError", "BindMonitor"]

@@ -168,6 +168,20 @@ def watch_cut_on_relist(kind: str = "pods", every_nth: int = 2,
                  count=count)]
 
 
+def overload(kind: int = 429, retry_after_s: float | None = 0.5,
+             path: str = "", method: str = "", every_nth: int = 1,
+             count: int = -1) -> list[Rule]:
+    """A shedding control plane: answer ``kind`` (429 by default, or 503
+    for the generic brown-out shape) with an optional Retry-After on
+    every ``every_nth``-th matching request — the sustained-overload
+    shape the client's retry budget and AIMD window must absorb without
+    amplification.  ``path``/``method`` scope the storm (e.g. only
+    creates, only binds); the default sheds everything forwarded."""
+    return [Rule(fault=FAULT_ERROR, method=method, path=path, status=kind,
+                 retry_after=retry_after_s, every_nth=every_nth,
+                 count=count)]
+
+
 def bind_conflict_storm(every_nth: int = 3, count: int = -1) -> list[Rule]:
     """409 every Nth binding POST — the competing-writer shape: the
     daemon must forget+requeue exactly the victims while the rest of the

@@ -29,15 +29,21 @@ from kubernetes_tpu.apiserver.memstore import (ConflictError, Event,
                                                TooOldError)
 from kubernetes_tpu.utils import knobs, metrics, threadreg
 from kubernetes_tpu.utils import trace
-from kubernetes_tpu.utils.flowcontrol import TokenBucketRateLimiter
+from kubernetes_tpu.utils.flowcontrol import (AIMDLimiter,
+                                              TokenBucketRateLimiter)
 
 DEFAULT_QPS = 5.0     # restclient/config.go:186 (perf rigs raise to 5000)
 DEFAULT_BURST = 10    # restclient/config.go:190
 
 # Retry policy for idempotent verbs (GET/HEAD list/get; watch reconnects
 # are paced by the reflector's relist backoff).  Non-idempotent verbs
-# (POST bindings!) are never retried here — their callers own the
-# semantics (the scheduler forgets + requeues on bind failure).
+# (POST bindings!) are never retried on transport faults or 5xx — their
+# callers own the semantics (the scheduler forgets + requeues on bind
+# failure).  The ONE exception is a 429 carrying Retry-After: that is the
+# apiserver flow controller's shed contract, emitted BEFORE dispatch
+# touched the store, so re-sending any verb is safe — and binds are
+# CAS-idempotent and creates name-deduped regardless (PR 11/16 safety
+# arguments).
 RETRIABLE_STATUS = (429, 500, 502, 503, 504)
 DEFAULT_MAX_RETRIES = 3
 RETRY_BACKOFF_BASE = 0.05   # jittered, doubling per attempt
@@ -123,8 +129,12 @@ class TLSConfig:
 
 
 class APIError(Exception):
-    def __init__(self, status: int, message: str = ""):
+    def __init__(self, status: int, message: str = "",
+                 retry_after: Optional[float] = None):
         self.status = status
+        # Retry-After seconds from a shedding server (flow-control 429);
+        # the reflector's relist backoff honors it over its own schedule.
+        self.retry_after = retry_after
         super().__init__(f"HTTP {status}: {message}")
 
 
@@ -192,6 +202,13 @@ class APIClient:
         # race would orphan a ThreadPoolExecutor for process lifetime.
         self._bind_pool = None
         self._bind_pool_lock = threading.Lock()
+        # Adaptive bind fan-out window: a shedding server (429) halves
+        # the concurrent chunk POSTs instead of re-offering the storm;
+        # clean round-trips probe back up to KT_BIND_PIPELINE.
+        self._bind_aimd = AIMDLimiter(
+            min_limit=knobs.get_int("KT_AIMD_MIN"),
+            max_limit=max(self.BIND_PIPELINE, 1),
+            backoff=knobs.get_float("KT_AIMD_BACKOFF"))
 
     def clone(self, qps: float = DEFAULT_QPS,
               burst: int = DEFAULT_BURST) -> "APIClient":
@@ -282,7 +299,8 @@ class APIClient:
         time.sleep(delay * (0.5 + random.random()))
 
     def _request(self, method: str, path: str,
-                 obj: Optional[dict] = None) -> dict:
+                 obj: Optional[dict] = None,
+                 retry_state: Optional[dict] = None) -> dict:
         self.limiter.accept()
         data = json.dumps(obj).encode() if obj is not None else None
         headers = {"Content-Type": "application/json"} if data else {}
@@ -310,8 +328,20 @@ class APIClient:
                 continue
             if status < 300:
                 return json.loads(body or b"{}")
-            if idempotent and status in RETRIABLE_STATUS and \
+            # A 429 WITH Retry-After is the flow controller's pre-dispatch
+            # shed: nothing was applied, so any verb may re-send.  (The
+            # eviction subresource's PDB-denial 429 carries no Retry-After
+            # and stays terminal.)  The AIMD window shrinks on every bind
+            # shed — even one the budget won't retry — so offered load
+            # tracks the server's capacity signal.
+            shed = status == 429 and retry_after is not None
+            if shed and "/bindings" in path:
+                self._bind_aimd.on_throttle()
+            if (shed or (idempotent and status in RETRIABLE_STATUS)) and \
                     self._retry_permitted(attempt):
+                if shed and not idempotent and retry_state is not None:
+                    retry_state["mutating_retries"] = \
+                        retry_state.get("mutating_retries", 0) + 1
                 self._retry_sleep(attempt, retry_after, verb=method)
                 attempt += 1
                 continue
@@ -320,7 +350,7 @@ class APIClient:
                 raise ConflictError(text)
             if status == 410:
                 raise TooOldError(text)
-            raise APIError(status, text)
+            raise APIError(status, text, retry_after=retry_after)
 
     def _object_path(self, kind: str, key: str) -> str:
         if kind in self._NAMESPACED or "/" in key:
@@ -337,7 +367,27 @@ class APIClient:
             raise
 
     def create(self, kind: str, obj: dict) -> dict:
-        return self._request("POST", f"/api/v1/{kind}", obj)
+        st: dict = {}
+        try:
+            return self._request("POST", f"/api/v1/{kind}", obj,
+                                 retry_state=st)
+        except ConflictError:
+            if not st.get("mutating_retries"):
+                raise
+            # Named-object dedupe: a shed-then-retried create may have
+            # landed on an attempt whose response never reached us (a
+            # proxy that 429s after forwarding).  Objects are named, so
+            # "already exists" after OUR retry means OUR create
+            # succeeded — return the stored object instead of a phantom
+            # conflict.
+            meta = obj.get("metadata") or {}
+            name = meta.get("name", "")
+            ns = meta.get("namespace") or \
+                ("default" if kind in self._NAMESPACED else "")
+            cur = self.get(kind, f"{ns}/{name}" if ns else name)
+            if cur is not None:
+                return cur
+            raise
 
     def update(self, kind: str, obj: dict) -> dict:
         ns = (obj.get("metadata") or {}).get("namespace", "")
@@ -418,10 +468,19 @@ class APIClient:
                         thread_name_prefix="bind-list")
 
         def one_chunk(chunk):
+            # The AIMD window gates fan-out INSIDE the worker: the pool
+            # keeps BIND_PIPELINE threads, but only window-many run a
+            # POST concurrently — after a server shed the window halves,
+            # so retried load decreases instead of re-offering the storm.
+            self._bind_aimd.acquire()
             try:
-                return self._bind_list_chunk(chunk)
+                res = self._bind_list_chunk(chunk)
+                self._bind_aimd.on_success()
+                return res
             except Exception as err:  # noqa: BLE001 — isolate the chunk
                 return [(0, f"bulk bind chunk failed: {err}")] * len(chunk)
+            finally:
+                self._bind_aimd.release()
 
         out: list[Optional[tuple[int, str]]] = []
         # Executor.map preserves chunk order, so per-item results stay
@@ -446,6 +505,15 @@ class APIClient:
         return [None if r.get("code") == 201 else
                 (r.get("code", 0), r.get("error", f"HTTP {r.get('code')}"))
                 for r in resp.get("results", [])]
+
+    def flow_report(self) -> dict:
+        """Client-side backpressure state for /debug/vars: the adaptive
+        bind window and how much of the retry budget a flapping or
+        shedding server has consumed."""
+        return {"aimd": self._bind_aimd.report(),
+                "retryBudgetSaturation":
+                    round(self._retry_budget.saturation(), 3),
+                "limiterSaturation": round(self.limiter.saturation(), 3)}
 
     def create_list(self, kind: str, objs: list[dict]) -> list[dict]:
         """Batch create: one POST carrying a v1 List; per-item results
@@ -531,10 +599,17 @@ class HTTPWatcher:
         resp = self._conn.getresponse()
         if resp.status >= 300:
             body = resp.read().decode(errors="replace")
+            retry_after = resp.getheader("Retry-After")
             self._conn.close()
             if resp.status == 410:
                 raise TooOldError(body)
-            raise APIError(resp.status, body)
+            try:
+                after = float(retry_after) if retry_after else None
+            except ValueError:
+                after = None
+            # A shed watch open (flow-control 429) carries the server's
+            # honest Retry-After so the reflector paces its re-open.
+            raise APIError(resp.status, body, retry_after=after)
         self._resp = resp
         self._thread = threadreg.spawn(self._pump, name=f"watch-{kind}",
                                        transient=True)

@@ -36,6 +36,23 @@ RELIST_BACKOFF_MAX = 30.0
 STREAM_MIN_HEALTHY = 1.0
 
 
+def _failure_delay(err: Exception, backoff: float) -> float:
+    """The wait before the next relist attempt after ``err``.
+
+    A 429 from a shedding server (flow control) carries an honest
+    Retry-After: honor it — the server computed when capacity frees, and
+    a generic jittered doubling would either hammer early or idle long
+    past it.  Small jitter ABOVE the hint keeps a reflector fleet from
+    returning in lockstep.  Everything else (transport faults, 5xx) keeps
+    the jittered doubling.  Duck-typed on status/retry_after so the
+    transport-agnostic reflector never imports the HTTP client."""
+    retry_after = getattr(err, "retry_after", None)
+    if getattr(err, "status", None) == 429 and retry_after is not None:
+        return min(retry_after * random.uniform(1.0, 1.25),
+                   RELIST_BACKOFF_MAX)
+    return backoff * random.uniform(0.5, 1.5)
+
+
 class Reflector:
     def __init__(self, source, kind: str, handler: Handler,
                  selector: Optional[Callable[[dict], bool]] = None,
@@ -118,11 +135,12 @@ class Reflector:
                                     else 0.0)
                     backoff = min(backoff * 2, RELIST_BACKOFF_MAX)
                     continue
-                except Exception:  # noqa: BLE001 — apiserver down: retry
-                    # Jittered doubling instead of the old fixed 1 s loop:
-                    # a fleet of reflectors against a flapping apiserver
-                    # must not relist in lockstep.
-                    self._stop.wait(backoff * random.uniform(0.5, 1.5))
+                except Exception as err:  # noqa: BLE001 — down: retry
+                    # Jittered doubling instead of the old fixed 1 s loop
+                    # (a fleet of reflectors against a flapping apiserver
+                    # must not relist in lockstep) — except a shedding
+                    # server's 429, whose Retry-After is honored exactly.
+                    self._stop.wait(_failure_delay(err, backoff))
                     backoff = min(backoff * 2, RELIST_BACKOFF_MAX)
                     continue
                 stream_started = time.monotonic()

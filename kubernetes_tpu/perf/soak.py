@@ -1302,6 +1302,502 @@ def run_tenancy_poison_wave(n_nodes: int = 60, pods_per_tenant: int = 150,
         locktrace.set_enabled(knobs.get_bool("KT_LOCKTRACE"))
 
 
+def _audit_wal_double_binds(storage_dir: str) -> tuple[int, int]:
+    """Replay the apiserver's durable record (snapshot + WAL) and count
+    pods whose ``spec.nodeName`` moved from one non-empty node to a
+    DIFFERENT non-empty node — the double-bind shape the bind CAS must
+    make impossible even across a SIGKILL.  Returns (double_binds,
+    records_audited).  The audit reads the server's own truth, not the
+    driver's bookkeeping: a zombie bind that landed between the kill and
+    the restart shows up here and nowhere else."""
+    node_of: dict[str, str] = {}
+    audited = 0
+    snap = os.path.join(storage_dir, "snapshot.json")
+    if os.path.exists(snap):
+        with open(snap, encoding="utf-8") as f:
+            objects = (json.load(f).get("objects") or {})
+        for key, obj in (objects.get("pods") or {}).items():
+            node_of[key] = ((obj.get("spec") or {})
+                            .get("nodeName") or "")
+    double = 0
+    wal = os.path.join(storage_dir, "wal.jsonl")
+    if os.path.exists(wal):
+        with open(wal, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                    etype, kind, key = rec["t"], rec["k"], rec["key"]
+                    obj = rec["o"]
+                except (ValueError, KeyError, TypeError):
+                    break  # torn tail: recovery truncates it too
+                audited += 1
+                if kind != "pods":
+                    continue
+                if etype == "DELETED":
+                    node_of.pop(key, None)
+                    continue
+                new_node = (((obj or {}).get("spec") or {})
+                            .get("nodeName") or "")
+                prev = node_of.get(key, "")
+                if prev and new_node and new_node != prev:
+                    double += 1
+                node_of[key] = new_node
+    return double, audited
+
+
+def run_apiserver_kill_wave(n_nodes: int = 60, avalanche_pods: int = 800,
+                            kill_at_bound: int = 150,
+                            settle_timeout: float = 180.0,
+                            quiet: bool = False) -> dict:
+    """The apiserver-kill wave (ISSUE 16): a REAL ``python -m
+    kubernetes_tpu.apiserver --storage-dir`` process is SIGKILLed
+    mid-avalanche — binds landing, backlog pending — and restarted on
+    the same port and storage dir.  The full scheduler rides through
+    the outage on its own machinery (client retries, reflector relist,
+    bind-conflict absorption); the wave then audits the three
+    crash-consistency invariants the ratchet pins:
+
+    * ZERO acknowledged-write loss — every create the driver got a 201
+      for before the kill is present after the restart (WAL replay);
+    * ZERO double-binds — replaying the server's own snapshot + WAL
+      finds no pod whose nodeName moved between non-empty nodes;
+    * ZERO stranded pods — the post-restart scheduler converges the
+      full avalanche (410/watch-break -> relist -> reschedule).
+    """
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from kubernetes_tpu.scheduler.factory import ConfigFactory
+
+    t_start = time.monotonic()
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    storage_dir = tempfile.mkdtemp(prefix="kt-soak-kill-")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    api_url = f"http://127.0.0.1:{port}"
+
+    def log(msg: str) -> None:
+        if not quiet:
+            print(f"kill[{time.monotonic() - t_start:6.1f}s] {msg}",
+                  file=sys.stderr)
+
+    def start_apiserver():
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubernetes_tpu.apiserver",
+             "--port", str(port), "--storage-dir", storage_dir],
+            env=dict(os.environ, PYTHONPATH=repo), cwd=repo,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError("apiserver died at startup")
+            try:
+                urllib.request.urlopen(f"{api_url}/healthz", timeout=2)
+                return proc
+            except OSError:
+                time.sleep(0.05)
+        proc.kill()
+        raise RuntimeError("apiserver never became ready")
+
+    direct = APIClient(api_url, qps=0, timeout=30.0)
+
+    def counts() -> tuple[int, int]:
+        items, _ = direct.list("pods")
+        bound = sum(1 for o in items
+                    if (o.get("spec") or {}).get("nodeName"))
+        return bound, len(items) - bound
+
+    proc = start_apiserver()
+    factory = None
+    # The scheduler rides through a ChaosProxy that adds a small
+    # per-bind latency: the wire path otherwise drains a whole chunk
+    # faster than one driver-side LIST can observe, and the kill MUST
+    # land while binds are demonstrably in flight.  The proxy dials the
+    # upstream per request, so it spans the apiserver restart; the
+    # driver's own polls go straight to the real server.
+    from kubernetes_tpu.chaos.proxy import FAULT_LATENCY, Rule
+    proxy = ChaosProxy(api_url).start()
+    proxy.add_rules([Rule(fault=FAULT_LATENCY, method="POST",
+                          path=r"/bindings", delay_s=0.05,
+                          every_nth=1)])
+    acked: list[str] = []
+    relists0 = metrics.REFLECTOR_RELISTS.value
+    try:
+        direct.create_list("nodes", [_node_json(f"kw-{i:04d}")
+                                     for i in range(n_nodes)])
+        factory = ConfigFactory(proxy.base_url, qps=5000, burst=5000)
+        # A 4096-binding frame clears the proxy in ONE delayed POST —
+        # near-atomic from the driver's LIST.  Small chunks turn the
+        # drain into a stream of delayed POSTs riding the AIMD-gated
+        # pipeline, so "mid-flight" is a real, observable window.
+        factory.store.BIND_CHUNK = 8
+        factory.daemon.backoff = PodBackoff(default_duration=0.1,
+                                            max_duration=2.0)
+        factory.run()
+        log(f"scheduler up against the real apiserver (pid {proc.pid})")
+
+        # The avalanche, acked chunk by chunk: a create_list that
+        # returned is the server's 201 — from that moment the write is
+        # covered by the durability contract.  The kill is interleaved
+        # WITH the avalanche: the moment binds are landing (>= the
+        # threshold) while acked pods are still pending, SIGKILL — the
+        # drain is then provably mid-flight, not quiesced (the wire
+        # path binds fast enough that polling after the fact would
+        # only ever see a drained cluster).
+        names = [f"kw-av-{i:06d}" for i in range(avalanche_pods)]
+        chunks = [names[i:i + 100]
+                  for i in range(0, avalanche_pods, 100)]
+        bound_at_kill = pending_at_kill = 0
+        downtime_s = 0.0
+        killed = False
+        at = 0
+        while at < len(chunks):
+            chunk = chunks[at]
+            direct.create_list("pods", [_pod_json(nm) for nm in chunk])
+            acked.extend(chunk)
+            at += 1
+            if killed:
+                continue
+            bound, pending = counts()
+            if bound >= kill_at_bound and pending > 0:
+                bound_at_kill, pending_at_kill = bound, pending
+                t_kill = time.monotonic()
+                proc.send_signal(signal.SIGKILL)
+                proc.wait(timeout=10)
+                killed = True
+                log(f"SIGKILLed the apiserver mid-avalanche "
+                    f"({bound_at_kill} bound, {pending_at_kill} "
+                    f"pending, {len(acked)}/{avalanche_pods} acked)")
+                time.sleep(0.5)  # in-flight binds hit the void
+                proc = start_apiserver()
+                downtime_s = time.monotonic() - t_kill
+                log(f"apiserver restarted on the recovered WAL "
+                    f"({downtime_s:.2f}s down); resuming the "
+                    f"avalanche")
+        if not killed:
+            # All chunks acked before the trigger fired — the bind
+            # latency keeps the drain in flight for seconds yet, so
+            # keep polling for the mid-flight window.
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                bound, pending = counts()
+                if bound >= kill_at_bound and pending > 0:
+                    bound_at_kill, pending_at_kill = bound, pending
+                    break
+                time.sleep(0.02)
+            t_kill = time.monotonic()
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            killed = True
+            log(f"SIGKILLed the apiserver mid-avalanche "
+                f"({bound_at_kill} bound, {pending_at_kill} pending, "
+                f"all {len(acked)} acked)")
+            time.sleep(0.5)
+            proc = start_apiserver()
+            downtime_s = time.monotonic() - t_kill
+            log(f"apiserver restarted on the recovered WAL "
+                f"({downtime_s:.2f}s down)")
+
+        # The scheduler must converge the whole avalanche on its own:
+        # watch streams broke (relist), in-flight binds errored
+        # (requeue), pre-kill acked binds resurface as 409s (absorb).
+        t_settle = time.monotonic()
+        deadline = time.monotonic() + settle_timeout
+        stranded = -1
+        while time.monotonic() < deadline:
+            bound, pending = counts()
+            if pending == 0 and bound >= len(acked):
+                stranded = 0
+                break
+            time.sleep(0.25)
+        if stranded < 0:
+            _, stranded = counts()
+        restart_settle_s = time.monotonic() - t_settle
+
+        items, _ = direct.list("pods")
+        present = {o["metadata"]["name"] for o in items}
+        lost = [nm for nm in acked if nm not in present]
+        double_binds, audited = _audit_wal_double_binds(storage_dir)
+        relists = int(metrics.REFLECTOR_RELISTS.value - relists0)
+        out = {
+            "n_nodes": n_nodes,
+            "acked_creates": len(acked),
+            "acked_writes_lost": len(lost),
+            "lost_sample": lost[:10],
+            "double_binds": double_binds,
+            "wal_records_audited": audited,
+            "stranded_pending": stranded,
+            "killed_mid_avalanche": bound_at_kill > 0 and
+            pending_at_kill > 0,
+            "bound_at_kill": bound_at_kill,
+            "pending_at_kill": pending_at_kill,
+            "downtime_s": round(downtime_s, 2),
+            "relists": relists,
+            "restart_settle_s": round(restart_settle_s, 2),
+            "duration_s": round(time.monotonic() - t_start, 1),
+        }
+        log(f"done: {out['acked_writes_lost']} acked writes lost, "
+            f"{double_binds} double-binds over {audited} WAL records, "
+            f"{stranded} stranded, {relists} relists")
+        return out
+    finally:
+        if factory is not None:
+            try:
+                factory.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        proxy.stop()
+        if proc.poll() is None:
+            proc.kill()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+
+def run_overload_wave(n_nodes: int = 200, calibration_pods: int = 900,
+                      storm_threads: int = 192,
+                      attempts_per_thread: int = 40,
+                      settle_timeout: float = 240.0,
+                      quiet: bool = False) -> dict:
+    """The overload wave (ISSUE 16): the apiserver runs with a
+    deliberately small flow-control envelope, a ShardManager keeps the
+    shard-lease plane alive through it, and a best-effort create/LIST
+    storm offers a large multiple of what that envelope admits.  The
+    envelope IS the system's declared capacity — max-inflight is the
+    operator's statement of how much concurrent work the server may
+    carry — so the ratcheted overload depth (``offered_multiple``) is
+    offered rate over admitted rate, both measured inside the storm
+    window.  The un-stormed calibration drain is kept as context
+    (``calibration_pods_per_s``, ``offered_vs_calibrated``): on a
+    one-core rig the storm clients timeshare the GIL with the server,
+    so raw offered rate can never outrun the unconstrained batch
+    pipeline — the envelope is what a storm genuinely oversubscribes.
+    The ratchet (check_bench.check_overload) pins the APF contract:
+
+    * the storm actually trips the controller (shed 429s > 0) and
+      offers >= 3x what the envelope admits;
+    * the system lane never sheds and NO shard lease expires — the
+      protected lease plane holds under saturation;
+    * queue depth stays inside the configured bound (scraped live from
+      the apiserver's exempt /debug/vars, which must keep answering);
+    * goodput degrades gracefully, never to zero, and every acked pod
+      still binds (stranded == 0).
+    """
+    import urllib.request
+
+    from kubernetes_tpu.apiserver import flowcontrol as apf
+    from kubernetes_tpu.apiserver.server import serve
+    from kubernetes_tpu.scheduler.factory import ConfigFactory
+    from kubernetes_tpu.scheduler.shards import ShardManager
+
+    t_start = time.monotonic()
+    queue_limit = 16
+    flow = apf.FlowController(system_inflight=8, workload_inflight=16,
+                              besteffort_inflight=4, watch_inflight=64,
+                              queue_limit=queue_limit, queue_wait_s=0.05,
+                              retry_floor=0.05)
+    store = MemStore()
+    api_srv = serve(store, flow=flow)
+    port = api_srv.server_address[1]
+    api_url = f"http://127.0.0.1:{port}"
+    direct = APIClient(api_url, qps=0, timeout=60.0)
+
+    def log(msg: str) -> None:
+        if not quiet:
+            print(f"overload[{time.monotonic() - t_start:6.1f}s] {msg}",
+                  file=sys.stderr)
+
+    direct.create_list("nodes", [_node_json(f"ov-{i:04d}")
+                                 for i in range(n_nodes)])
+    monitor = BindMonitor(store)
+    lost_leases: list[int] = []
+    mgr = ShardManager(APIClient(api_url, qps=0), incarnation="soak-ov",
+                       n_shards=4, lease_duration=1.0,
+                       renew_deadline=0.7, retry_period=0.1, jitter=0.0,
+                       on_lost=lost_leases.append)
+    factory = None
+    sampler_stop = threading.Event()
+    depth_samples: list[int] = []
+    exempt_errors = [0]
+
+    def sample_debug_vars() -> None:
+        # The exempt lane's live evidence: /debug/vars must answer
+        # THROUGH the storm, and its per-level queue depths are the
+        # boundedness record.
+        while not sampler_stop.wait(0.05):
+            try:
+                with urllib.request.urlopen(f"{api_url}/debug/vars",
+                                            timeout=5) as r:
+                    levels = ((json.loads(r.read()).get("overload")
+                               or {}).get("levels") or {})
+                depth_samples.append(max(
+                    (lv.get("queued") or 0) for lv in levels.values()))
+            except Exception:  # noqa: BLE001 — counted, then ratcheted
+                exempt_errors[0] += 1
+
+    try:
+        mgr.run()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                mgr.owned() != frozenset(range(4)):
+            time.sleep(0.02)
+        assert mgr.owned() == frozenset(range(4)), \
+            f"lease plane never settled: {sorted(mgr.owned())}"
+        factory = ConfigFactory(api_url, qps=5000, burst=5000)
+        factory.daemon.backoff = PodBackoff(default_duration=0.1,
+                                            max_duration=2.0)
+        factory.run()
+
+        # Warmup (uncounted): flush post-prewarm XLA compiles and the
+        # first drain's lazy caches out of the capacity measurement.
+        direct.create_list("pods", [_pod_json(f"ov-warm-{i:04d}")
+                                    for i in range(100)])
+        warm_deadline = time.monotonic() + settle_timeout
+        while monitor.binds < 100:
+            if time.monotonic() > warm_deadline:
+                raise RuntimeError("warmup wave never settled")
+            time.sleep(0.05)
+
+        # Calibration: the fleet's un-stormed SUSTAINED drain rate,
+        # the denominator of the offered-load multiple.  Three spaced
+        # bursts force multiple drain cycles so one lucky warm drain
+        # can't inflate the measured capacity.
+        t0 = time.monotonic()
+        third = calibration_pods // 3
+        for b in range(3):
+            direct.create_list(
+                "pods",
+                [_pod_json(f"ov-cal-{i:05d}")
+                 for i in range(b * third,
+                                calibration_pods if b == 2
+                                else (b + 1) * third)])
+            while monitor.binds < 100 + (calibration_pods if b == 2
+                                         else (b + 1) * third):
+                if time.monotonic() - t0 > settle_timeout:
+                    raise RuntimeError("calibration wave never settled")
+                time.sleep(0.05)
+        cal_rate = calibration_pods / (time.monotonic() - t0)
+        log(f"calibrated capacity: {cal_rate:.1f} pods/s")
+
+        sampler = threading.Thread(target=sample_debug_vars,
+                                   daemon=True, name="ov-sampler")
+        sampler.start()
+        # Per-thread tallies (summed after join — no racy shared ints).
+        tallies = [{"offered": 0, "acked": 0, "listed": 0, "shed": 0}
+                   for _ in range(storm_threads)]
+
+        def storm_worker(w: int) -> None:
+            cl = APIClient(api_url, qps=0, max_retries=0, timeout=30.0)
+            tally = tallies[w]
+            for i in range(attempts_per_thread):
+                tally["offered"] += 1
+                try:
+                    if i % 10 == 9:
+                        cl.list("pods")  # the LIST face of the storm
+                        tally["listed"] += 1
+                    else:
+                        cl.create("pods", _pod_json(
+                            f"ov-storm-{w:02d}-{i:05d}"))
+                        tally["acked"] += 1
+                except Exception as err:  # noqa: BLE001
+                    if getattr(err, "status", None) == 429:
+                        tally["shed"] += 1
+
+        t_storm = time.monotonic()
+        binds0 = monitor.binds
+        threads = [threading.Thread(target=storm_worker, args=(w,),
+                                    name=f"ov-storm-{w}")
+                   for w in range(storm_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        storm_s = time.monotonic() - t_storm
+        storm_binds = monitor.binds - binds0
+        offered = [sum(t["offered"] for t in tallies)]
+        acked = [sum(t["acked"] for t in tallies)]
+        listed = sum(t["listed"] for t in tallies)
+        shed = [sum(t["shed"] for t in tallies)]
+        offered_rate = offered[0] / max(storm_s, 1e-9)
+        admitted_rate = (acked[0] + listed) / max(storm_s, 1e-9)
+        log(f"storm: {offered[0]} ops offered in {storm_s:.1f}s "
+            f"({offered_rate:.0f}/s vs {admitted_rate:.0f}/s admitted "
+            f"= {offered_rate / max(admitted_rate, 1e-9):.1f}x the "
+            f"envelope; unstormed drain {cal_rate:.0f} pods/s), "
+            f"{acked[0]} acked, {shed[0]} shed with 429")
+        sampler_stop.set()
+        sampler.join(timeout=5)
+
+        # Every acked create still converges: graceful degradation
+        # sheds NEW work at the door, never work already admitted.
+        total = 100 + calibration_pods + acked[0]
+        deadline = time.monotonic() + settle_timeout
+        while monitor.binds < total and time.monotonic() < deadline:
+            time.sleep(0.1)
+        items, _ = store.list("pods")
+        stranded = sum(1 for o in items
+                       if not (o.get("spec") or {}).get("nodeName"))
+        levels = flow.report()["levels"]
+        system_rejected = sum(
+            (levels.get(apf.LEVEL_SYSTEM) or {})
+            .get("rejected", {}).values())
+        out = {
+            "n_nodes": n_nodes,
+            "queue_limit": queue_limit,
+            "calibration_pods_per_s": round(cal_rate, 1),
+            "offered_ops": offered[0],
+            # Overload depth: offered rate over the rate the configured
+            # envelope actually admitted (creates acked + LISTs served)
+            # inside the storm window.  check_overload bars this at 3x.
+            "offered_multiple": round(
+                offered_rate / max(admitted_rate, 1e-9), 1),
+            "admitted_ops_per_s": round(admitted_rate, 1),
+            "offered_vs_calibrated": round(
+                offered_rate / max(cal_rate, 1e-9), 1),
+            "storm_window_s": round(storm_s, 1),
+            "acked_creates": acked[0],
+            "admitted_lists": listed,
+            "shed_429": shed[0],
+            "goodput_pods_per_s": round(storm_binds / max(storm_s, 1e-9),
+                                        1),
+            "lease_expiries": len(lost_leases),
+            "leases_held_final": len(mgr.owned()),
+            "system_rejected": int(system_rejected),
+            "max_queue_depth": max(depth_samples) if depth_samples
+            else 0,
+            "debug_vars_samples": len(depth_samples),
+            "debug_vars_errors": exempt_errors[0],
+            "stranded_pending": stranded,
+            "levels": levels,
+            "duration_s": round(time.monotonic() - t_start, 1),
+        }
+        log(f"done: {out['shed_429']} shed, goodput "
+            f"{out['goodput_pods_per_s']} pods/s, "
+            f"{out['lease_expiries']} lease expiries, max queue depth "
+            f"{out['max_queue_depth']}/{queue_limit}, "
+            f"{stranded} stranded")
+        return out
+    finally:
+        sampler_stop.set()
+        monitor.stop()
+        try:
+            mgr.stop(release=False)  # audit counts real expiries only
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        if factory is not None:
+            try:
+                factory.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        api_srv.shutdown()
+
+
 def _reconcile(store: MemStore, factory, monitor: _BindMonitor) -> dict:
     """Post-soak apiserver-vs-oracle reconciliation: the acceptance
     invariants a mid-drain kill must not break."""
@@ -1406,6 +1902,16 @@ def collect(ha: bool = True, **kw) -> dict:
     if os.environ.get("BENCH_SOAK_TENANCY_POISON", "1") != "0":
         rec["tenancy_poison"] = run_tenancy_poison_wave(
             quiet=kw.get("quiet", False))
+    if os.environ.get("BENCH_SOAK_KILL", "1") != "0":
+        # The apiserver-kill wave: crash-consistency of the CONTROL
+        # PLANE itself (0 acked-write loss, 0 double-binds) — the
+        # ratchet's check_overload pins it.
+        rec["apiserver_kill"] = run_apiserver_kill_wave(
+            quiet=kw.get("quiet", False))
+    if os.environ.get("BENCH_SOAK_OVERLOAD", "1") != "0":
+        # The overload wave: APF shedding + the protected lease plane
+        # under a 3x-capacity best-effort storm.
+        rec["overload"] = run_overload_wave(quiet=kw.get("quiet", False))
     # The artifact-level locktrace columns check_soak ratchets to zero:
     # the main churn run + the HA wave (scraped from the survivor
     # processes) + the tenancy poison wave, all under KT_LOCKTRACE=1.
@@ -1445,6 +1951,10 @@ def main() -> None:
     ap.add_argument("--no-restart", action="store_true")
     ap.add_argument("--no-ha", action="store_true",
                     help="skip the active-active HA wave")
+    ap.add_argument("--no-kill", action="store_true",
+                    help="skip the apiserver-kill wave")
+    ap.add_argument("--no-overload", action="store_true",
+                    help="skip the overload wave")
     opts = ap.parse_args()
     rec = run_soak(n_nodes=opts.nodes, duration_s=opts.duration,
                    chaos=not opts.no_chaos,
@@ -1452,6 +1962,10 @@ def main() -> None:
                    restart=not opts.no_restart)
     if not opts.no_ha:
         rec["ha"] = run_ha_wave()
+    if not opts.no_kill:
+        rec["apiserver_kill"] = run_apiserver_kill_wave()
+    if not opts.no_overload:
+        rec["overload"] = run_overload_wave()
     with open(opts.out, "w") as f:
         json.dump(rec, f, indent=1)
         f.write("\n")

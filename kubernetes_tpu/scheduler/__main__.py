@@ -283,6 +283,13 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
                                 is not None else None),
                     "shardRecoveries": getattr(
                         factory, "shard_recoveries", [])[-8:],
+                    # Client-side backpressure against a shedding
+                    # apiserver (utils/flowcontrol.py): the AIMD bind
+                    # window + retry-budget saturation; null when the
+                    # store is in-process (no wire, nothing to shed).
+                    "overload": (factory.store.flow_report()
+                                 if hasattr(factory.store, "flow_report")
+                                 else None),
                     "cachedPods": cache.pod_count(),
                     "cachedNodes": len(cache.nodes()),
                     "cacheStats": cache.stats,

@@ -342,7 +342,10 @@ class ConfigFactory:
                               message: str) -> None:
         """podConditionUpdater (factory.go:589-600): PodScheduled=False."""
         key = pod.key
-        obj = self.store.get("pods", key)
+        try:
+            obj = self.store.get("pods", key)
+        except Exception:  # noqa: BLE001 — best-effort: an unreachable
+            return         # apiserver must not kill the error path
         if obj is None:
             return
         conds = obj.setdefault("status", {}).setdefault("conditions", [])

@@ -135,6 +135,11 @@ _knob("KT_POD_BACKOFF_MAX_S", "60", "float",
       "Per-pod requeue backoff ceiling in seconds")
 _knob("KT_BIND_PIPELINE", "4", "int",
       "Persistent connections pipelining bind-chunk POSTs")
+_knob("KT_AIMD_MIN", "1", "int",
+      "AIMD bind fan-out concurrency floor (ceiling is "
+      "KT_BIND_PIPELINE)")
+_knob("KT_AIMD_BACKOFF", "0.5", "float",
+      "AIMD multiplicative-decrease factor applied on a server 429")
 _knob("KT_FLIGHT_DIR", "", "str",
       "Directory persisting the decision flight ring across restarts")
 _knob("KT_VERIFY_PERIOD", "0", "float",
@@ -148,6 +153,27 @@ _knob("KT_SLO_OBJECTIVE", "99", "float",
 # -- apiserver ----------------------------------------------------------
 _knob("KT_BIND_CAPACITY", "1", "bool",
       "Server-side bind capacity validation (overcommit binds 409)")
+_knob("KT_APF", "1", "bool",
+      "APF-style priority-level flow control in the apiserver request "
+      "loop; 0 = admit everything (pre-PR-16 behavior)")
+_knob("KT_APF_SYSTEM_INFLIGHT", "16", "int",
+      "Reserved max-inflight slots for the system level (lease/presence "
+      "CAS, heartbeats); never queued, never starved by lower levels")
+_knob("KT_APF_WORKLOAD_INFLIGHT", "32", "int",
+      "Max-inflight for the workload level (binds, evictions, solve "
+      "traffic)")
+_knob("KT_APF_BESTEFFORT_INFLIGHT", "16", "int",
+      "Max-inflight for the best-effort level (pod-create storms, LISTs)")
+_knob("KT_APF_QUEUE", "64", "int",
+      "Bounded FIFO wait-queue depth per queueable level; a full queue "
+      "sheds 429 + Retry-After")
+_knob("KT_APF_QUEUE_WAIT_S", "1.0", "float",
+      "Queue wait deadline in seconds; past it the request sheds 429")
+_knob("KT_APF_WATCH_INFLIGHT", "128", "int",
+      "Concurrent watch-stream cap; watches are admitted or 429d, "
+      "never queued (a stream holds its handler thread for its life)")
+_knob("KT_APF_RETRY_AFTER_S", "0.25", "float",
+      "Floor of the honest Retry-After hint on shed responses")
 _knob("KT_NATIVE_APISERVER", "1", "bool",
       "Perf rigs use the native apiserver binary when available")
 _knob("KT_WATCH_FRAMES", "1", "bool",

@@ -769,6 +769,32 @@ APISERVER_REQUEST_LATENCY = register(Histogram(
     exponential_buckets(100, 2, 15),
     labelnames=("verb", "resource", "code")))
 
+# APF-style priority-level flow control (apiserver/flowcontrol.py): the
+# reference's apiserver_flowcontrol_* family collapsed to the three-level
+# kt classification.  Label space is server-controlled (level names are
+# the fixed system/workload/best-effort set, plus "watch" for the
+# stream-admission gate), so cardinality is bounded by construction.
+APISERVER_INFLIGHT = register(Gauge(
+    "apiserver_inflight",
+    "Requests currently executing per priority level (watch streams "
+    "count under their dedicated admission gate)",
+    labelnames=("level",)))
+APISERVER_QUEUE_DEPTH = register(Gauge(
+    "apiserver_queue_depth",
+    "Requests currently parked in a priority level's bounded FIFO "
+    "wait queue",
+    labelnames=("level",)))
+APISERVER_REJECTED = register(Counter(
+    "apiserver_rejected_total",
+    "Requests shed with 429 + Retry-After per priority level, by "
+    "reason (queue-full/deadline/inflight-full)",
+    labelnames=("level", "reason")))
+APISERVER_QUEUE_WAIT = register(Histogram(
+    "apiserver_queue_wait_microseconds",
+    "Time admitted requests spent parked in a priority level's wait "
+    "queue before an inflight slot freed",
+    exponential_buckets(100, 2, 15), labelnames=("level",)))
+
 
 class SchedulerMetrics:
     """The scheduler's metric set (metrics.go:31-55), microseconds, plus

@@ -233,6 +233,7 @@ const GROUPS = [
  ["Multi-tenant service", /^scheduler_tenant_|^apiserver_bind_capacity/],
  ["Device transfers", /^scheduler_(device_transfer|post_prewarm_compiles)/],
  ["Decisions & binds", /^scheduler_(pod_scheduling_attempts|e2e_decision|bind_|batch_formation|batch_deadline)/],
+ ["Overload", /^apiserver_(inflight|queue_depth|rejected_total|queue_wait)/],
  ["Everything else", /./],
 ];
 const DERIV = /(_total|_count|_sum)(\\{|$)/;   // counters chart as rates

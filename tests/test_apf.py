@@ -1,0 +1,357 @@
+"""APF-style flow control (apiserver/flowcontrol.py): the admission
+matrix — per-level inflight caps, queue-bound shed, watch never-queued,
+system-lane bypass under a saturated workload lane, deadline-exceeded
+429s carrying Retry-After — plus the PR 16 tentpole guarantee: under a
+best-effort storm with latency chaos on the lease path, shard-lease
+renewals stay inside ``renew_deadline`` and a healthy scheduler never
+fails over (ROADMAP 4c)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.apiserver import flowcontrol as apf
+from kubernetes_tpu.apiserver.flowcontrol import (LEVEL_BEST_EFFORT,
+                                                  LEVEL_SYSTEM,
+                                                  LEVEL_WATCH,
+                                                  LEVEL_WORKLOAD,
+                                                  FlowController, classify)
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.apiserver.server import serve
+from kubernetes_tpu.chaos.proxy import ChaosProxy, node_flap, overload
+from kubernetes_tpu.client.http import APIClient, APIError
+
+
+def _pod(name, ns="default"):
+    return {"metadata": {"name": name, "namespace": ns},
+            "spec": {"containers": [{"name": "c"}]}}
+
+
+# -- classification ----------------------------------------------------------
+
+@pytest.mark.parametrize("method,resource,is_watch,sub,want", [
+    ("GET", "endpoints", False, "", LEVEL_SYSTEM),
+    ("PUT", "endpoints", False, "", LEVEL_SYSTEM),     # lease CAS renew
+    ("PUT", "leases", False, "", LEVEL_SYSTEM),
+    ("PUT", "nodes", False, "", LEVEL_SYSTEM),         # status heartbeat
+    ("POST", "bindings", False, "", LEVEL_WORKLOAD),
+    ("POST", "pods", False, "eviction", LEVEL_WORKLOAD),
+    ("PUT", "pods", False, "", LEVEL_WORKLOAD),        # status publish
+    ("DELETE", "pods", False, "", LEVEL_WORKLOAD),     # preemption
+    ("GET", "pods", True, "", LEVEL_WATCH),            # scheduler watch
+    ("GET", "nodes", True, "", LEVEL_WATCH),
+    ("POST", "pods", False, "", LEVEL_BEST_EFFORT),    # create storm
+    ("GET", "pods", False, "", LEVEL_BEST_EFFORT),     # LIST
+    ("POST", "nodes", False, "", LEVEL_BEST_EFFORT),
+    ("GET", "healthz", False, "", None),               # exempt
+    ("GET", "metrics", False, "", None),
+    ("GET", "debug", False, "", None),
+])
+def test_classification_matrix(method, resource, is_watch, sub, want):
+    assert classify(method, resource, is_watch, sub) == want
+
+
+# -- the admission matrix (controller-level) ---------------------------------
+
+def _hold(fc, n, level=LEVEL_BEST_EFFORT, method="POST", resource="pods"):
+    """Admit n requests at ``level`` and return their tickets."""
+    out = []
+    for _ in range(n):
+        t = fc.admit(method, resource, False)
+        assert t.ok
+        out.append(t)
+    return out
+
+
+def test_per_level_inflight_cap_sheds_past_queue():
+    fc = FlowController(besteffort_inflight=2, queue_limit=0,
+                        queue_wait_s=0.05)
+    held = _hold(fc, 2)
+    shed = fc.admit("POST", "pods", False)
+    assert not shed.ok
+    assert shed.reason == "inflight-full"
+    assert shed.retry_after is not None and shed.retry_after > 0
+    held[0].release()
+    assert fc.admit("POST", "pods", False).ok
+    for t in held:
+        t.release()
+
+
+def test_queue_admits_when_slot_frees_and_bounds_depth():
+    fc = FlowController(besteffort_inflight=1, queue_limit=1,
+                        queue_wait_s=2.0)
+    (holder,) = _hold(fc, 1)
+    results = []
+
+    def waiter():
+        results.append(fc.admit("POST", "pods", False))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    deadline = time.monotonic() + 2
+    while fc.levels[LEVEL_BEST_EFFORT].report()["queued"] < 1:
+        assert time.monotonic() < deadline, "waiter never queued"
+        time.sleep(0.005)
+    # The queue is at its bound: the NEXT request sheds queue-full.
+    shed = fc.admit("POST", "pods", False)
+    assert not shed.ok and shed.reason == "queue-full"
+    assert shed.retry_after is not None
+    holder.release()       # frees the slot: the queued waiter admits
+    t.join(timeout=2)
+    assert results and results[0].ok
+    results[0].release()
+    rep = fc.levels[LEVEL_BEST_EFFORT].report()
+    assert rep["rejected"].get("queue-full") == 1
+    assert rep["queuedTotal"] == 1
+
+
+def test_queue_deadline_exceeded_429_carries_retry_after():
+    fc = FlowController(besteffort_inflight=1, queue_limit=4,
+                        queue_wait_s=0.05, retry_floor=0.25)
+    (holder,) = _hold(fc, 1)
+    t0 = time.monotonic()
+    shed = fc.admit("POST", "pods", False)
+    waited = time.monotonic() - t0
+    assert not shed.ok and shed.reason == "deadline"
+    assert shed.retry_after is not None and shed.retry_after >= 0.25
+    assert waited >= 0.04, "deadline shed must actually wait the window"
+    holder.release()
+
+
+def test_watch_never_queued():
+    fc = FlowController(watch_inflight=2, queue_limit=64,
+                        queue_wait_s=5.0)
+    a = fc.admit("GET", "pods", True)
+    b = fc.admit("GET", "nodes", True)
+    assert a.ok and b.ok
+    t0 = time.monotonic()
+    shed = fc.admit("GET", "pods", True)
+    assert not shed.ok and shed.reason == "inflight-full"
+    # Rejected IMMEDIATELY — watches must never park in a wait queue
+    # (each admitted stream owns a handler thread for its life).
+    assert time.monotonic() - t0 < 1.0
+    assert fc.levels[LEVEL_WATCH].report()["queued"] == 0
+    a.release()
+    b.release()
+
+
+def test_system_lane_bypasses_saturated_workload_lane():
+    fc = FlowController(system_inflight=4, workload_inflight=2,
+                        besteffort_inflight=1, queue_limit=1,
+                        queue_wait_s=0.5)
+    # Saturate workload: both slots held, the queue slot parked.
+    held = _hold(fc, 2, method="POST", resource="bindings")
+    parked = threading.Thread(
+        target=lambda: fc.admit("POST", "bindings", False))
+    parked.start()
+    deadline = time.monotonic() + 2
+    while fc.levels[LEVEL_WORKLOAD].report()["queued"] < 1:
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    # And saturate best-effort too, for good measure.
+    be = _hold(fc, 1)
+    # A lease renewal admits instantly regardless.
+    t0 = time.monotonic()
+    lease = fc.admit("PUT", "endpoints", False)
+    assert lease.ok
+    assert time.monotonic() - t0 < 0.1, "system lane must not wait"
+    lease.release()
+    for t in held:
+        t.release()
+    for t in be:
+        t.release()
+    parked.join(timeout=2)
+    assert fc.levels[LEVEL_SYSTEM].report()["rejected"] == {}
+
+
+def test_disabled_controller_admits_everything():
+    fc = FlowController(enabled=False, besteffort_inflight=0,
+                        watch_inflight=0, queue_limit=0)
+    for _ in range(50):
+        assert fc.admit("POST", "pods", False).ok
+    assert fc.admit("GET", "pods", True).ok
+
+
+# -- the wire: 429 + Retry-After header --------------------------------------
+
+class _Rig:
+    def __init__(self, flow):
+        self.store = MemStore()
+        self.srv = serve(self.store, flow=flow)
+        self.url = f"http://127.0.0.1:{self.srv.server_address[1]}"
+
+    def stop(self):
+        self.srv.shutdown()
+
+
+def test_shed_response_carries_retry_after_header():
+    rig = _Rig(FlowController(watch_inflight=0))
+    try:
+        req = urllib.request.Request(f"{rig.url}/api/v1/pods?watch=1")
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=5)
+        err = exc_info.value
+        assert err.code == 429
+        assert float(err.headers["Retry-After"]) > 0
+        body = json.loads(err.read())
+        assert "overloaded" in body["error"]
+    finally:
+        rig.stop()
+
+
+def test_apiclient_watch_shed_surfaces_retry_after():
+    rig = _Rig(FlowController(watch_inflight=0))
+    try:
+        client = APIClient(rig.url, qps=0, max_retries=0)
+        with pytest.raises(APIError) as exc_info:
+            client.watch("pods", 0)
+        assert exc_info.value.status == 429
+        assert exc_info.value.retry_after is not None
+    finally:
+        rig.stop()
+
+
+def test_exempt_paths_answer_while_best_effort_sheds():
+    """/healthz and /metrics must keep answering under a full lane —
+    liveness probes firing during overload would kill the apiserver at
+    exactly the wrong moment."""
+    fc = FlowController(besteffort_inflight=0, queue_limit=0)
+    rig = _Rig(fc)
+    try:
+        client = APIClient(rig.url, qps=0, max_retries=0)
+        with pytest.raises(APIError) as exc_info:
+            client.list("pods")
+        assert exc_info.value.status == 429
+        for path in ("/healthz", "/metrics", "/debug/vars"):
+            with urllib.request.urlopen(rig.url + path, timeout=5) as r:
+                assert r.status == 200
+    finally:
+        rig.stop()
+
+
+# -- satellite: retry budget under a sustained 429 storm ---------------------
+
+def test_retry_budget_exhausts_cleanly_under_429_storm():
+    """A sustained shedding server must cost a bounded number of retries:
+    the token-bucket retry budget drains, the exhaustion counter counts,
+    and request amplification stays ~1x afterwards — no retry storm."""
+    from kubernetes_tpu.utils import metrics as mets
+    store = MemStore()
+    srv = serve(store)
+    proxy = ChaosProxy(
+        f"http://127.0.0.1:{srv.server_address[1]}").start()
+    try:
+        proxy.add_rules(overload(kind=429, retry_after_s=0.01))
+        client = APIClient(proxy.base_url, qps=0)
+        exhausted_before = mets.CLIENT_RETRY_BUDGET_EXHAUSTED.value
+        attempts = 40
+        failures = 0
+        for i in range(attempts):
+            try:
+                client.create("pods", _pod(f"storm-{i}"))
+            except APIError as err:
+                assert err.status == 429
+                failures += 1
+        assert failures == attempts, "every create must shed through"
+        # Amplification bound: at most budget-burst (20) + refill-margin
+        # retries on top of the 40 first attempts.
+        assert proxy.requests_total <= attempts + 20 + 10
+        assert mets.CLIENT_RETRY_BUDGET_EXHAUSTED.value > \
+            exhausted_before, "the budget must exhaust, counted"
+    finally:
+        proxy.stop()
+        srv.shutdown()
+
+
+# -- the tentpole guarantee: protected lease plane under storm + chaos -------
+
+def _node(name):
+    return {"metadata": {"name": name},
+            "status": {"capacity": {"cpu": "64", "memory": "256Gi",
+                                    "pods": "110"},
+                       "conditions": [{"type": "Ready",
+                                       "status": "True"}]}}
+
+
+def test_lease_plane_survives_storm_and_latency_chaos():
+    """ROADMAP 4c pinned: a best-effort create/list avalanche saturates
+    its lane (shedding 429s) AND the lease path crosses a latency-chaos
+    proxy, yet every shard-lease renewal lands inside renew_deadline —
+    the ShardManager never loses a shard it holds, zero failovers of a
+    healthy scheduler."""
+    from kubernetes_tpu.scheduler.shards import ShardManager
+    fc = FlowController(system_inflight=4, workload_inflight=4,
+                        besteffort_inflight=2, queue_limit=2,
+                        queue_wait_s=0.05, retry_floor=0.05)
+    store = MemStore()
+    srv = serve(store, flow=fc)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    # The lease client dials through a chaos proxy injecting latency on
+    # every endpoints verb — the congested-link shape on the one path
+    # that must stay live.
+    proxy = ChaosProxy(url).start()
+    proxy.add_rules(node_flap(kind="latency", period=1, delay_s=0.03))
+    lease_client = APIClient(proxy.base_url, qps=0)
+    lost: list[int] = []
+    mgr = ShardManager(lease_client, incarnation="healthy", n_shards=2,
+                       lease_duration=1.2, renew_deadline=0.8,
+                       retry_period=0.1, jitter=0.0,
+                       on_lost=lambda s: lost.append(s))
+    mgr.run()
+    try:
+        deadline = time.monotonic() + 10
+        while mgr.owned() != frozenset({0, 1}):
+            assert time.monotonic() < deadline, "never acquired shards"
+            time.sleep(0.02)
+        # Storm: hammer best-effort (creates + LISTs) from 10 threads
+        # for ~3 s — multiples of the lane's capacity; sheds expected.
+        stop = threading.Event()
+        shed_counts = [0] * 10
+
+        def stormer(i):
+            c = APIClient(url, qps=0, max_retries=0)
+            n = 0
+            while not stop.is_set():
+                try:
+                    if n % 3:
+                        c.create("pods", _pod(f"s{i}-{n}"))
+                    else:
+                        c.list("pods")
+                except APIError as err:
+                    if err.status == 429:
+                        shed_counts[i] += 1
+                except Exception:  # noqa: BLE001 — churn is the point
+                    pass
+                n += 1
+
+        threads = [threading.Thread(target=stormer, args=(i,))
+                   for i in range(10)]
+        for t in threads:
+            t.start()
+        storm_end = time.monotonic() + 3.0
+        while time.monotonic() < storm_end:
+            # The live assertion: ownership holds THROUGHOUT the storm,
+            # not only after it drains.
+            assert mgr.owned() == frozenset({0, 1}), \
+                f"shard lost mid-storm (lost={lost})"
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not lost, f"healthy scheduler failed over: {lost}"
+        assert mgr.owned() == frozenset({0, 1})
+        report = fc.report()["levels"]
+        assert sum(shed_counts) > 0, "storm never saturated the lane"
+        assert sum(report[LEVEL_BEST_EFFORT]["rejected"].values()) > 0
+        assert report[LEVEL_SYSTEM]["rejected"] == {}, \
+            "lease traffic must never shed under a best-effort storm"
+    finally:
+        mgr.stop(release=True)
+        proxy.stop()
+        srv.shutdown()

@@ -1,6 +1,7 @@
-"""Coverage for the idle-GC and contention paths of the two shared
-rate-control primitives: ``PodBackoff.gc()`` (scheduler/backoff.py) and
-``TokenBucketRateLimiter`` (utils/flowcontrol.py) — plus regression tests
+"""Coverage for the idle-GC and contention paths of the shared
+rate-control primitives: ``PodBackoff.gc()`` (scheduler/backoff.py),
+``TokenBucketRateLimiter`` and ``AIMDLimiter`` (utils/flowcontrol.py),
+the reflector's Retry-After-aware relist delay — plus regression tests
 for the ScheduledJobController constructor and status-publish retry."""
 
 from __future__ import annotations
@@ -10,7 +11,8 @@ import threading
 import pytest
 
 from kubernetes_tpu.scheduler.backoff import PodBackoff
-from kubernetes_tpu.utils.flowcontrol import TokenBucketRateLimiter
+from kubernetes_tpu.utils.flowcontrol import (AIMDLimiter,
+                                              TokenBucketRateLimiter)
 
 
 class FakeClock:
@@ -174,6 +176,116 @@ def test_token_bucket_disabled_never_blocks():
     for _ in range(1000):
         assert lim.try_accept()
     assert lim.saturation() == 0.0
+
+
+# -- AIMDLimiter -------------------------------------------------------------
+
+def test_aimd_starts_at_ceiling_and_halves_on_throttle():
+    lim = AIMDLimiter(min_limit=1, max_limit=8, backoff=0.5)
+    assert lim.limit() == 8
+    lim.on_throttle()
+    assert lim.limit() == 4
+    lim.on_throttle()
+    assert lim.limit() == 2
+    # Multiplicative decrease floors at min_limit, never zero.
+    for _ in range(10):
+        lim.on_throttle()
+    assert lim.limit() == 1
+
+
+def test_aimd_additive_climb_back_to_ceiling():
+    lim = AIMDLimiter(min_limit=1, max_limit=4, backoff=0.5)
+    for _ in range(10):
+        lim.on_throttle()
+    assert lim.limit() == 1
+    # Additive increase (amortized per-window) recovers the ceiling in a
+    # bounded number of clean round-trips, and never overshoots it.
+    for _ in range(100):
+        lim.on_success()
+    assert lim.limit() == 4
+
+
+def test_aimd_acquire_blocks_at_window():
+    lim = AIMDLimiter(min_limit=1, max_limit=2, backoff=0.5)
+    lim.acquire()
+    lim.acquire()
+    assert lim.inflight() == 2
+    admitted = threading.Event()
+
+    def third():
+        lim.acquire()
+        admitted.set()
+        lim.release()
+
+    t = threading.Thread(target=third)
+    t.start()
+    assert not admitted.wait(0.1), "third acquire must block at window=2"
+    lim.release()
+    assert admitted.wait(2), "release must wake the blocked acquire"
+    t.join(timeout=2)
+    lim.release()
+
+
+def test_aimd_shrunk_window_gates_waiters():
+    """After a throttle shrinks the window below current inflight, new
+    acquires block until inflight drains below the NEW window."""
+    lim = AIMDLimiter(min_limit=1, max_limit=4, backoff=0.5)
+    for _ in range(4):
+        lim.acquire()
+    lim.on_throttle()   # window: 4 -> 2 while 4 are inflight
+    admitted = threading.Event()
+
+    def fifth():
+        lim.acquire()
+        admitted.set()
+        lim.release()
+
+    t = threading.Thread(target=fifth)
+    t.start()
+    lim.release()
+    lim.release()       # inflight 2 == window 2: still full
+    assert not admitted.wait(0.1)
+    lim.release()       # inflight 1 < window 2: waiter admits
+    assert admitted.wait(2)
+    t.join(timeout=2)
+    lim.release()
+    assert lim.report()["throttles"] == 1
+
+
+# -- reflector relist delay: Retry-After from a shedding server --------------
+
+def test_reflector_honors_retry_after_on_429():
+    from kubernetes_tpu.client.http import APIError
+    from kubernetes_tpu.client.reflector import _failure_delay
+    err = APIError(429, "overloaded", retry_after=3.0)
+    for _ in range(20):
+        delay = _failure_delay(err, backoff=0.2)
+        # The server's hint is honored (never shortened by the generic
+        # jittered doubling), with bounded jitter above it.
+        assert 3.0 <= delay <= 3.75
+
+
+def test_reflector_429_without_retry_after_keeps_generic_backoff():
+    from kubernetes_tpu.client.http import APIError
+    from kubernetes_tpu.client.reflector import _failure_delay
+    err = APIError(429, "pdb denial; no hint")
+    for _ in range(20):
+        assert _failure_delay(err, backoff=0.2) <= 0.2 * 1.5
+
+
+def test_reflector_generic_fault_uses_jittered_backoff():
+    from kubernetes_tpu.client.reflector import _failure_delay
+    err = ConnectionRefusedError("down")
+    for _ in range(20):
+        assert 0.1 <= _failure_delay(err, backoff=0.2) <= 0.3
+
+
+def test_reflector_retry_after_capped_at_relist_max():
+    from kubernetes_tpu.client.http import APIError
+    from kubernetes_tpu.client.reflector import (RELIST_BACKOFF_MAX,
+                                                 _failure_delay)
+    err = APIError(429, "hour-long hint", retry_after=3600.0)
+    assert _failure_delay(err, backoff=0.2) == RELIST_BACKOFF_MAX
 
 
 # -- ScheduledJobController regressions -------------------------------------

@@ -483,25 +483,54 @@ def test_ha_scale_out_bar_disarmed_on_serialized_rig():
     assert len(problems) == 1 and "below" in problems[0]
 
 
-def test_ha_aggregate_ratchets_against_predecessors_ha_wave():
-    """Artifact-over-artifact, the bar is the predecessor's own HA
-    aggregate — but only within one backend (wall-clock rows
-    re-baseline on a device change, like density p50)."""
-    prev = dict(_soak(), backend="cpu", ha=_ha(agg=800.0))
+def test_ha_efficiency_ratchets_against_predecessors_ha_wave():
+    """Artifact-over-artifact, the bar is the predecessor's scale-out
+    EFFICIENCY (aggregate / same-wave solo baseline): both terms of
+    each ratio come from one rig minutes apart, so the comparison
+    survives the rig itself speeding up or slowing down between
+    artifacts — but only within one backend (ratio rows re-baseline on
+    a device change like every other cross-artifact row)."""
+    prev = dict(_soak(), backend="cpu", ha=_ha(agg=800.0,
+                                               baseline=450.0))
+    # Efficiency 700/450 = 1.56 vs the predecessor's 800/450 = 1.78:
+    # a real scale-out regression, rig speed unchanged.
+    arts = [("SOAK_r11.json", prev),
+            ("SOAK_r12.json", dict(_soak(), backend="cpu",
+                                   ha=_ha(agg=700.0, baseline=450.0)))]
+    problems = cb.check_ha(arts)
+    assert len(problems) == 1 and "efficiency" in problems[0]
+    # Within tolerance of the predecessor's ratio: noise.
+    arts = [("SOAK_r11.json", prev),
+            ("SOAK_r12.json", dict(_soak(), backend="cpu",
+                                   ha=_ha(agg=770.0, baseline=450.0)))]
+    assert cb.check_ha(arts) == []
+    # Rig drift: the whole box halved, aggregate AND solo both fell —
+    # the efficiency held, so nothing regressed in this repo's code.
+    arts = [("SOAK_r11.json", prev),
+            ("SOAK_r12.json", dict(_soak(), backend="cpu",
+                                   ha=_ha(agg=400.0, baseline=225.0)))]
+    assert cb.check_ha(arts) == []
+    # Different backend: re-baselined, no problem.
+    arts = [("SOAK_r11.json", prev),
+            ("SOAK_r12.json", dict(_soak(), backend="tpu",
+                                   ha=_ha(agg=700.0, baseline=450.0)))]
+    assert cb.check_ha(arts) == []
+
+
+def test_ha_predecessor_without_solo_baseline_falls_back_to_rate():
+    """A predecessor stamped before the phase-0 control existed can
+    only support the raw-rate comparison."""
+    prev_ha = _ha(agg=800.0)
+    del prev_ha["single_scheduler_pods_per_s"]
+    prev = dict(_soak(), backend="cpu", ha=prev_ha)
     arts = [("SOAK_r11.json", prev),
             ("SOAK_r12.json", dict(_soak(), backend="cpu",
                                    ha=_ha(agg=700.0)))]
     problems = cb.check_ha(arts)
     assert len(problems) == 1 and "HA aggregate" in problems[0]
-    # Within tolerance of the predecessor: noise, not a regression.
     arts = [("SOAK_r11.json", prev),
             ("SOAK_r12.json", dict(_soak(), backend="cpu",
                                    ha=_ha(agg=770.0)))]
-    assert cb.check_ha(arts) == []
-    # Different backend: re-baselined, no problem.
-    arts = [("SOAK_r11.json", prev),
-            ("SOAK_r12.json", dict(_soak(), backend="tpu",
-                                   ha=_ha(agg=700.0)))]
     assert cb.check_ha(arts) == []
 
 
@@ -629,6 +658,114 @@ def test_soak_capacity_wave_stranded_fails():
 
 def test_soak_without_capacity_section_ratchets_nothing():
     assert cb.check_soak([("SOAK_r11.json", _soak())]) == []
+
+
+# -- overload-protection ratchet (ISSUE 16) ----------------------------------
+
+def _kill(lost=0, double=0, stranded=0, mid=True, relists=2):
+    return {"acked_creates": 800, "acked_writes_lost": lost,
+            "lost_sample": [], "double_binds": double,
+            "wal_records_audited": 1600, "stranded_pending": stranded,
+            "killed_mid_avalanche": mid, "bound_at_kill": 150 if mid
+            else 0, "pending_at_kill": 650 if mid else 0,
+            "downtime_s": 1.2, "relists": relists,
+            "restart_settle_s": 4.0}
+
+
+def _overload(shed=5000, expiries=0, system_rejected=0, depth=12,
+              limit=16, goodput=120.0, stranded=0, samples=150,
+              errors=0, multiple=8.0):
+    return {"queue_limit": limit, "calibration_pods_per_s": 300.0,
+            "offered_ops": 4200, "offered_multiple": multiple,
+            "acked_creates": 900, "shed_429": shed,
+            "goodput_pods_per_s": goodput, "lease_expiries": expiries,
+            "leases_held_final": 4, "system_rejected": system_rejected,
+            "max_queue_depth": depth, "debug_vars_samples": samples,
+            "debug_vars_errors": errors, "stranded_pending": stranded}
+
+
+def test_repo_artifacts_pass_the_overload_ratchet():
+    problems = cb.check_overload()
+    assert problems == [], problems
+
+
+def test_overload_sections_absent_ratchet_nothing():
+    assert cb.check_overload([("SOAK_r13.json", _soak())]) == []
+    assert cb.check_overload([]) == []
+
+
+def test_kill_wave_acked_write_loss_fails():
+    art = dict(_soak(), apiserver_kill=_kill(lost=3))
+    problems = cb.check_overload([("SOAK_r16.json", art)])
+    assert len(problems) == 1 and "acknowledged write" in problems[0]
+
+
+def test_kill_wave_double_bind_fails():
+    art = dict(_soak(), apiserver_kill=_kill(double=1))
+    problems = cb.check_overload([("SOAK_r16.json", art)])
+    assert len(problems) == 1 and "double-bind" in problems[0]
+
+
+def test_kill_wave_stranded_fails():
+    art = dict(_soak(), apiserver_kill=_kill(stranded=7))
+    problems = cb.check_overload([("SOAK_r16.json", art)])
+    assert len(problems) == 1 and "stranded" in problems[0]
+
+
+def test_kill_wave_must_land_mid_avalanche_and_relist():
+    art = dict(_soak(), apiserver_kill=_kill(mid=False))
+    problems = cb.check_overload([("SOAK_r16.json", art)])
+    assert len(problems) == 1 and "mid-avalanche" in problems[0]
+    art = dict(_soak(), apiserver_kill=_kill(relists=0))
+    problems = cb.check_overload([("SOAK_r16.json", art)])
+    assert len(problems) == 1 and "relist" in problems[0]
+
+
+def test_kill_wave_clean_passes():
+    art = dict(_soak(), apiserver_kill=_kill())
+    assert cb.check_overload([("SOAK_r16.json", art)]) == []
+
+
+def test_overload_wave_must_actually_shed():
+    art = dict(_soak(), overload=_overload(shed=0))
+    problems = cb.check_overload([("SOAK_r16.json", art)])
+    assert len(problems) == 1 and "never tripped" in problems[0]
+
+
+def test_overload_lease_expiry_or_system_shed_fails():
+    art = dict(_soak(), overload=_overload(expiries=2))
+    problems = cb.check_overload([("SOAK_r16.json", art)])
+    assert len(problems) == 1 and "lease" in problems[0]
+    art = dict(_soak(), overload=_overload(system_rejected=4))
+    problems = cb.check_overload([("SOAK_r16.json", art)])
+    assert len(problems) == 1 and "system-lane" in problems[0]
+
+
+def test_overload_unbounded_queue_or_zero_goodput_fails():
+    art = dict(_soak(), overload=_overload(depth=40, limit=16))
+    problems = cb.check_overload([("SOAK_r16.json", art)])
+    assert len(problems) == 1 and "bound" in problems[0]
+    art = dict(_soak(), overload=_overload(goodput=0.0))
+    problems = cb.check_overload([("SOAK_r16.json", art)])
+    assert len(problems) == 1 and "goodput" in problems[0]
+
+
+def test_overload_exempt_probe_failures_fail():
+    art = dict(_soak(), overload=_overload(errors=3))
+    problems = cb.check_overload([("SOAK_r16.json", art)])
+    assert len(problems) == 1 and "/debug/vars" in problems[0]
+
+
+def test_overload_below_3x_capacity_fails():
+    art = dict(_soak(), overload=_overload(multiple=1.5))
+    problems = cb.check_overload([("SOAK_r16.json", art)])
+    assert len(problems) == 1 and "3x" in problems[0]
+
+
+def test_overload_clean_wave_passes():
+    art = dict(_soak(), overload=_overload(),
+               apiserver_kill=_kill())
+    assert cb.check_overload([("SOAK_r16.json", art)]) == []
 
 
 # -- compile-surface provenance (kt-xray, ISSUE 14 satellite) ----------------

@@ -92,6 +92,68 @@ class TestWalRecovery:
         assert s3.get("pods", "default/after-tear") is not None
         s3.close()
 
+    def test_torn_tail_never_regresses_rv(self, tmp_path):
+        """SIGKILL mid-record: recovery replays to the last complete
+        record and the RV counter continues monotonically from it —
+        a regressed RV would break resumed watches and CAS."""
+        d = str(tmp_path / "s")
+        s1 = MemStore(storage_dir=d)
+        s1.create("pods", _pod("a"))
+        s1.create("pods", _pod("b"))
+        rv = s1.list("pods")[1]
+        s1.close()
+        with open(os.path.join(d, "wal.jsonl"), "a") as f:
+            f.write('{"t": "ADDED", "k": "pods", "key": "default/c", "rv"')
+        s2 = MemStore(storage_dir=d)
+        assert s2.list("pods")[1] == rv
+        created = s2.create("pods", _pod("post"))
+        assert int(created["metadata"]["resourceVersion"]) == rv + 1
+        s2.close()
+
+    def test_binary_mid_record_truncation(self, tmp_path):
+        """The raw SIGKILL shape: the WAL file chopped at an arbitrary
+        byte offset inside the final record (not at a field boundary)."""
+        d = str(tmp_path / "s")
+        s1 = MemStore(storage_dir=d)
+        for i in range(5):
+            s1.create("pods", _pod(f"p{i}"))
+        s1.close()
+        wal = os.path.join(d, "wal.jsonl")
+        size = os.path.getsize(wal)
+        with open(wal, "rb+") as f:
+            f.truncate(size - 7)   # mid-record, mid-field
+        s2 = MemStore(storage_dir=d)
+        # p0..p3 replay; p4's record was torn and must be gone.
+        for i in range(4):
+            assert s2.get("pods", f"default/p{i}") is not None
+        assert s2.get("pods", "default/p4") is None
+        # The tear was truncated: acked writes now survive a restart.
+        s2.create("pods", _pod("after"))
+        s2.close()
+        s3 = MemStore(storage_dir=d)
+        assert s3.get("pods", "default/after") is not None
+        s3.close()
+
+    def test_parseable_but_incomplete_record_tolerated(self, tmp_path):
+        """A tear can land exactly on a line boundary, leaving valid
+        JSON that is not a complete record — the loader must stop
+        replay there (and truncate), not crash with KeyError."""
+        d = str(tmp_path / "s")
+        s1 = MemStore(storage_dir=d)
+        s1.create("pods", _pod("a"))
+        rv = s1.list("pods")[1]
+        s1.close()
+        with open(os.path.join(d, "wal.jsonl"), "a") as f:
+            f.write('{"t": "ADDED", "k": "pods"}\n')   # fields missing
+        s2 = MemStore(storage_dir=d)   # must not raise
+        assert s2.get("pods", "default/a") is not None
+        assert s2.list("pods")[1] == rv
+        s2.create("pods", _pod("after"))   # acked write
+        s2.close()
+        s3 = MemStore(storage_dir=d)   # fragment was truncated away
+        assert s3.get("pods", "default/after") is not None
+        s3.close()
+
     def test_snapshot_rotation(self, tmp_path, monkeypatch):
         monkeypatch.setattr(memstore, "SNAPSHOT_EVERY", 10)
         d = str(tmp_path / "s")

@@ -309,9 +309,11 @@ def check_ha(artifacts: list[tuple[str, dict]] | None = None,
     outright (the bind CAS + lease partition must make them
     impossible), shard takeover after the mid-drain kill must settle
     in under a second, nothing may strand, and the 3-incarnation
-    aggregate steady-state rate must not fall below the committed
-    predecessor's single-scheduler number — scale-out that slows the
-    fleet down is a regression, not a feature.  The rate comparisons
+    scale-out efficiency (aggregate over the same wave's solo phase-0
+    baseline) must not fall below the committed predecessor's —
+    scale-out that slows the fleet down is a regression, not a
+    feature, while a rig that got slower under BOTH measurements is
+    drift, not a regression.  The rate comparisons
     carry ``tolerance`` (invariant rows never do): both sides are
     single measurements under a chaos storm, and a hair's-width miss
     on a noisy rig is measurement noise, not a regression — the same
@@ -380,10 +382,19 @@ def check_ha(artifacts: list[tuple[str, dict]] | None = None,
                 f"single-scheduler baseline {own} pods/s on a "
                 f"{cpus}-cpu rig — scale-out made the fleet slower")
         if len(artifacts) >= 2:
-            # Artifact-over-artifact is a wall-clock row: only ratchet
-            # within one backend (check()'s re-baselining rule, with
-            # the same scan-back past foreign-backend artifacts), and
-            # only against predecessors that ran an HA wave at all.
+            # Artifact-over-artifact: only ratchet within one backend
+            # (check()'s re-baselining rule, with the same scan-back
+            # past foreign-backend artifacts), and only against
+            # predecessors that ran an HA wave at all.  When both
+            # sides carry the phase-0 solo baseline, compare the
+            # SCALE-OUT EFFICIENCY ratio (aggregate / same-wave solo)
+            # rather than raw wall clock: both terms of each ratio are
+            # measured minutes apart on one rig, so the ratio is
+            # invariant to the rig being faster or slower than it was
+            # when the predecessor was stamped — which is exactly the
+            # drift a raw pods/s comparison misreads as a regression.
+            # Predecessors without a solo baseline fall back to the
+            # raw-rate comparison (the only row they can support).
             comparable = [(n, a) for n, a in artifacts[:-1]
                           if (a.get("ha") or {})
                           .get("aggregate_steady_pods_per_s")
@@ -392,13 +403,129 @@ def check_ha(artifacts: list[tuple[str, dict]] | None = None,
                 else (None, {})
             prev_ha = (prev.get("ha") or {}) \
                 .get("aggregate_steady_pods_per_s")
-            if prev_ha and \
+            prev_own = (prev.get("ha") or {}) \
+                .get("single_scheduler_pods_per_s")
+            if prev_ha and prev_own and own:
+                ratio = float(agg) / float(own)
+                prev_ratio = float(prev_ha) / float(prev_own)
+                if ratio < prev_ratio * (1.0 - tolerance):
+                    problems.append(
+                        f"{new_name}: HA scale-out efficiency "
+                        f"{ratio:.2f} (aggregate {agg} / solo {own} "
+                        f"pods/s) fell more than {tolerance:.0%} below "
+                        f"the committed predecessor's {prev_ratio:.2f} "
+                        f"({prev_name}: {prev_ha} / {prev_own})")
+            elif prev_ha and \
                     float(agg) < float(prev_ha) * (1.0 - tolerance):
                 problems.append(
                     f"{new_name}: HA aggregate {agg} pods/s fell more "
                     f"than {tolerance:.0%} below the committed "
                     f"predecessor's HA aggregate {prev_ha} pods/s "
                     f"({prev_name})")
+    return problems
+
+
+def check_overload(artifacts: list[tuple[str, dict]] | None = None) \
+        -> list[str]:
+    """The overload-protection ratchet (ISSUE 16) over the newest SOAK
+    artifact's ``apiserver_kill`` and ``overload`` sections
+    (perf/soak.run_apiserver_kill_wave / run_overload_wave).  All rows
+    are invariants — no tolerances:
+
+    ``apiserver_kill``: any acknowledged write lost across the SIGKILL,
+    any double-bind in the WAL audit, any stranded pod, a kill that
+    never landed mid-avalanche (a quiet restart proves nothing), or a
+    recovery with zero reflector relists (the relist path was never
+    exercised) all fail.
+
+    ``overload``: a storm that never tripped the flow controller proves
+    nothing; the system lane must never shed and no shard lease may
+    expire (the protected lease plane); queue depth must stay inside
+    the configured bound; goodput must never collapse to zero; the
+    exempt /debug/vars must have answered throughout; and every acked
+    pod must still have bound.  Artifacts predating the sections
+    ratchet nothing."""
+    if artifacts is None:
+        artifacts = committed_soak_artifacts()
+    problems: list[str] = []
+    if not artifacts:
+        return problems
+    new_name, new = artifacts[-1]
+    kill = new.get("apiserver_kill") or {}
+    if kill:
+        if kill.get("acked_writes_lost"):
+            problems.append(
+                f"{new_name}: {kill['acked_writes_lost']} acknowledged "
+                f"write(s) lost across the apiserver SIGKILL — WAL "
+                f"durability broke (sample: {kill.get('lost_sample')})")
+        if kill.get("double_binds"):
+            problems.append(
+                f"{new_name}: {kill['double_binds']} double-bind(s) in "
+                f"the apiserver-kill WAL audit — a pod's nodeName moved "
+                f"between nodes across the crash")
+        if kill.get("stranded_pending"):
+            problems.append(
+                f"{new_name}: {kill['stranded_pending']} pod(s) "
+                f"stranded after the apiserver restart — the scheduler "
+                f"never reconverged the avalanche")
+        if not kill.get("killed_mid_avalanche"):
+            problems.append(
+                f"{new_name}: the apiserver kill never landed "
+                f"mid-avalanche (bound {kill.get('bound_at_kill')}, "
+                f"pending {kill.get('pending_at_kill')}) — the wave "
+                f"measured a quiet restart, not a crash")
+        if not kill.get("relists"):
+            problems.append(
+                f"{new_name}: zero reflector relists across the "
+                f"apiserver restart — the watch-break recovery path "
+                f"was never exercised")
+    ov = new.get("overload") or {}
+    if ov:
+        if not ov.get("shed_429"):
+            problems.append(
+                f"{new_name}: the overload storm never tripped the "
+                f"flow controller (0 shed 429s) — the wave measured "
+                f"nothing")
+        if ov.get("lease_expiries"):
+            problems.append(
+                f"{new_name}: {ov['lease_expiries']} shard lease(s) "
+                f"expired during the overload storm — the protected "
+                f"system lane failed to keep renewals inside the "
+                f"deadline")
+        if ov.get("system_rejected"):
+            problems.append(
+                f"{new_name}: the flow controller shed "
+                f"{ov['system_rejected']} system-lane request(s) — the "
+                f"lease plane was not protected")
+        if ov.get("max_queue_depth", 0) > ov.get("queue_limit", 0):
+            problems.append(
+                f"{new_name}: queue depth hit "
+                f"{ov['max_queue_depth']} past the configured bound "
+                f"{ov.get('queue_limit')} — the APF queues are not "
+                f"bounded")
+        if not ov.get("goodput_pods_per_s"):
+            problems.append(
+                f"{new_name}: zero goodput during the overload storm — "
+                f"shedding starved the workload lane entirely")
+        if ov.get("stranded_pending"):
+            problems.append(
+                f"{new_name}: {ov['stranded_pending']} pod(s) stranded "
+                f"after the overload wave — an admitted create never "
+                f"bound")
+        if ov.get("debug_vars_samples", 1) == 0 or \
+                ov.get("debug_vars_errors"):
+            problems.append(
+                f"{new_name}: the exempt /debug/vars stopped answering "
+                f"during the storm "
+                f"({ov.get('debug_vars_samples')} samples, "
+                f"{ov.get('debug_vars_errors')} errors) — liveness "
+                f"probes would have been shed")
+        mult = ov.get("offered_multiple")
+        if mult is not None and float(mult) < 3.0:
+            problems.append(
+                f"{new_name}: the overload storm offered only "
+                f"{mult}x what the flow-control envelope admitted "
+                f"(bar: >= 3x) — the wave never reached overload")
     return problems
 
 
@@ -838,6 +965,7 @@ def main() -> int:
     problems = check_workloads()
     problems += check_soak()
     problems += check_ha()
+    problems += check_overload()
     problems += check_serving()
     problems += check_tenancy()
     problems += check_xray()
@@ -871,6 +999,20 @@ def main() -> int:
                   f"{(ha.get('takeover') or {}).get('takeover_settle_s')}"
                   f"s, {ha.get('double_binds')} double-binds, aggregate "
                   f"{ha.get('aggregate_steady_pods_per_s')} pods/s")
+        kill = sk[-1][1].get("apiserver_kill") or {}
+        if kill:
+            print(f"apiserver-kill ratchet OK: {sk[-1][0]} "
+                  f"{kill.get('acked_creates')} acked creates, "
+                  f"{kill.get('acked_writes_lost')} lost, "
+                  f"{kill.get('double_binds')} double-binds, "
+                  f"{kill.get('relists')} relists")
+        ov = sk[-1][1].get("overload") or {}
+        if ov:
+            print(f"overload ratchet OK: {sk[-1][0]} "
+                  f"{ov.get('offered_multiple')}x capacity offered, "
+                  f"{ov.get('shed_429')} shed, goodput "
+                  f"{ov.get('goodput_pods_per_s')} pods/s, "
+                  f"{ov.get('lease_expiries')} lease expiries")
     tn = committed_tenancy_artifacts()
     if tn:
         new = tn[-1][1]
