@@ -50,6 +50,13 @@ GANG_SIZE_ANNOTATION_KEY = "scheduling.kt.io/gang-size"
 PRIORITY_ANNOTATION_KEY = "scheduling.kt.io/priority"
 TOPOLOGY_SPREAD_ANNOTATION_KEY = \
     "scheduling.kt.io/topologySpreadConstraints"
+# Two-phase defrag migration intent (scheduler/defrag.py).  Stamped on a
+# pod *before* its evict-to-pending; cleared once the pod rebinds (or by
+# the startup reconciler after a crash).  Value: JSON {"from": node,
+# "round": n}.  Lives here — not in the scheduler package — so the
+# recovery reconciler, the chaos bind monitor, and the defragmenter can
+# all read it without import cycles.
+DEFRAG_MIGRATION_ANNOTATION_KEY = "scheduling.kt.io/defrag-migration"
 
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
 

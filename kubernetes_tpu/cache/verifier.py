@@ -35,12 +35,12 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Callable, Iterable, Optional
 
 import numpy as np
 
 from kubernetes_tpu.api import types as api
-from kubernetes_tpu.utils import metrics, threadreg
+from kubernetes_tpu.utils import locktrace, metrics, threadreg
 from kubernetes_tpu.utils.logging import get_logger
 
 log = get_logger("verifier")
@@ -54,7 +54,7 @@ APISERVER_GRACE_S = 0.5
 
 @dataclass
 class Violation:
-    kind: str      # aggregates | device_row | apiserver
+    kind: str      # aggregates | device_row | apiserver | defrag
     detail: str
 
     def __str__(self) -> str:  # pragma: no cover — logging sugar
@@ -81,6 +81,12 @@ class Verifier:
         self._stop = threading.Event()
         self.passes = 0
         self.violations_total = 0
+        # Pods whose defrag migration just settled (scheduler/defrag.py
+        # arms these via note_defrag): the next pass runs the ``defrag``
+        # reconciliation kind over them — cache placement and aggregates
+        # must already reflect the moves.
+        self._defrag_pending: set[str] = set()
+        self._defrag_lock = locktrace.make_lock("cache.Verifier.defrag")
 
     # -- the three checks ------------------------------------------------
 
@@ -208,6 +214,60 @@ class Verifier:
         persistent = sorted(set(first) & set(second))
         return [Violation("apiserver", m) for m in persistent]
 
+    def note_defrag(self, keys: Iterable[str]) -> None:
+        """Arm the ``defrag`` reconciliation kind for settled migrations:
+        the next pass confirms cache placement and aggregate rows
+        reflect the moves (a scatter that missed an eviction delta shows
+        up here as a counted violation, not a skewed placement)."""
+        with self._defrag_lock:
+            self._defrag_pending.update(keys)
+
+    def _check_defrag(self) -> list[Violation]:
+        """Post-migration reconciliation over the armed key set: each
+        rebound migrant's cache attachment must match apiserver truth,
+        and the aggregate rows must survive a from-scratch recompute
+        (re-labeled ``defrag`` so the ratchet can pin migration-settle
+        integrity separately from steady-state drift)."""
+        with self._defrag_lock:
+            if not self._defrag_pending:
+                return []
+            keys, self._defrag_pending = self._defrag_pending, set()
+        out: list[Violation] = []
+        if self.truth is not None:
+            try:
+                items = self.truth()
+            except Exception:  # noqa: BLE001 — unreachable truth: re-arm
+                self.note_defrag(keys)
+                return []
+            truth_node = {}
+            for obj in items:
+                truth_node[api.key_from_json(obj)] = \
+                    (obj.get("spec") or {}).get("nodeName") or ""
+            suspect: list[tuple[str, str]] = []
+            for key in sorted(keys):
+                node = truth_node.get(key)
+                if not node:
+                    continue  # deleted, or re-evicted: nothing to confirm
+                tracked = self.cache.get_pod(key)
+                have = getattr(tracked, "node_name", None)
+                if have != node and not self.cache.is_assumed(key):
+                    suspect.append((key, node))
+            if suspect and not self._stop.wait(self.grace_s):
+                # Grace re-check: the confirm event for a just-landed
+                # re-bind may still be in the watch pipe — real drift
+                # survives the wait, delivery lag does not.
+                for key, node in suspect:
+                    tracked = self.cache.get_pod(key)
+                    have = getattr(tracked, "node_name", None)
+                    if have != node and not self.cache.is_assumed(key):
+                        out.append(Violation(
+                            "defrag",
+                            f"post-migration pod {key} bound to {node} "
+                            f"at the apiserver but cached on {have}"))
+        for v in self._check_aggregates():
+            out.append(Violation("defrag", "post-migration " + v.detail))
+        return out
+
     # -- orchestration ---------------------------------------------------
 
     def verify_once(self) -> list[Violation]:
@@ -215,7 +275,8 @@ class Verifier:
         Returns the violations found."""
         violations = (self._check_aggregates() +
                       self._check_device_rows() +
-                      self._check_apiserver())
+                      self._check_apiserver() +
+                      self._check_defrag())
         self.passes += 1
         if not violations:
             return []

@@ -1798,6 +1798,310 @@ def run_overload_wave(n_nodes: int = 200, calibration_pods: int = 900,
         api_srv.shutdown()
 
 
+def run_defrag_wave(n_nodes: int = 8, quiet: bool = False) -> dict:
+    """The continuous-rebalancing wave (ISSUE 17): fragmentation is
+    injected by BIASED CHURN — a fleet packed with small pods, one small
+    pod deleted per node (every node a little bit empty), then large
+    pods created that fit NOWHERE whole — and the always-on defragmenter
+    must consolidate the slivers: evict small pods into other nodes'
+    free space (two-phase, intent-annotated, PDB-vetoed) so the large
+    pods place.  The wave then lands a scheduler SIGKILL (``abandon``)
+    mid-migration — after the evict-to-pending, with the rebind path
+    chaos-blocked so the window cannot close — and the restarted
+    scheduler's startup reconcile must requeue the in-flight pod and
+    clear its intent.  The ratchet (``check_defrag``) pins:
+    ``defrag_gain > 0``, migrations never exceeding the per-round cap,
+    0 PDB violations, 0 stranded pods, 0 double-binds / double-capacity,
+    0 invariant violations, and ``migrations_recovered >= 1``."""
+    from kubernetes_tpu.api.types import DEFRAG_MIGRATION_ANNOTATION_KEY
+    from kubernetes_tpu.apiserver.server import serve
+    from kubernetes_tpu.chaos.proxy import FAULT_ERROR, Rule
+    from kubernetes_tpu.controller.disruption import DisruptionController
+    from kubernetes_tpu.scheduler.factory import ConfigFactory
+
+    t_start = time.monotonic()
+    n_large = 3
+
+    def log(msg: str) -> None:
+        if not quiet:
+            print(f"defrag[{time.monotonic() - t_start:6.1f}s] {msg}",
+                  file=sys.stderr)
+
+    saved_env = {k: os.environ.get(k) for k in (
+        "KT_DEFRAG", "KT_DEFRAG_PERIOD_S", "KT_DEFRAG_MAX_MIGRATIONS",
+        "KT_DEFRAG_MIN_GAIN", "KT_DEFRAG_BUDGET", "KT_TENANTS",
+        "KT_VERIFY_PERIOD", "KT_POD_BACKOFF_S", "KT_POD_BACKOFF_MAX_S")}
+    os.environ.update({
+        # Short period: the soak must converge in seconds, not minutes.
+        "KT_DEFRAG": "1", "KT_DEFRAG_PERIOD_S": "0.3",
+        "KT_DEFRAG_MAX_MIGRATIONS": "4", "KT_DEFRAG_MIN_GAIN": "0.2",
+        "KT_DEFRAG_BUDGET": "16",
+        # One tenant engages the SolverService, so the defrag probe
+        # rides its low-priority submit_background lane (the tentpole's
+        # tenant-placement requirement), not the host fallback.
+        "KT_TENANTS": "default",
+        "KT_VERIFY_PERIOD": "0.5",
+        "KT_POD_BACKOFF_S": "0.1", "KT_POD_BACKOFF_MAX_S": "1",
+    })
+    inv0 = _labeled_snapshot(metrics.CACHE_INVARIANT_VIOLATIONS)
+    store = MemStore()
+    api_srv = serve(store)
+    api_url = f"http://127.0.0.1:{api_srv.server_address[1]}"
+    direct = APIClient(api_url, qps=0)
+    # The scheduler rides through a ChaosProxy so phase B can BLOCK the
+    # rebind path (500 every POST /bindings): the kill then provably
+    # lands inside the evict->rebind window, not after it.
+    proxy = ChaosProxy(api_url).start()
+
+    # Geometry that makes every migration decision exact: 1000m nodes,
+    # 300m small pods, 600m large pods.  Packed 3-up (900m) and churned
+    # down to 2-up, every node holds 400m free — no large pod fits
+    # anywhere, yet one 300m migration clears 700m on its source node.
+    direct.create_list("nodes", [
+        _node_json(f"df-{i:02d}", milli_cpu=1000, pods=16)
+        for i in range(n_nodes)])
+    # Two pods on node 0 are PDB-protected with minAvailable=2 — zero
+    # disruption headroom, so the rebalancer must route around them.
+    direct.create("poddisruptionbudgets", {
+        "metadata": {"name": "df-pdb", "namespace": "default"},
+        "spec": {"minAvailable": 2, "selector": {"app": "df-prot"}}})
+
+    def small(i: int, j: int) -> dict:
+        protected = i == 0 and j < 2
+        obj = _pod_json(f"df-s-{i:02d}-{j}", cpu="300m")
+        obj["spec"]["nodeName"] = f"df-{i:02d}"
+        obj["metadata"]["labels"] = {
+            "app": "df-prot" if protected else "df-small"}
+        obj["status"] = {"phase": "Running", "conditions": [
+            {"type": "Ready", "status": "True"}]}
+        return obj
+
+    direct.create_list("pods", [small(i, j) for i in range(n_nodes)
+                                for j in range(3)])
+    # The biased churn: delete one small pod per node.  Every node now
+    # carries a 400m sliver; the fleet has 3200m free and can fit no
+    # 600m pod.
+    for i in range(n_nodes):
+        direct.delete("pods", f"default/df-s-{i:02d}-2")
+    dc = DisruptionController(store, sync_period=0.2).run()
+    monitor = BindMonitor(store)
+    protected = {"default/df-s-00-0", "default/df-s-00-1"}
+    pdb_unbinds: list[str] = []
+    kill_armed = threading.Event()
+    intent_unbound = threading.Event()
+    watch_stop = threading.Event()
+    watcher = store.watch(["pods"], from_rv=store.list("pods")[1])
+
+    ev_log: list[tuple] = []
+
+    def watch_loop() -> None:
+        while not watch_stop.is_set():
+            ev = watcher.next(timeout=0.5)
+            if ev is None:
+                continue
+            node = (ev.object.get("spec") or {}).get("nodeName") or ""
+            ann = ((ev.object.get("metadata") or {})
+                   .get("annotations") or {})
+            ev_log.append((round(time.monotonic() - t_start, 2),
+                           ev.type, ev.key, node,
+                           DEFRAG_MIGRATION_ANNOTATION_KEY in ann))
+            if ev.type == "DELETED":
+                continue
+            if not node and ev.key in protected:
+                pdb_unbinds.append(ev.key)
+            if not node and DEFRAG_MIGRATION_ANNOTATION_KEY in ann \
+                    and kill_armed.is_set():
+                intent_unbound.set()
+
+    threading.Thread(target=watch_loop, daemon=True,
+                     name="defrag-wave-watch").start()
+
+    factory = factory2 = None
+    stats1: dict = {}
+    killed_mid_migration = False
+    migrations_recovered = intents_cleared = 0
+    stranded = -1
+    try:
+        factory = ConfigFactory(proxy.base_url, qps=5000, burst=5000)
+        factory.daemon.backoff = PodBackoff(default_duration=0.1,
+                                            max_duration=1.0)
+        factory.run()
+        log(f"scheduler up, defrag on ({n_nodes} nodes, "
+            f"{n_nodes * 2} small pods, 400m slivers everywhere)")
+
+        # Phase A: two large pods that fit nowhere whole.  The live
+        # path: probe marks them blocked, the planner clears a node per
+        # pod, the ordinary enqueue->solve->bind path completes each
+        # migration, and the settle pass credits the unblocks.
+        direct.create_list("pods", [_pod_json(f"df-l-{k}", cpu="600m")
+                                    for k in range(n_large - 1)])
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            items, _ = store.list("pods")
+            unbound = sum(1 for o in items
+                          if not (o.get("spec") or {}).get("nodeName"))
+            rep = factory.defrag.report() if factory.defrag else {}
+            if unbound == 0 and rep.get("unblocked", 0) >= n_large - 1:
+                break
+            time.sleep(0.1)
+        rep = factory.defrag.report() if factory.defrag else {}
+        log(f"phase A settled: {rep.get('migrations_executed', 0)} "
+            f"migration(s), {rep.get('unblocked', 0)} unblocked, "
+            f"{rep.get('vetoed_pdb', 0)} PDB-vetoed victim(s)")
+
+        # Phase B: block the rebind path, offer one more large pod, and
+        # SIGKILL the scheduler the moment a migration's evict lands —
+        # the in-flight pod is then pending WITH an intent annotation,
+        # exactly the state a crash between the two phases leaves.
+        proxy.add_rules([Rule(fault=FAULT_ERROR, method="POST",
+                              path=r"/bindings", every_nth=1)])
+        kill_armed.set()
+        direct.create("pods", _pod_json(f"df-l-{n_large - 1}",
+                                        cpu="600m"))
+        killed_mid_migration = intent_unbound.wait(timeout=90)
+        factory.abandon()
+        time.sleep(0.3)  # the abandoned round's _execute drains
+        stats1 = factory.defrag.report() if factory.defrag else {}
+        log(f"SIGKILLed the scheduler mid-migration "
+            f"(caught-in-window={killed_mid_migration}, "
+            f"{stats1.get('inflight', 0)} in flight)")
+        proxy.clear()
+
+        # The restarted scheduler: startup reconcile must requeue the
+        # stranded migrant and clear its intent; the still-on defrag
+        # loop finishes whatever rebalancing remains.
+        factory2 = ConfigFactory(api_url, qps=5000, burst=5000)
+        factory2.daemon.backoff = PodBackoff(default_duration=0.1,
+                                             max_duration=1.0)
+        factory2.run()
+        rec = factory2.last_recovery or {}
+        migrations_recovered = int(rec.get("migrations_recovered", 0))
+        intents_cleared = int(rec.get("migration_intents_cleared", 0))
+        log(f"restarted: {migrations_recovered} migration(s) requeued "
+            f"by reconcile, {intents_cleared} stale intent(s) cleared")
+        deadline = time.time() + 120
+        last_dump = time.monotonic()
+        while time.time() < deadline:
+            items, _ = store.list("pods")
+            unbound = [api.key_from_json(o) for o in items
+                       if not (o.get("spec") or {}).get("nodeName")]
+            intents = sum(
+                1 for o in items
+                if DEFRAG_MIGRATION_ANNOTATION_KEY in
+                ((o.get("metadata") or {}).get("annotations") or {}))
+            # Wait for the intent annotations to drain too: the clear
+            # rides defrag's NEXT settle tick after the rebind, so
+            # measuring at first-converged would flag a false lingerer.
+            if not unbound and intents == 0:
+                stranded = 0
+                break
+            if time.monotonic() - last_dump > 10:
+                last_dump = time.monotonic()
+                free = {(o.get("metadata") or {}).get("name"):
+                        int((o.get("status") or {})
+                            .get("allocatable", {}).get("cpu", "0m")
+                            .rstrip("m"))
+                        for o in store.list("nodes")[0]}
+                for o in items:
+                    nd = (o.get("spec") or {}).get("nodeName")
+                    if nd in free:
+                        free[nd] -= MemStore._pod_requests(o)[0]
+                log(f"settling: unbound={unbound} free_milli={free} "
+                    f"defrag={factory2.defrag.report() if factory2.defrag else {}}")
+            time.sleep(0.1)
+        if stranded < 0:
+            items, _ = store.list("pods")
+            bad = [api.key_from_json(o) for o in items
+                   if not (o.get("spec") or {}).get("nodeName")]
+            stranded = len(bad)
+            for k in bad:
+                log(f"stranded {k} event history: "
+                    f"{[e for e in ev_log if e[2] == k]}")
+        if factory2.verifier is not None:
+            try:  # one forced settled pass so the artifact's invariant
+                factory2.verifier.verify_once()  # column is post-moves
+            except Exception:  # noqa: BLE001 — wave teardown races
+                pass
+        stats2 = factory2.defrag.report() if factory2.defrag else {}
+    finally:
+        watch_stop.set()
+        watcher.stop()
+        monitor.stop()
+        dc.stop()
+        for f in (factory, factory2):
+            if f is not None:
+                try:
+                    f.stop()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+        proxy.stop()
+        api_srv.shutdown()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    items, _ = store.list("pods")
+    larges_bound = sum(
+        1 for o in items
+        if o["metadata"]["name"].startswith("df-l-")
+        and (o.get("spec") or {}).get("nodeName"))
+    lingering_intents = sum(
+        1 for o in items
+        if DEFRAG_MIGRATION_ANNOTATION_KEY in
+        ((o.get("metadata") or {}).get("annotations") or {}))
+    migrations_executed = (int(stats1.get("migrations_executed", 0)) +
+                           int(stats2.get("migrations_executed", 0)))
+    inv_delta = _labeled_delta(metrics.CACHE_INVARIANT_VIOLATIONS, inv0)
+    out = {
+        "n_nodes": n_nodes,
+        "small_pods": n_nodes * 3,
+        "churn_deleted": n_nodes,
+        "large_pods": n_large,
+        "blocked_larges_bound": larges_bound,
+        # The ratcheted column: placements unblocked per migration.
+        # Every large pod fit nowhere at creation time, so each one
+        # bound is a placement only the rebalancer could have made.
+        "defrag_gain": round(larges_bound /
+                             max(1, migrations_executed), 3),
+        "unblocked_credited": int(stats1.get("unblocked", 0)) +
+        int(stats2.get("unblocked", 0)),
+        "migrations_executed": migrations_executed,
+        "migrations_completed":
+            int(stats1.get("migrations_completed", 0)) +
+            int(stats2.get("migrations_completed", 0)),
+        "max_batch": max(int(stats1.get("max_batch", 0)),
+                         int(stats2.get("max_batch", 0))),
+        "migration_cap": 4,
+        "vetoed_budget": int(stats1.get("vetoed_budget", 0)) +
+        int(stats2.get("vetoed_budget", 0)),
+        "vetoed_pdb": int(stats1.get("vetoed_pdb", 0)) +
+        int(stats2.get("vetoed_pdb", 0)),
+        "cas_conflicts": int(stats1.get("cas_conflict", 0)) +
+        int(stats2.get("cas_conflict", 0)),
+        "pdb_violations": len(pdb_unbinds),
+        "stranded": stranded,
+        "lingering_intents": lingering_intents,
+        "double_binds": monitor.double_binds,
+        "double_capacity": monitor.double_capacity,
+        "monitor_migrations_started": monitor.migrations_started,
+        "monitor_migrations_completed": monitor.migrations_completed,
+        "invariant_violations": int(sum(inv_delta.values())),
+        "invariant_detail": {k: v for k, v in inv_delta.items() if v},
+        "killed_mid_migration": bool(killed_mid_migration),
+        "migrations_recovered": migrations_recovered,
+        "migration_intents_cleared": intents_cleared,
+        "duration_s": round(time.monotonic() - t_start, 1),
+    }
+    log(f"done: gain={out['defrag_gain']} over "
+        f"{migrations_executed} migration(s), {stranded} stranded, "
+        f"{len(pdb_unbinds)} PDB violations, "
+        f"{monitor.double_capacity} double-capacity, "
+        f"{migrations_recovered} crash-recovered")
+    return out
+
+
 def _reconcile(store: MemStore, factory, monitor: _BindMonitor) -> dict:
     """Post-soak apiserver-vs-oracle reconciliation: the acceptance
     invariants a mid-drain kill must not break."""
@@ -1912,6 +2216,11 @@ def collect(ha: bool = True, **kw) -> dict:
         # The overload wave: APF shedding + the protected lease plane
         # under a 3x-capacity best-effort storm.
         rec["overload"] = run_overload_wave(quiet=kw.get("quiet", False))
+    if os.environ.get("BENCH_SOAK_DEFRAG", "1") != "0":
+        # The defrag wave: continuous rebalancing under biased-churn
+        # fragmentation, with a scheduler SIGKILL mid-migration; the
+        # ratchet's check_defrag pins gain > 0 and the zero columns.
+        rec["defrag"] = run_defrag_wave(quiet=kw.get("quiet", False))
     # The artifact-level locktrace columns check_soak ratchets to zero:
     # the main churn run + the HA wave (scraped from the survivor
     # processes) + the tenancy poison wave, all under KT_LOCKTRACE=1.
@@ -1955,6 +2264,8 @@ def main() -> None:
                     help="skip the apiserver-kill wave")
     ap.add_argument("--no-overload", action="store_true",
                     help="skip the overload wave")
+    ap.add_argument("--no-defrag", action="store_true",
+                    help="skip the defrag wave")
     opts = ap.parse_args()
     rec = run_soak(n_nodes=opts.nodes, duration_s=opts.duration,
                    chaos=not opts.no_chaos,
@@ -1966,6 +2277,8 @@ def main() -> None:
         rec["apiserver_kill"] = run_apiserver_kill_wave()
     if not opts.no_overload:
         rec["overload"] = run_overload_wave()
+    if not opts.no_defrag:
+        rec["defrag"] = run_defrag_wave()
     with open(opts.out, "w") as f:
         json.dump(rec, f, indent=1)
         f.write("\n")

@@ -54,6 +54,18 @@ class MemStoreBinder:
         except KeyError:
             pass  # already gone (watch raced the eviction)
 
+    def unbind(self, pod: api.Pod) -> None:
+        """Defrag eviction-to-pending (scheduler/defrag.py): clear
+        spec.nodeName under CAS — the pod stays alive and the
+        unassigned reflector's set-transition requeues it."""
+        obj = self.store.get("pods", pod.key)
+        if obj is None:
+            raise KeyError(f"pods {pod.key} not found")
+        obj.setdefault("spec", {})["nodeName"] = ""
+        self.store.update("pods", obj,
+                          expected_rv=(obj.get("metadata") or {})
+                          .get("resourceVersion"))
+
 
 def make_event_sink(source: Union[MemStore, APIClient]):
     """An EventRecorder sink that posts Events as API objects
@@ -165,6 +177,9 @@ class ConfigFactory:
         # on /debug/vars; None until run() completes the pass.
         self.last_recovery: Optional[dict] = None
         self.verifier = None
+        # Continuous rebalancing loop (scheduler/defrag.py); constructed
+        # by run() behind KT_DEFRAG.
+        self.defrag = None
         # Decision-latency SLO burn monitor (scheduler/slo.py); started
         # by run() at KT_SLO_PERIOD cadence, reported on /debug/vars.
         from kubernetes_tpu.scheduler.slo import SLOMonitor
@@ -275,7 +290,15 @@ class ConfigFactory:
                 return
         pod = api.pod_from_json(obj)
         if etype == "DELETED" or _is_terminated(obj):
-            if pod.node_name:
+            # A set-transition DELETED (the pod left the bound set on an
+            # UNBIND — the defrag evict-to-pending path) carries the NEW
+            # object, whose nodeName is already empty: remove whatever
+            # the cache actually tracks under the key, not the carried
+            # object, or the eviction leaves a ghost entry behind.
+            cached = self.algorithm.cache.get_pod(pod.key)
+            if cached is not None:
+                self.algorithm.cache.remove_pod(cached)
+            elif pod.node_name:
                 self.algorithm.cache.remove_pod(pod)
             return
         self._on_assigned_pod(etype, obj, pod=pod)
@@ -531,6 +554,22 @@ class ConfigFactory:
                 self.algorithm.cache, resident=self.algorithm.resident,
                 truth=lambda: self.store.list("pods")[0])
             self._threads.append(self.verifier.run(period=verify_period))
+        if knobs.get_bool("KT_DEFRAG"):
+            # Always-on defragmentation (scheduler/defrag.py): dry joint
+            # solves over the bound state propose bounded, PDB-vetoed
+            # migration batches.  With tenancy on the probe rides the
+            # SolverService's low-priority background lane so defrag
+            # never steals device time from live drains; without it the
+            # controller's host-side feasibility walk stands in.
+            from kubernetes_tpu.scheduler.defrag import DefragController
+            probe = None
+            if self.tenancy is not None:
+                probe = lambda pods: self.tenancy.submit_background(  # noqa: E731
+                    pods, joint=True)
+            self.defrag = DefragController(self.daemon, self.store,
+                                           probe=probe,
+                                           verifier=self.verifier)
+            self._threads.append(self.defrag.run())
         if self.shards is not None:
             # Shard leases start AFTER reflectors sync and the full
             # startup reconcile: each acquisition's takeover relist then
@@ -564,6 +603,8 @@ class ConfigFactory:
             r.stop()
         if self.verifier is not None:
             self.verifier.stop()
+        if self.defrag is not None:
+            self.defrag.stop()
         self.slo.stop()
         self.daemon.stop()
         sink = getattr(self.daemon.config.recorder, "_sink", None)
@@ -587,5 +628,10 @@ class ConfigFactory:
             r.stop()
         if self.verifier is not None:
             self.verifier.stop()
+        if self.defrag is not None:
+            # Thread stops, but in-flight migration intents stay on the
+            # apiserver exactly as a kill -9 leaves them — the next
+            # incarnation's reconcile requeues or clears them.
+            self.defrag.stop()
         self.slo.stop()
         self.daemon.abandon()

@@ -189,6 +189,36 @@ class FlightRecorder:
                               {pod_key: node}, {pod_key: detail})
             self._ring.append(rec)
 
+    def record_defrag(self, pod_key: str, decision: str,
+                      from_node: str = "", to_node: str = "",
+                      target: str = "") -> None:
+        """A defragmentation decision touched this pod
+        (scheduler/defrag.py): ``decision`` is one of proposed /
+        executed / completed / vetoed_budget / vetoed_pdb /
+        cas_conflict / crash-recovered; ``from_node``/``to_node`` frame
+        the migration, ``target`` names the blocked pod the move serves.
+        Amends the pod's newest record so ``kubectl explain pod``
+        answers "why did the rebalancer move me"."""
+        detail: dict = {"defrag": decision}
+        if from_node:
+            detail["migration_from"] = from_node
+        if to_node:
+            detail["migration_to"] = to_node
+        if target:
+            detail["migration_for"] = target
+        with self._lock:
+            for rec in reversed(self._ring):
+                if pod_key not in rec.placements:
+                    continue
+                old = rec.failures.get(pod_key)
+                rec.failures[pod_key] = {**old, **detail} if old \
+                    else detail
+                return
+            rec = BatchRecord(next(self._seq), "", time.time(), 0.0,
+                              {pod_key: to_node or None},
+                              {pod_key: detail})
+            self._ring.append(rec)
+
     # -- persistence across restarts (KT_FLIGHT_DIR) ----------------------
 
     def save(self, flight_dir: str) -> str:

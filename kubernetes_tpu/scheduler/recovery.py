@@ -31,6 +31,7 @@ sync and before the drain loop starts (``KT_RECOVERY=0`` opts out).
 
 from __future__ import annotations
 
+import json
 import time
 from typing import Callable, Optional
 
@@ -39,6 +40,37 @@ from kubernetes_tpu.utils import metrics
 from kubernetes_tpu.utils.logging import get_logger
 
 log = get_logger("recovery")
+
+
+def _migration_intent(obj: dict) -> Optional[dict]:
+    """The defrag migration-intent annotation (scheduler/defrag.py) on a
+    pod dict, parsed, or None.  An unparseable value still counts as an
+    intent (it must be cleared) but carries no source node."""
+    raw = ((obj.get("metadata") or {}).get("annotations") or {}).get(
+        api.DEFRAG_MIGRATION_ANNOTATION_KEY)
+    if raw is None:
+        return None
+    try:
+        parsed = json.loads(raw)
+        return parsed if isinstance(parsed, dict) else {}
+    except ValueError:
+        return {}
+
+
+def _clear_migration_intent(store, obj: dict) -> bool:
+    """Drop the intent annotation under CAS.  A lost CAS is fine — the
+    live defragmenter's settle pass (or the next restart) retires it."""
+    from kubernetes_tpu.client import cas_update
+    meta = obj.setdefault("metadata", {})
+    ann = dict(meta.get("annotations") or {})
+    if ann.pop(api.DEFRAG_MIGRATION_ANNOTATION_KEY, None) is None:
+        return False
+    meta["annotations"] = ann
+    try:
+        cas_update(store, "pods", obj)
+    except Exception:  # noqa: BLE001 — CAS race: someone else owns it now
+        return False
+    return True
 
 
 def reconcile(daemon, store, scheduler_name: Optional[str] = None) -> dict:
@@ -54,7 +86,8 @@ def reconcile(daemon, store, scheduler_name: Optional[str] = None) -> dict:
     cache = daemon.config.algorithm.cache
     items, _rv = store.list("pods")
     report = {"readopted": 0, "requeued": 0, "expired": 0, "removed": 0,
-              "confirmed": 0, "pods_listed": len(items)}
+              "confirmed": 0, "pods_listed": len(items),
+              "migrations_recovered": 0, "migration_intents_cleared": 0}
     seen: set[str] = set()
     for obj in items:
         key = api.key_from_json(obj)
@@ -62,7 +95,16 @@ def reconcile(daemon, store, scheduler_name: Optional[str] = None) -> dict:
         if api.is_terminated_json(obj):
             continue
         node = (obj.get("spec") or {}).get("nodeName") or ""
+        intent = _migration_intent(obj)
         if node:
+            if intent is not None:
+                # A SIGKILL landed between the defragmenter's intent
+                # stamp and its evict (or after the pod already rebound):
+                # the pod is bound, so the stale intent just clears.
+                if _clear_migration_intent(store, obj):
+                    report["migration_intents_cleared"] += 1
+                    metrics.DEFRAG_RECOVERED.labels(
+                        action="cleared").inc()
             # Bound at the apiserver.  An assumed entry agreeing on the
             # node just flips to confirmed; anything else (unknown pod,
             # or one tracked on a DIFFERENT node) re-adopts through the
@@ -92,6 +134,21 @@ def reconcile(daemon, store, scheduler_name: Optional[str] = None) -> dict:
                     daemon.enqueue(pod)
                     if key in daemon.queue:
                         report["requeued"] += 1
+            if intent is not None:
+                # A SIGKILL landed between the defragmenter's evict and
+                # the pod's re-bind: the migrant is pending and (by the
+                # requeue above, or the reflector sync before this pass)
+                # back on the queue — requeued, not stranded.  Clear the
+                # intent so nothing mistakes it for an in-flight move.
+                if _clear_migration_intent(store, obj):
+                    report["migrations_recovered"] += 1
+                    metrics.DEFRAG_RECOVERED.labels(
+                        action="requeued").inc()
+                    fr = daemon.config.flight_recorder
+                    if fr is not None:
+                        fr.record_defrag(key, "crash-recovered",
+                                         from_node=str(
+                                             intent.get("from", "")))
     # Cache entries with no apiserver record: ghosts from the previous
     # incarnation (pod deleted while the scheduler was down).
     for key, _node, assumed in cache.tracked_pods():

@@ -362,6 +362,38 @@ class SolverService:
             raise req["err"]
         return req["result"]
 
+    def submit_background(self, pods: list, timeout: float = 30.0,
+                          joint: bool = True) -> Optional[list]:
+        """Low-priority solve lane (the defragmenter's tenancy seat,
+        ISSUE 17): solve only when NO live submit is pending or holding
+        the engine — a background solve never queues ahead of a drain,
+        so defrag dry-solves cannot steal device time from live
+        tenants.  Returns placements, or None if the engine stayed busy
+        for the whole ``timeout`` (the caller skips this round)."""
+        if not pods:
+            return []
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._pending_lock:
+                busy = bool(self._pending)
+            if busy:
+                time.sleep(0.02)
+                continue
+            if not self.engine_lock.acquire(timeout=0.05):
+                continue
+            try:
+                with self._pending_lock:
+                    if self._pending:
+                        # A live submit arrived while we took the lock:
+                        # yield immediately — it becomes the leader on
+                        # our release and drains the pending set.
+                        continue
+                return self.engine.schedule_batch(
+                    pods, joint=joint, pad_to=self._pad_bucket(len(pods)))
+            finally:
+                self.engine_lock.release()
+        return None
+
     def _solve_packed(self, batch: list[dict]) -> None:
         """One packed solve for every pending request: host-tenant
         requests route to the host engine per request; the device set

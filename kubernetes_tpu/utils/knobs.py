@@ -217,6 +217,22 @@ _knob("KT_WIRE_ACCUM", None, "float",
       "tunneled chip, 20 locally)")
 _knob("KT_PERF_ASSERTS", "1", "bool",
       "Wall-clock assertions in perf-sensitive tests (0 on slow rigs)")
+# -- continuous rebalancing (ISSUE 17) ----------------------------------
+_knob("KT_DEFRAG", "0", "bool",
+      "Background defragmentation loop (scheduler/defrag.py): dry joint "
+      "solves over the bound state propose bounded migration batches")
+_knob("KT_DEFRAG_PERIOD_S", "30", "float",
+      "Defrag round cadence in seconds (a round = settle in-flight "
+      "migrations, probe-solve the blocked set, plan + execute a batch)")
+_knob("KT_DEFRAG_MAX_MIGRATIONS", "8", "int",
+      "Hard cap on migrations executed per defrag round (window); a "
+      "plan is trimmed to it before the gain gate")
+_knob("KT_DEFRAG_MIN_GAIN", "0.5", "float",
+      "Cost-model floor: projected placements unblocked per migration; "
+      "a batch below it is vetoed (recorded vetoed-by-budget)")
+_knob("KT_DEFRAG_BUDGET", "16", "int",
+      "Disruption budget: max evicted-but-not-yet-rebound pods allowed "
+      "in flight at once; new batches are vetoed while it is spent")
 # -- concurrency discipline (ISSUE 13) ----------------------------------
 _knob("KT_LOCKTRACE", "0", "bool",
       "Instrumented locks: per-thread acquisition chains, order-"

@@ -498,6 +498,38 @@ PREEMPTIONS = register(Counter(
 PREEMPTION_VICTIMS = register(Counter(
     "scheduler_preemption_victims_total",
     "Pods evicted by executed preemption decisions"))
+# Continuous rebalancing (scheduler/defrag.py): the background joint-
+# solve defragmenter.  Every migration decision is counted (and flight-
+# recorded); the soak's defrag wave ratchets gain > 0 with zero PDB
+# violations and zero stranded migrants.
+DEFRAG_ROUNDS = register(Counter(
+    "scheduler_defrag_rounds_total",
+    "Defragmentation rounds executed by the background rebalancer "
+    "(each: settle in-flight migrations, probe-solve the blocked set, "
+    "plan + gate + execute one bounded migration batch)"))
+DEFRAG_MIGRATIONS = register(Counter(
+    "scheduler_defrag_migrations_total",
+    "Per-pod migration decisions by result: executed (intent stamped + "
+    "evicted to pending), vetoed_budget (batch failed the min-gain "
+    "cost model or the in-flight disruption budget), vetoed_pdb "
+    "(victim protected by PodDisruptionBudget state), cas_conflict "
+    "(intent stamp or evict lost the resourceVersion CAS)",
+    labelnames=("result",)))
+DEFRAG_UNBLOCKED = register(Counter(
+    "scheduler_defrag_unblocked_total",
+    "Previously-unschedulable pods observed bound after a defrag "
+    "migration batch — the numerator of the soak's defrag_gain column"))
+DEFRAG_INFLIGHT = register(Gauge(
+    "scheduler_defrag_inflight_migrations",
+    "Evicted-but-not-yet-rebound migrations currently in flight (the "
+    "disruption budget KT_DEFRAG_BUDGET is spent against this)"))
+DEFRAG_RECOVERED = register(Counter(
+    "scheduler_defrag_recovered_total",
+    "Migration intents found by the startup reconciler after a crash, "
+    "by action: requeued (evicted-but-not-rebound pod put back on the "
+    "queue, intent cleared) or cleared (pod still/again bound; stale "
+    "intent dropped)",
+    labelnames=("action",)))
 # Persistent XLA compilation cache (engine/compile_cache.py): without
 # these the 3-4 s \"warm\" start is undiagnosable — a miss here is a
 # program that re-paid the full XLA compile despite the cache.

@@ -515,6 +515,23 @@ def test_ha_efficiency_ratchets_against_predecessors_ha_wave():
             ("SOAK_r12.json", dict(_soak(), backend="tpu",
                                    ha=_ha(agg=700.0, baseline=450.0)))]
     assert cb.check_ha(arts) == []
+    # One-phase rig drift: the solo baseline inflated 2x (cache
+    # warmth a timeshared aggregate cannot follow) while the aggregate
+    # held — the ratio fell, but the fleet got no slower: drift, not a
+    # regression.
+    arts = [("SOAK_r11.json", prev),
+            ("SOAK_r12.json", dict(_soak(), backend="cpu",
+                                   ha=_ha(agg=810.0, baseline=900.0,
+                                          cpus=1)))]
+    assert cb.check_ha(arts) == []
+    # But an inflated solo does NOT excuse a genuine aggregate
+    # collapse: both the ratio and the raw rate fell — regression.
+    arts = [("SOAK_r11.json", prev),
+            ("SOAK_r12.json", dict(_soak(), backend="cpu",
+                                   ha=_ha(agg=400.0, baseline=900.0,
+                                          cpus=1)))]
+    problems = cb.check_ha(arts)
+    assert len(problems) == 1 and "efficiency" in problems[0]
 
 
 def test_ha_predecessor_without_solo_baseline_falls_back_to_rate():
@@ -898,3 +915,86 @@ def test_all_wire_runs_errored_still_fails():
     d["wire"] = {"zero_bound_runs": 0, "failed_runs": 3, "runs": []}
     problems = cb.check_wire([("BENCH_r15.json", d)])
     assert problems and "every wire run failed" in problems[0]
+
+
+# -- continuous-defrag ratchet (ISSUE 17) ------------------------------------
+
+def _defrag(gain=0.5, executed=6, pdb=0, stranded=0, intents=0,
+            double=0, double_cap=0, inv=0, batch=2, cap=4, mid=True,
+            recovered=1):
+    return {"n_nodes": 8, "small_pods": 24, "churn_deleted": 8,
+            "large_pods": 3, "blocked_larges_bound": 3,
+            "defrag_gain": gain, "unblocked_credited": 3,
+            "migrations_executed": executed,
+            "migrations_completed": executed - 1, "max_batch": batch,
+            "migration_cap": cap, "vetoed_budget": 0, "vetoed_pdb": 10,
+            "cas_conflicts": 0, "pdb_violations": pdb,
+            "stranded": stranded, "lingering_intents": intents,
+            "double_binds": double, "double_capacity": double_cap,
+            "invariant_violations": inv, "invariant_detail": {},
+            "killed_mid_migration": mid,
+            "migrations_recovered": recovered,
+            "migration_intents_cleared": 0, "duration_s": 5.0}
+
+
+def test_repo_artifacts_pass_the_defrag_ratchet():
+    problems = cb.check_defrag()
+    assert problems == [], problems
+
+
+def test_defrag_section_absent_ratchets_nothing():
+    assert cb.check_defrag([("SOAK_r16.json", _soak())]) == []
+    assert cb.check_defrag([]) == []
+
+
+def test_defrag_zero_gain_or_zero_migrations_fails():
+    art = dict(_soak(), defrag=_defrag(gain=0.0))
+    problems = cb.check_defrag([("SOAK_r17.json", art)])
+    assert any("defrag_gain" in p for p in problems)
+    art = dict(_soak(), defrag=_defrag(executed=0))
+    problems = cb.check_defrag([("SOAK_r17.json", art)])
+    assert any("zero migrations" in p for p in problems)
+
+
+def test_defrag_pdb_violation_fails():
+    art = dict(_soak(), defrag=_defrag(pdb=1))
+    problems = cb.check_defrag([("SOAK_r17.json", art)])
+    assert len(problems) == 1 and "PDB" in problems[0]
+
+
+def test_defrag_stranded_or_lingering_intent_fails():
+    art = dict(_soak(), defrag=_defrag(stranded=2))
+    problems = cb.check_defrag([("SOAK_r17.json", art)])
+    assert len(problems) == 1 and "stranded" in problems[0]
+    art = dict(_soak(), defrag=_defrag(intents=1))
+    problems = cb.check_defrag([("SOAK_r17.json", art)])
+    assert len(problems) == 1 and "never cleared" in problems[0]
+
+
+def test_defrag_double_capacity_and_invariants_fail():
+    art = dict(_soak(), defrag=_defrag(double_cap=1))
+    problems = cb.check_defrag([("SOAK_r17.json", art)])
+    assert len(problems) == 1 and "double-capacity" in problems[0]
+    art = dict(_soak(), defrag=_defrag(inv=3))
+    problems = cb.check_defrag([("SOAK_r17.json", art)])
+    assert len(problems) == 1 and "invariant" in problems[0]
+
+
+def test_defrag_budget_leak_fails():
+    art = dict(_soak(), defrag=_defrag(batch=7, cap=4))
+    problems = cb.check_defrag([("SOAK_r17.json", art)])
+    assert len(problems) == 1 and "per-round cap" in problems[0]
+
+
+def test_defrag_kill_arc_must_land_and_recover():
+    art = dict(_soak(), defrag=_defrag(mid=False))
+    problems = cb.check_defrag([("SOAK_r17.json", art)])
+    assert len(problems) == 1 and "mid-migration" in problems[0]
+    art = dict(_soak(), defrag=_defrag(recovered=0))
+    problems = cb.check_defrag([("SOAK_r17.json", art)])
+    assert len(problems) == 1 and "requeued" in problems[0]
+
+
+def test_defrag_clean_passes():
+    art = dict(_soak(), defrag=_defrag())
+    assert cb.check_defrag([("SOAK_r17.json", art)]) == []

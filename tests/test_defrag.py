@@ -1,0 +1,381 @@
+"""Continuous defragmentation (ISSUE 17): the planner and its cost-model
+gates, the PDB interlock, the crash-safe two-phase execute/settle
+protocol, the restart reconciler's migration arms, the verifier's
+``defrag`` reconciliation kind, and the bind monitor's migration-window
+referee — all over a real MemStore, host-fallback probe (no device)."""
+
+from __future__ import annotations
+
+import json
+import time
+
+from kubernetes_tpu.api import types as api
+from kubernetes_tpu.apiserver.memstore import MemStore
+from kubernetes_tpu.cache.verifier import Verifier
+from kubernetes_tpu.chaos.bindmonitor import BindMonitor
+from kubernetes_tpu.client import cas_update
+from kubernetes_tpu.engine.generic_scheduler import GenericScheduler
+from kubernetes_tpu.scheduler import recovery
+from kubernetes_tpu.scheduler.binder import InMemoryBinder
+from kubernetes_tpu.scheduler.defrag import DefragController
+from kubernetes_tpu.scheduler.factory import MemStoreBinder
+from kubernetes_tpu.scheduler.scheduler import Scheduler, SchedulerConfig
+
+from helpers import make_node, make_pod
+
+INTENT = api.DEFRAG_MIGRATION_ANNOTATION_KEY
+
+
+def _node_json(name: str, cpu: str = "1") -> dict:
+    return {"metadata": {"name": name,
+                         "labels": {"kubernetes.io/hostname": name}},
+            "status": {"allocatable": {"cpu": cpu, "memory": "64Gi",
+                                       "pods": "110"},
+                       "conditions": [{"type": "Ready",
+                                       "status": "True"}]}}
+
+
+def _pod_json(name: str, cpu: str = "300m", node: str = "",
+              labels: dict | None = None,
+              annotations: dict | None = None) -> dict:
+    d: dict = {"metadata": {"name": name, "namespace": "default"},
+               "spec": {"containers": [{
+                   "name": "c",
+                   "resources": {"requests": {"cpu": cpu}}}]}}
+    if labels:
+        d["metadata"]["labels"] = dict(labels)
+    if annotations:
+        d["metadata"]["annotations"] = dict(annotations)
+    if node:
+        d["spec"]["nodeName"] = node
+    return d
+
+
+class _SpyVerifier:
+    def __init__(self):
+        self.noted: list[str] = []
+
+    def note_defrag(self, keys) -> None:
+        self.noted.extend(keys)
+
+
+def _rig(n_nodes: int = 2, smalls_per_node: int = 2,
+         labels: dict | None = None, gang: str | None = None):
+    """The canonical fragmented fleet: 1-cpu nodes each 2x300m full
+    (400m free), so a pending 600m pod fits nowhere whole but one
+    300m migration unblocks it."""
+    store = MemStore()
+    algo = GenericScheduler()
+    for i in range(n_nodes):
+        store.create("nodes", _node_json(f"n{i}"))
+        algo.cache.add_node(make_node(f"n{i}", milli_cpu=1000))
+    ann = {api.GANG_ANNOTATION_KEY: gang} if gang else None
+    for i in range(n_nodes):
+        for j in range(smalls_per_node):
+            name = f"s{i}-{j}"
+            store.create("pods", _pod_json(name, node=f"n{i}",
+                                           labels=labels,
+                                           annotations=ann))
+            p = make_pod(name, cpu="300m", node_name=f"n{i}",
+                         labels=labels)
+            if gang:
+                p.annotations[api.GANG_ANNOTATION_KEY] = gang
+            algo.cache.add_pod(p)
+    store.create("pods", _pod_json("big", cpu="600m"))
+    daemon = Scheduler(SchedulerConfig(algorithm=algo,
+                                       binder=MemStoreBinder(store),
+                                       async_bind=False))
+    return store, daemon
+
+
+class TestPlanAndExecute:
+    def test_round_migrates_a_victim_and_enqueues_the_anchor(self):
+        store, daemon = _rig()
+        spy = _SpyVerifier()
+        ctrl = DefragController(daemon, store, verifier=spy)
+        rep = ctrl.run_once()
+        assert rep["blocked"] == 1
+        assert rep["executed"] == 1
+        assert ctrl.stats["migrations_executed"] == 1
+        # Exactly one small evicted to pending, carrying the phase-1
+        # intent record naming its source node.
+        evicted = [o for o in store.list("pods")[0]
+                   if not (o.get("spec") or {}).get("nodeName")
+                   and o["metadata"]["name"] != "big"]
+        assert len(evicted) == 1
+        intent = json.loads(
+            evicted[0]["metadata"]["annotations"][INTENT])
+        assert intent["from"] in ("n0", "n1")
+        vkey = api.key_from_json(evicted[0])
+        assert ctrl.report()["inflight"] == 1
+        # The eviction dropped the cache attachment (capacity freed).
+        assert daemon.config.algorithm.cache.get_pod(vkey) is None
+        # The anchor was eagerly requeued so it races to the freed
+        # space instead of rotting in the backoff heap.
+        assert "default/big" in daemon.queue
+
+    def test_settle_clears_intent_and_credits_unblocked(self):
+        store, daemon = _rig()
+        spy = _SpyVerifier()
+        ctrl = DefragController(daemon, store, verifier=spy)
+        ctrl.run_once()
+        evicted = next(o for o in store.list("pods")[0]
+                       if not (o.get("spec") or {}).get("nodeName")
+                       and o["metadata"]["name"] != "big")
+        vname = evicted["metadata"]["name"]
+        vkey = api.key_from_json(evicted)
+        # The ordinary drain rebinds the migrant and the anchor.
+        store.bind("default", vname, "n1")
+        store.bind("default", "big", "n0")
+        ctrl.run_once()
+        assert ctrl.stats["migrations_completed"] == 1
+        assert ctrl.report()["inflight"] == 0
+        # Phase-1 state retired: no intent annotation anywhere.
+        assert not any(
+            INTENT in ((o.get("metadata") or {}).get("annotations")
+                       or {}) for o in store.list("pods")[0])
+        # The settled migrant armed the verifier's defrag kind, and the
+        # previously-blocked anchor was credited as unblocked.
+        assert spy.noted == [vkey]
+        assert ctrl.stats["unblocked"] == 1
+
+    def test_settle_reenqueues_a_still_pending_migrant(self):
+        """A lost watch delivery must never strand a migrant: the settle
+        cadence re-offers it to the queue until it lands."""
+        store, daemon = _rig()
+        ctrl = DefragController(daemon, store)
+        ctrl.run_once()
+        evicted = next(o for o in store.list("pods")[0]
+                       if not (o.get("spec") or {}).get("nodeName")
+                       and o["metadata"]["name"] != "big")
+        vkey = api.key_from_json(evicted)
+        daemon.queue.delete(vkey)  # simulate the lost delivery
+        ctrl.run_once()
+        assert vkey in daemon.queue
+
+    def test_gang_members_are_never_victims(self, monkeypatch):
+        store, daemon = _rig(gang="g0")
+        ctrl = DefragController(daemon, store)
+        rep = ctrl.run_once()
+        assert rep["blocked"] == 1 and rep["executed"] == 0
+        assert ctrl.stats["migrations_executed"] == 0
+        assert all((o.get("spec") or {}).get("nodeName")
+                   for o in store.list("pods")[0]
+                   if o["metadata"]["name"] != "big")
+
+
+class TestGates:
+    def test_min_gain_vetoes_the_batch(self, monkeypatch):
+        monkeypatch.setenv("KT_DEFRAG_MIN_GAIN", "2.0")
+        store, daemon = _rig()
+        ctrl = DefragController(daemon, store)
+        rep = ctrl.run_once()
+        assert rep["veto"] == "vetoed_budget"
+        assert rep["executed"] == 0
+        assert ctrl.stats["vetoed_budget"] == 1
+
+    def test_inflight_budget_vetoes_the_batch(self, monkeypatch):
+        monkeypatch.setenv("KT_DEFRAG_BUDGET", "0")
+        store, daemon = _rig()
+        ctrl = DefragController(daemon, store)
+        rep = ctrl.run_once()
+        assert rep["veto"] == "vetoed_budget" and rep["executed"] == 0
+
+    def test_max_migrations_trims_whole_subplans(self, monkeypatch):
+        monkeypatch.setenv("KT_DEFRAG_MAX_MIGRATIONS", "0")
+        store, daemon = _rig()
+        ctrl = DefragController(daemon, store)
+        rep = ctrl.run_once()
+        assert rep["executed"] == 0
+        assert ctrl.stats["migrations_executed"] == 0
+
+
+class TestPDBInterlock:
+    def test_exhausted_budget_makes_victims_immovable(self):
+        store, daemon = _rig(labels={"app": "prot"})
+        store.create("poddisruptionbudgets", {
+            "metadata": {"name": "pdb", "namespace": "default"},
+            "spec": {"minAvailable": 4,
+                     "selector": {"app": "prot"}},
+            "status": {"disruptionAllowed": False,
+                       "currentHealthy": 4, "desiredHealthy": 4,
+                       "expectedPods": 4}})
+        ctrl = DefragController(daemon, store)
+        rep = ctrl.run_once()
+        assert rep["executed"] == 0
+        assert ctrl.stats["vetoed_pdb"] >= 1
+        assert all((o.get("spec") or {}).get("nodeName")
+                   for o in store.list("pods")[0]
+                   if o["metadata"]["name"] != "big")
+
+    def test_headroom_is_consumed_not_reread(self):
+        """One batch can never spend a PDB's headroom twice: the guard
+        closure decrements per allowed eviction."""
+        store, daemon = _rig(labels={"app": "prot"})
+        store.create("poddisruptionbudgets", {
+            "metadata": {"name": "pdb", "namespace": "default"},
+            "spec": {"minAvailable": 3,
+                     "selector": {"app": "prot"}},
+            "status": {"disruptionAllowed": True,
+                       "currentHealthy": 4, "desiredHealthy": 3,
+                       "expectedPods": 4}})
+        ctrl = DefragController(daemon, store)
+        veto = ctrl._pdb_guard()
+        prot = _pod_json("x", labels={"app": "prot"})
+        assert veto(prot) is False   # headroom 1: first eviction ok
+        assert veto(prot) is True    # spent: second is vetoed
+        assert veto(_pod_json("y")) is False  # unmatched pods never veto
+
+    def test_unpublished_status_vetoes_conservatively(self):
+        store, daemon = _rig(labels={"app": "prot"})
+        store.create("poddisruptionbudgets", {
+            "metadata": {"name": "pdb", "namespace": "default"},
+            "spec": {"minAvailable": 1, "selector": {"app": "prot"}}})
+        ctrl = DefragController(daemon, store)
+        assert ctrl._pdb_guard()(
+            _pod_json("x", labels={"app": "prot"})) is True
+
+
+class TestCrashRecovery:
+    def test_unbound_migrant_requeues_and_clears_intent(self):
+        """SIGKILL between the evict and the re-bind: the restarted
+        incarnation's reconcile requeues the pending migrant and clears
+        the phase-1 intent — never a stranded pod."""
+        store, daemon = _rig()
+        ctrl = DefragController(daemon, store)
+        ctrl.run_once()
+        evicted = next(o for o in store.list("pods")[0]
+                       if not (o.get("spec") or {}).get("nodeName")
+                       and o["metadata"]["name"] != "big")
+        vkey = api.key_from_json(evicted)
+        # A fresh incarnation: empty cache, empty queue.
+        algo = GenericScheduler()
+        d2 = Scheduler(SchedulerConfig(algorithm=algo,
+                                       binder=InMemoryBinder(),
+                                       async_bind=False))
+        report = recovery.reconcile(d2, store)
+        assert report["migrations_recovered"] == 1
+        assert vkey in d2.queue
+        obj = store.get("pods", vkey)
+        assert INTENT not in ((obj.get("metadata") or {})
+                              .get("annotations") or {})
+
+    def test_bound_pod_with_stale_intent_is_cleared(self):
+        """SIGKILL after the intent stamp but before the evict (or after
+        the rebind, before settle): the pod is bound, so reconcile just
+        clears the stale intent and re-adopts it."""
+        store = MemStore()
+        store.create("nodes", _node_json("n0"))
+        store.create("pods", _pod_json(
+            "p0", node="n0",
+            annotations={INTENT: json.dumps({"from": "n0",
+                                             "round": 3})}))
+        d = Scheduler(SchedulerConfig(algorithm=GenericScheduler(),
+                                      binder=InMemoryBinder(),
+                                      async_bind=False))
+        report = recovery.reconcile(d, store)
+        assert report["migration_intents_cleared"] == 1
+        assert report["readopted"] == 1
+        obj = store.get("pods", "default/p0")
+        assert INTENT not in ((obj.get("metadata") or {})
+                              .get("annotations") or {})
+        assert "default/p0" not in d.queue
+
+
+class TestVerifierDefragKind:
+    def test_injected_stale_row_is_flagged_as_defrag_kind(self):
+        """A settled migrant whose cache attachment disagrees with
+        apiserver truth must surface under the ``defrag`` kind — the
+        migration-settle integrity signal, separate from steady-state
+        drift."""
+        store = MemStore()
+        store.create("nodes", _node_json("n0"))
+        store.create("nodes", _node_json("n1"))
+        store.create("pods", _pod_json("m0", node="n1"))
+        algo = GenericScheduler()
+        algo.cache.add_node(make_node("n0", milli_cpu=1000))
+        algo.cache.add_node(make_node("n1", milli_cpu=1000))
+        # Inject the stale row: truth says n1, the cache tracks n0.
+        algo.cache.add_pod(make_pod("m0", cpu="300m", node_name="n0"))
+        v = Verifier(algo.cache,
+                     truth=lambda: store.list("pods")[0],
+                     heal=False, grace_s=0.01)
+        # Nothing armed: the drift shows as ordinary apiserver drift,
+        # never as the defrag kind.
+        assert not any(x.kind == "defrag" for x in v.verify_once())
+        v.note_defrag(["default/m0"])
+        violations = v.verify_once()
+        assert any(x.kind == "defrag" and "default/m0" in x.detail
+                   for x in violations)
+        # The armed set is one-shot: the next pass carries no defrag
+        # rows again.
+        assert not any(x.kind == "defrag" for x in v.verify_once())
+
+    def test_settled_migrant_matching_truth_is_clean(self):
+        store = MemStore()
+        store.create("nodes", _node_json("n0"))
+        store.create("pods", _pod_json("m0", node="n0"))
+        algo = GenericScheduler()
+        algo.cache.add_node(make_node("n0", milli_cpu=1000))
+        algo.cache.add_pod(make_pod("m0", cpu="300m", node_name="n0"))
+        v = Verifier(algo.cache,
+                     truth=lambda: store.list("pods")[0],
+                     heal=False, grace_s=0.01)
+        v.note_defrag(["default/m0"])
+        assert v.verify_once() == []
+
+
+class TestBindMonitorMigrationWindow:
+    def _wait(self, cond, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            time.sleep(0.02)
+        raise AssertionError("monitor never observed the transition")
+
+    def test_clean_migration_window_opens_and_closes(self):
+        store = MemStore()
+        mon = BindMonitor(store)
+        try:
+            store.create("pods", _pod_json("mw0", node="n0"))
+            self._wait(lambda: mon.binds == 1)
+            # Evict-to-pending with the intent: the window opens.
+            obj = store.get("pods", "default/mw0")
+            obj["metadata"].setdefault("annotations", {})[INTENT] = \
+                json.dumps({"from": "n0", "round": 1})
+            obj["spec"]["nodeName"] = ""
+            cas_update(store, "pods", obj)
+            self._wait(lambda: mon.migrations_started == 1)
+            store.bind("default", "mw0", "n1")
+            self._wait(lambda: mon.migrations_completed == 1)
+            assert mon.double_capacity == 0 and mon.double_binds == 0
+            mon.assert_clean()
+        finally:
+            mon.stop()
+
+    def test_skipped_pending_hop_is_double_capacity(self):
+        """A migrating pod observed node -> node with no pending hop was
+        counted as capacity on two nodes at once — the invariant the
+        two-phase evict exists to prevent."""
+        store = MemStore()
+        mon = BindMonitor(store)
+        try:
+            store.create("pods", _pod_json("mw1", node="n0"))
+            self._wait(lambda: mon.binds == 1)
+            obj = store.get("pods", "default/mw1")
+            obj["metadata"].setdefault("annotations", {})[INTENT] = \
+                json.dumps({"from": "n0", "round": 1})
+            obj["spec"]["nodeName"] = "n1"  # teleport: no pending hop
+            cas_update(store, "pods", obj)
+            self._wait(lambda: mon.double_capacity == 1)
+            assert mon.double_binds == 1
+            try:
+                mon.assert_clean()
+            except AssertionError:
+                pass
+            else:
+                raise AssertionError("assert_clean missed the "
+                                     "double-capacity window")
+        finally:
+            mon.stop()
