@@ -398,6 +398,10 @@ def main(argv=None) -> None:
         # (any post-prewarm compile, or >15% bytes-per-pod growth,
         # fails tier-1).
         "device": result.device,
+        # kt-prof attribution (best run): component CPU split +
+        # unclassified fraction over the timed window — ratcheted by
+        # tools/check_bench.check_profile.
+        "profile": result.profile,
     }
     if cold_vs_warm is not None:
         out["cold_vs_warm"] = cold_vs_warm
@@ -449,6 +453,10 @@ def main(argv=None) -> None:
             # Pre-clock warm attribution: pre-intern wall + prewarm's
             # per-signature cache hit/miss/seconds audit.
             "warm_breakdown": wire.warm_breakdown,
+            # kt-prof over the wire window: decode/handler µs per event
+            # (daemon side) + serialize µs per op (apiserver scrape) —
+            # the per-event costs check_bench.check_profile ratchets.
+            "profile": wire.profile,
         }
     if serving is not None:
         trickle = serving["workloads"]["poisson_trickle"]

@@ -43,7 +43,14 @@ from kubernetes_tpu.apiserver.validation import (AdmissionError,
                                                  store_admission)
 from kubernetes_tpu.utils import trace as trace_mod
 from kubernetes_tpu.utils.metrics import (APISERVER_REQUEST_LATENCY,
+                                          APISERVER_SERIALIZE_OPS,
+                                          APISERVER_SERIALIZE_SECONDS,
                                           expose_registry)
+
+# Watch-stream serialize accounting children, resolved once — the
+# stream loop flushes per coalesced batch, not per event.
+_WATCH_SER_S = APISERVER_SERIALIZE_SECONDS.labels(verb="WATCH")
+_WATCH_SER_OPS = APISERVER_SERIALIZE_OPS.labels(verb="WATCH")
 
 # Idle watch streams carry a blank heartbeat chunk this often so clients'
 # read deadlines only fire on genuinely dead sockets.
@@ -239,9 +246,18 @@ def make_handler(store: MemStore, auth=None, admission_control=None,
                 except (BrokenPipeError, ConnectionResetError):
                     return
 
+        # Per-request serialize accounting (kt-prof wire attribution):
+        # _send_json accumulates dumps() nanoseconds here; _dispatch
+        # flushes the sum under the request's verb in its finally.
+        _ser_ns = 0
+        _ser_ops = 0
+
         def _send_json(self, code: int, obj, retry_after=None) -> None:
-            self._send_raw(code, json.dumps(obj).encode(),
-                           "application/json", retry_after)
+            t0 = time.perf_counter_ns()
+            body = json.dumps(obj).encode()
+            self._ser_ns += time.perf_counter_ns() - t0
+            self._ser_ops += 1
+            self._send_raw(code, body, "application/json", retry_after)
 
         def _send_raw(self, code: int, body: bytes, ctype: str,
                       retry_after=None) -> None:
@@ -323,6 +339,12 @@ def make_handler(store: MemStore, auth=None, admission_control=None,
                 APISERVER_REQUEST_LATENCY.labels(
                     verb=verb, resource=resource,
                     code=str(self._code)).observe(dur * 1e6)
+                if self._ser_ns:
+                    APISERVER_SERIALIZE_SECONDS.labels(verb=verb).inc(
+                        self._ser_ns / 1e9)
+                    APISERVER_SERIALIZE_OPS.labels(verb=verb).inc(
+                        self._ser_ops)
+                    self._ser_ns = self._ser_ops = 0
                 trace_mod.record_server_span(
                     "apiserver.request", traceparent, dur,
                     verb=verb, resource=resource, code=self._code)
@@ -390,6 +412,19 @@ def make_handler(store: MemStore, auth=None, admission_control=None,
                 from kubernetes_tpu.utils import telemetry
                 self._send_raw(200, telemetry.dashboard_html().encode(),
                                "text/html; charset=utf-8")
+                return True
+            if parts == ["debug", "profile"]:
+                # kt-prof continuous CPU profile; disabled (KT_PROF=0)
+                # is a client-visible 404, never a 500.
+                from kubernetes_tpu.utils import profiler
+                resolved = profiler.render(query)
+                if resolved is None:
+                    self._send_raw(404,
+                                   b"profiling disabled (KT_PROF=0)",
+                                   "text/plain")
+                else:
+                    body, ctype = resolved
+                    self._send_raw(200, body, ctype)
                 return True
             if parts == ["debug", "vars"]:
                 # Live flow-control state (the scheduler's /debug/vars
@@ -484,12 +519,15 @@ def make_handler(store: MemStore, auth=None, admission_control=None,
                         if nxt is None:
                             break
                         batch.append(nxt)
+                    t0 = time.perf_counter_ns()
                     if frames:
                         body = b'{"items":[' + b",".join(
                             e.wire_json() for e in batch) + b"]}"
                         payload = b"=%d\n%s\n" % (len(body), body)
                     else:
                         payload = b"".join(e.wire_line() for e in batch)
+                    _WATCH_SER_S.inc((time.perf_counter_ns() - t0) / 1e9)
+                    _WATCH_SER_OPS.inc(len(batch))
                     self.wfile.write(f"{len(payload):x}\r\n".encode()
                                      + payload + b"\r\n")
                     self.wfile.flush()
@@ -776,8 +814,11 @@ def serve(store: MemStore, port: int = 0,
     bearer tokens."""
     # The apiserver self-scrapes like every other daemon: its request-
     # latency registry lands in the ring /debug/timeseries serves.
-    from kubernetes_tpu.utils import telemetry
+    from kubernetes_tpu.utils import profiler, telemetry
     telemetry.ensure_started()
+    # kt-prof sampling starts with the daemon (one branch when KT_PROF=0)
+    # so /debug/profile covers the server's whole life.
+    profiler.ensure_started()
     # Priority-level flow control, knobs read once here (never per
     # request); pass an explicit FlowController to override caps in
     # tests/rigs.

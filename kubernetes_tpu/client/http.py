@@ -585,6 +585,12 @@ class HTTPWatcher:
         self.kind = kind
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
         self._stopped = threading.Event()
+        # Wire-attribution children resolved once per stream: the pump
+        # flushes decode time per read chunk, never per event.
+        from kubernetes_tpu.utils.metrics import (WATCH_DECODE_EVENTS,
+                                                  WATCH_DECODE_SECONDS)
+        self._m_decode_s = WATCH_DECODE_SECONDS.labels(kind=kind)
+        self._m_decode_n = WATCH_DECODE_EVENTS.labels(kind=kind)
         headers = {"Authorization": f"Bearer {token}"} if token else {}
         parsed = urllib.parse.urlsplit(url)
         # The timeout is the per-read socket deadline, not a stream
@@ -623,8 +629,12 @@ class HTTPWatcher:
         try:
             q_put = self._q.put
             kind = self.kind
+            m_decode_s, m_decode_n = self._m_decode_s, self._m_decode_n
+            perf_ns = time.perf_counter_ns
+            n_emitted = 0
 
             def emit(d: dict) -> None:
+                nonlocal n_emitted
                 obj = d.get("object") or {}
                 meta = obj.get("metadata") or {}
                 ns = meta.get("namespace")
@@ -634,6 +644,7 @@ class HTTPWatcher:
                     type=d.get("type", ""), kind=kind, key=key or "",
                     object=obj,
                     rv=int(meta.get("resourceVersion", "0") or "0")))
+                n_emitted += 1
 
             buf = bytearray()
             while True:
@@ -641,6 +652,11 @@ class HTTPWatcher:
                 if not chunk or self._stopped.is_set():
                     break
                 buf += chunk
+                # Per-CHUNK decode accounting (kt-prof wire attribution):
+                # one clock read pair + at most two counter updates per
+                # read1 chunk, amortized across every event it carried.
+                t_chunk = perf_ns()
+                n_before = n_emitted
                 start = 0
                 while True:
                     # Framed batch: '=<len>\n' then exactly len bytes of
@@ -675,6 +691,9 @@ class HTTPWatcher:
                     emit(json.loads(line))
                 if start:
                     del buf[:start]
+                m_decode_s.inc((perf_ns() - t_chunk) / 1e9)
+                if n_emitted != n_before:
+                    m_decode_n.inc(n_emitted - n_before)
         except Exception:  # noqa: BLE001 — stream died: deliver EOF
             pass
         finally:

@@ -73,6 +73,14 @@ class Reflector:
         self._stop = threading.Event()
         self._synced = threading.Event()
         self._known: dict[str, dict] = {}  # key -> last delivered object
+        # Dispatch accounting children resolved once (kt-prof wire
+        # attribution): handler nanoseconds accumulate locally and flush
+        # per batch — relist delivery, idle tick, or every
+        # _DISPATCH_FLUSH_EVERY events — never per event.
+        self._m_handler_s = metrics.HANDLER_SECONDS.labels(handler=kind)
+        self._m_handler_n = metrics.HANDLER_EVENTS.labels(handler=kind)
+
+    _DISPATCH_FLUSH_EVERY = 256
 
     # Back-compat alias (round-1 callers constructed with store=).
     @property
@@ -105,13 +113,21 @@ class Reflector:
                 self.kind, self.selector,
                 field_selector=self.field_selector)
         fresh = {MemStore.object_key(obj): obj for obj in items}
+        t0 = time.perf_counter_ns()
+        n = 0
         for key, obj in list(self._known.items()):
             if key not in fresh:
                 self.handler("DELETED", obj)
                 del self._known[key]
+                n += 1
         for key, obj in fresh.items():
             self.handler("ADDED", obj)
             self._known[key] = obj
+            n += 1
+        # One flush for the whole relist delivery.
+        self._m_handler_s.inc((time.perf_counter_ns() - t0) / 1e9)
+        if n:
+            self._m_handler_n.inc(n)
         self._synced.set()
         return rv
 
@@ -144,10 +160,25 @@ class Reflector:
                     backoff = min(backoff * 2, RELIST_BACKOFF_MAX)
                     continue
                 stream_started = time.monotonic()
+                # Handler nanoseconds accumulate here and flush per
+                # batch boundary (idle tick / flush threshold / stream
+                # end), so the steady-state event path pays two clock
+                # reads and no metric update.
+                acc_ns = acc_n = 0
+                perf_ns = time.perf_counter_ns
+
+                def flush():
+                    nonlocal acc_ns, acc_n
+                    if acc_n:
+                        self._m_handler_s.inc(acc_ns / 1e9)
+                        self._m_handler_n.inc(acc_n)
+                        acc_ns = acc_n = 0
+
                 try:
                     while not self._stop.is_set():
                         ev = watcher.next(timeout=0.1)
                         if ev is None:
+                            flush()
                             continue
                         if ev.type == "ERROR":
                             break  # stream died: relist (reflector.go:232)
@@ -158,11 +189,20 @@ class Reflector:
                             # a delete so stores drop it (the fielded watch
                             # the reference gets server-side).
                             self._known.pop(ev.key, None)
+                            t0 = perf_ns()
                             self.handler("DELETED", ev.object)
+                            acc_ns += perf_ns() - t0
+                            acc_n += 1
                             continue
                         self._known[ev.key] = ev.object
+                        t0 = perf_ns()
                         self.handler(ev.type, ev.object)
+                        acc_ns += perf_ns() - t0
+                        acc_n += 1
+                        if acc_n >= self._DISPATCH_FLUSH_EVERY:
+                            flush()
                 finally:
+                    flush()
                     watcher.stop()
                 # Reset the backoff only when the stream actually lived:
                 # list + watch-open + a healthy stream means the server

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 import time
 from dataclasses import dataclass
@@ -47,6 +48,10 @@ class DensityResult:
     # measured window (timed drain + waves) — the columns the BENCH
     # artifact carries and tools/check_bench.py ratchets.
     device: dict = None
+    # kt-prof attribution over the timed window: per-component CPU
+    # split, unclassified fraction, and per-event wire accounting
+    # (profile_section) — the section check_bench.check_profile ratchets.
+    profile: dict = None
 
 
 def _stage_snapshot() -> dict:
@@ -69,6 +74,103 @@ def stage_breakdown(before: dict, after: dict) -> dict:
             out[name] = {"seconds": round((s1 - s0) / 1e6, 6),
                          "count": n1 - n0}
     return out
+
+
+# One regex scrapes BOTH apiservers (Python and native C++): each
+# renders Prometheus text with identical serialize family names.
+_SER_ROW = re.compile(
+    rb'^apiserver_serialize_(seconds|ops)_total\{verb="[A-Z]+"\}'
+    rb'\s+([0-9.eE+-]+)', re.M)
+
+
+def _scrape_serialize(port: int) -> tuple[float, float]:
+    """Total serialize (seconds, ops) across verbs from an apiserver
+    subprocess's /metrics — the one wire-accounting counter that lives on
+    the far side of the process boundary in the wire rig."""
+    import http.client
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        c.request("GET", "/metrics")
+        body = c.getresponse().read()
+        c.close()
+    except OSError:
+        return 0.0, 0.0
+    sec = ops = 0.0
+    for kind, val in _SER_ROW.findall(body):
+        if kind == b"seconds":
+            sec += float(val)
+        else:
+            ops += float(val)
+    return sec, ops
+
+
+def _profile_snapshot(serialize_port: int = None) -> dict:
+    """Cumulative kt-prof + wire-accounting state; the harness diffs two
+    of these around a timed window (profile_section).  Forces one sampler
+    tick so the window's edges carry fresh per-thread CPU baselines."""
+    from kubernetes_tpu.utils import metrics as m
+    from kubernetes_tpu.utils import profiler
+    prof = profiler.ensure_started()
+    if prof is not None:
+        prof.sample_once()
+
+    def total(counter):
+        return sum(child.value for child in counter.children().values())
+
+    snap = {
+        "cpu": prof.snapshot() if prof is not None else None,
+        "decode_s": total(m.WATCH_DECODE_SECONDS),
+        "decode_n": total(m.WATCH_DECODE_EVENTS),
+        "handler_s": total(m.HANDLER_SECONDS),
+        "handler_n": total(m.HANDLER_EVENTS),
+    }
+    if serialize_port is not None:
+        snap["ser_s"], snap["ser_n"] = _scrape_serialize(serialize_port)
+    else:
+        snap["ser_s"] = total(m.APISERVER_SERIALIZE_SECONDS)
+        snap["ser_n"] = total(m.APISERVER_SERIALIZE_OPS)
+    return snap
+
+
+def profile_section(before: dict, after: dict, wall_s: float) -> dict:
+    """The BENCH artifact's ``profile`` section: where the window's CPU
+    went (kt-prof component split + unclassified fraction) and what each
+    wire event cost (decode/handler µs per event, serialize µs per op).
+    ``check_bench.check_profile`` ratchets the per-event costs and holds
+    the unclassified fraction under its bar."""
+    from kubernetes_tpu.utils import profiler
+    sec: dict = {"wall_s": round(wall_s, 3)}
+    b_cpu, a_cpu = before.get("cpu"), after.get("cpu")
+    if b_cpu is not None and a_cpu is not None:
+        delta = {c: max(0.0, a_cpu["cpu_seconds"][c]
+                        - b_cpu["cpu_seconds"][c])
+                 for c in profiler.COMPONENTS}
+        total = sum(delta.values())
+        sec["enabled"] = True
+        sec["samples"] = a_cpu["samples"] - b_cpu["samples"]
+        sec["cpu_seconds"] = {c: round(v, 4)
+                              for c, v in delta.items() if v > 0}
+        if total > 0:
+            sec["cpu_fraction"] = {c: round(v / total, 4)
+                                   for c, v in delta.items() if v > 0}
+            sec["unclassified_fraction"] = round(delta["other"] / total, 4)
+        sec["sampler_self_cpu_s"] = round(
+            a_cpu["sampler_self_cpu_s"] - b_cpu["sampler_self_cpu_s"], 4)
+    else:
+        sec["enabled"] = False
+    wire: dict = {}
+    for name, skey, nkey, per in (
+            ("decode", "decode_s", "decode_n", "us_per_event"),
+            ("handler", "handler_s", "handler_n", "us_per_event"),
+            ("serialize", "ser_s", "ser_n", "us_per_op")):
+        d_s = after.get(skey, 0.0) - before.get(skey, 0.0)
+        d_n = after.get(nkey, 0) - before.get(nkey, 0)
+        if d_n > 0:
+            wire[name] = {"seconds": round(d_s, 6), "events": int(d_n),
+                          per: round(d_s / d_n * 1e6, 3)}
+    if wire:
+        sec["wire"] = wire
+    return sec
 
 
 def _make_daemon(num_nodes: int, profile: str = "uniform",
@@ -168,6 +270,8 @@ def density(num_nodes: int, num_pods: int, profile: str = "uniform",
     for pod in pods:
         daemon.enqueue(pod)
     stages_before = _stage_snapshot()
+    prof_before = _profile_snapshot()
+    t_prof = time.perf_counter()
     with devicestats.watchdog_window() as compiles:
         start = time.perf_counter()
         popped = daemon.schedule_pending(wait_first=False)
@@ -185,6 +289,10 @@ def density(num_nodes: int, num_pods: int, profile: str = "uniform",
     device["sanity_rejected_binds"] = \
         int(metrics_mod.GATE_REJECTED_BINDS.value)
     stages = stage_breakdown(stages_before, _stage_snapshot())
+    # Profile window = timed drain + steady waves (the same span the
+    # device columns cover); in-process rig, so serialize stays local.
+    profile_sec = profile_section(prof_before, _profile_snapshot(),
+                                  time.perf_counter() - t_prof)
     scheduled = daemon.config.binder.count() - device.pop("_steady_bound")
     if not quiet:
         print(f"density {num_nodes} nodes x {num_pods} pods: "
@@ -195,7 +303,7 @@ def density(num_nodes: int, num_pods: int, profile: str = "uniform",
         num_nodes=num_nodes, num_pods=num_pods, elapsed_s=elapsed,
         scheduled=scheduled, pods_per_second=scheduled / elapsed,
         algorithm_ms_per_pod=elapsed / max(scheduled, 1) * 1e3,
-        stages=stages, warm_s=warm_s, device=device)
+        stages=stages, warm_s=warm_s, device=device, profile=profile_sec)
 
 
 def _steady_state_device_window(daemon, wave_pods: list, wave_n: int,
@@ -295,6 +403,11 @@ class WireDensityResult:
     # bucket runs a 2x30720-step scan), not cache-dodging compiles; the
     # hit/miss counters pin that attribution.
     warm_breakdown: dict = None
+    # kt-prof attribution over the wire window: component CPU split plus
+    # decode/handler µs per event (daemon side) and serialize µs per op
+    # (scraped from the apiserver subprocess's /metrics — works for the
+    # Python and the native C++ server identically).
+    profile: dict = None
 
 
 def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
@@ -471,6 +584,7 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
                     for i in range(0, len(pod_jsons), 1000)]
 
         stages_before = _stage_snapshot()
+        prof_before = _profile_snapshot(serialize_port=port)
         start = time.perf_counter()
         # Each creator thread POSTs batch Lists of ~1000 pods — the
         # makePodsFromRC 30-way-parallel shape (util.go:85-170) with the
@@ -544,6 +658,10 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
         # detection — the tail is idle requeue time of unschedulable pods.
         elapsed = (last_change if stalled else time.perf_counter()) - start
         bound = factory.daemon.config.metrics.binding_latency.count
+        # Profile edge BEFORE tearing the rig down: the serialize side
+        # lives in the apiserver subprocess and dies with it.
+        profile_sec = profile_section(
+            prof_before, _profile_snapshot(serialize_port=port), elapsed)
         if bound == 0:
             # A zero-bound run is a rig fault, never a sample: fail the
             # run loudly instead of returning 0.0 pods/s for a median
@@ -565,7 +683,7 @@ def density_wire(num_nodes: int, num_pods: int, profile: str = "uniform",
             pods_per_second=int(bound) / max(elapsed, 1e-9),
             create_s=create_s, warm_s=warm_s, timeline=timeline,
             stages=stage_breakdown(stages_before, _stage_snapshot()),
-            warm_breakdown=warm_breakdown)
+            warm_breakdown=warm_breakdown, profile=profile_sec)
     finally:
         # Stop the daemon's reflector/scheduler threads on EVERY exit path
         # (left running they'd relist-spin against the dead apiserver).

@@ -2183,9 +2183,18 @@ def collect(ha: bool = True, **kw) -> dict:
     HA wave appended as the artifact's ``ha`` section
     (``BENCH_SOAK_HA=0`` skips it)."""
     from kubernetes_tpu.engine import devicestats
+    from kubernetes_tpu.perf import harness
     before = devicestats.transfer_snapshot()
+    prof_before = harness._profile_snapshot()
+    t_prof = time.perf_counter()
     rec = run_soak(**kw)
     after = devicestats.transfer_snapshot()
+    # kt-prof over the churn run: the soak is the one window where
+    # watch decode + handler dispatch run for minutes, so its per-event
+    # costs are the highest-signal wire sample the artifacts carry.
+    rec["profile"] = harness.profile_section(
+        prof_before, harness._profile_snapshot(),
+        time.perf_counter() - t_prof)
     delta = {c: after[c] - before[c] for c in after}
     pods = (rec.get("scale") or {}).get("pods_scheduled_total") or 1
     rec["device"] = {

@@ -171,6 +171,10 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
     # dashboard's queue/stage/SLO sparklines have their sources.
     telemetry.ensure_started(
         factory.daemon.config.metrics.all_metrics())
+    # kt-prof rides the same lifecycle: sampling starts with the mux so
+    # the profile covers the daemon's whole life (KT_PROF=0 = no-op).
+    from kubernetes_tpu.utils import profiler
+    profiler.ensure_started()
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -215,6 +219,17 @@ def _status_mux(factory: ConfigFactory, configz: dict, port: int
                     return
                 from kubernetes_tpu.utils.profiling import thread_stacks
                 self._send(200, thread_stacks().encode())
+            elif path == "/debug/profile":
+                # kt-prof continuous CPU profile (speedscope JSON, or
+                # collapsed stacks via ?format=collapsed).  KT_PROF=0 is
+                # a client-visible state: 404, never 500.
+                from kubernetes_tpu.utils import profiler
+                resolved = profiler.render(query)
+                if resolved is None:
+                    self._send(404, b"profiling disabled (KT_PROF=0)")
+                else:
+                    body, ctype = resolved
+                    self._send(200, body, ctype)
             elif path == "/debug/traces":
                 # The span ring as Chrome trace-event JSON: load in
                 # Perfetto and the queue_wait -> snapshot -> compile ->

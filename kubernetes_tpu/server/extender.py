@@ -528,8 +528,10 @@ def serve(port: int = 12346, policy: Policy | None = None,
     core = ExtenderCore(policy)
     # Self-scrape ring behind /debug/timeseries + /debug/dashboard: the
     # extender's verb-latency metric set rides next to the registry.
-    from kubernetes_tpu.utils import telemetry
+    from kubernetes_tpu.utils import profiler, telemetry
     telemetry.ensure_started(core.metrics.all_metrics())
+    # kt-prof sampling starts with the daemon (no-op when KT_PROF=0).
+    profiler.ensure_started()
     server = ThreadingHTTPServer((host, port), make_handler(core))
     _freeze_baseline_heap()
     return server

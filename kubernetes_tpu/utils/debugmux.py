@@ -57,6 +57,14 @@ def common_route(path: str,
     if path.startswith("/debug/pprof"):
         from kubernetes_tpu.utils.profiling import thread_stacks
         return 200, thread_stacks().encode(), "text/plain"
+    if path == "/debug/profile":
+        from kubernetes_tpu.utils import profiler
+        resolved = profiler.render(query)
+        if resolved is None:
+            # Disabled is a client-visible state, not a server fault.
+            return 404, b"profiling disabled (KT_PROF=0)", "text/plain"
+        body, ctype = resolved
+        return 200, body, ctype
     return None
 
 
@@ -71,8 +79,11 @@ def serve_status_mux(port: int = 0, host: str = "127.0.0.1",
     extra = extra or {}
     # The self-scrape ring behind /debug/timeseries + /debug/dashboard
     # starts with the mux: a daemon that serves the routes also samples.
-    from kubernetes_tpu.utils import telemetry
+    from kubernetes_tpu.utils import profiler, telemetry
     telemetry.ensure_started()
+    # Same deal for the kt-prof sampler (one branch when KT_PROF=0):
+    # continuous profiling starts with the daemon, not the first scrape.
+    profiler.ensure_started()
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"

@@ -71,6 +71,16 @@ _knob("KT_TELEMETRY_RING", "720", "int",
       "Self-scrape time-series ring capacity in samples")
 _knob("KT_TELEMETRY_PERIOD", "5", "float",
       "Self-scrape cadence in seconds (0 = no sampler thread)")
+_knob("KT_PROF", "1", "bool",
+      "kt-prof continuous CPU profiler; 0 = off (one branch, no sampler "
+      "thread, /debug/profile answers 404)")
+_knob("KT_PROF_HZ", "19", "float",
+      "kt-prof max sample rate in Hz (off-beat default so the sampler "
+      "never phase-locks with periodic work; the loop self-paces below "
+      "this to keep sampler CPU under 2%)")
+_knob("KT_PROF_RING", "512", "int",
+      "kt-prof folded-stack table bound (distinct stacks; overflow CPU "
+      "folds into one ring-truncated bucket)")
 # -- engine / device ----------------------------------------------------
 _knob("KT_COMPILE_CACHE", "", "str",
       "Persistent XLA cache dir (empty = ~/.cache/kubernetes_tpu/xla; "

@@ -827,6 +827,53 @@ APISERVER_QUEUE_WAIT = register(Histogram(
     "queue before an inflight slot freed",
     exponential_buckets(100, 2, 15), labelnames=("level",)))
 
+# kt-prof CPU attribution plane (utils/profiler.py + the wire-accounting
+# sites in client/http.py, client/reflector.py, apiserver/server.py).
+# The seconds/events counter pairs are accumulated PER FRAME or PER
+# BATCH, never per event — µs/event is derived at read time (the bench
+# `profile` section and the check_profile ratchet), so the hot paths pay
+# one counter update per read1 chunk / dispatch batch.
+PROCESS_CPU_FRACTION = register(Gauge(
+    "process_cpu_fraction",
+    "Fraction of one core spent per control-plane component (kt-prof "
+    "sampler EWMA: per-thread CPU deltas attributed through sampled "
+    "stacks)",
+    labelnames=("component",)))
+PROCESS_THREAD_CPU = register(Counter(
+    "process_thread_cpu_seconds_total",
+    "Cumulative CPU seconds per thread role (instance suffixes "
+    "collapsed; label space bounded by the kt-prof sampler)",
+    labelnames=("thread",)))
+WATCH_DECODE_SECONDS = register(Counter(
+    "scheduler_watch_decode_seconds_total",
+    "CPU-clock seconds HTTPWatcher._pump spent decoding watch bytes "
+    "into events, accumulated per read chunk",
+    labelnames=("kind",)))
+WATCH_DECODE_EVENTS = register(Counter(
+    "scheduler_watch_decode_events_total",
+    "Watch events decoded by HTTPWatcher._pump (pairs with "
+    "scheduler_watch_decode_seconds_total for µs/event)",
+    labelnames=("kind",)))
+HANDLER_SECONDS = register(Counter(
+    "scheduler_handler_seconds_total",
+    "Seconds reflector event dispatch spent inside registered handlers, "
+    "accumulated per dispatch batch",
+    labelnames=("handler",)))
+HANDLER_EVENTS = register(Counter(
+    "scheduler_handler_events_total",
+    "Events dispatched to reflector handlers (pairs with "
+    "scheduler_handler_seconds_total for µs/event)",
+    labelnames=("handler",)))
+APISERVER_SERIALIZE_SECONDS = register(Counter(
+    "apiserver_serialize_seconds_total",
+    "Seconds the apiserver spent serializing response bodies, by verb "
+    "(the native server exports the same family from its own /metrics)",
+    labelnames=("verb",)))
+APISERVER_SERIALIZE_OPS = register(Counter(
+    "apiserver_serialize_ops_total",
+    "Response bodies serialized by the apiserver, by verb",
+    labelnames=("verb",)))
+
 
 class SchedulerMetrics:
     """The scheduler's metric set (metrics.go:31-55), microseconds, plus

@@ -234,6 +234,7 @@ const GROUPS = [
  ["Device transfers", /^scheduler_(device_transfer|post_prewarm_compiles)/],
  ["Decisions & binds", /^scheduler_(pod_scheduling_attempts|e2e_decision|bind_|batch_formation|batch_deadline)/],
  ["Overload", /^apiserver_(inflight|queue_depth|rejected_total|queue_wait)/],
+ ["Control-plane CPU", /^process_(cpu_fraction|thread_cpu)|^scheduler_(watch_decode|handler_seconds|handler_events)|^apiserver_serialize/],
  ["Everything else", /./],
 ];
 const DERIV = /(_total|_count|_sum)(\\{|$)/;   // counters chart as rates

@@ -739,6 +739,27 @@ static int g_epfd = -1;
 static Store g_store;
 static uint64_t g_requests = 0;
 
+// kt-prof wire attribution: per-verb response/event serialization time,
+// exported at /metrics under the same family names the Python server
+// registers (apiserver_serialize_seconds_total / _ops_total) so the
+// bench's profile stamper reads both servers identically.  The event
+// loop is single-threaded, so plain accumulators suffice (g_requests'
+// shape).  WATCH covers Store::emit's serialize-once event fan-out.
+enum SerVerb { SER_GET, SER_POST, SER_PUT, SER_WATCH, SER_NVERBS };
+static const char* kSerVerb[SER_NVERBS] = {"GET", "POST", "PUT", "WATCH"};
+static double g_ser_seconds[SER_NVERBS] = {0};
+static uint64_t g_ser_ops[SER_NVERBS] = {0};
+
+struct SerTimer {
+  SerVerb v;
+  double t0;
+  explicit SerTimer(SerVerb verb) : v(verb), t0(now_s()) {}
+  ~SerTimer() {
+    g_ser_seconds[v] += now_s() - t0;
+    g_ser_ops[v]++;
+  }
+};
+
 static void conn_arm(Conn* c, bool want_write) {
   struct epoll_event ev;
   ev.events = EPOLLIN | (want_write ? EPOLLOUT : 0);
@@ -835,7 +856,10 @@ void Store::emit(const char* etype, const std::string& kind,
   meta->set("resourceVersion", jstr(std::to_string(rv)));
   auto obj_json = std::make_shared<std::string>();
   obj_json->reserve(256);
-  jdump(*obj, *obj_json);
+  {
+    SerTimer st(SER_WATCH);
+    jdump(*obj, *obj_json);
+  }
   if (wal) append_wal(etype, kind, object_key(*obj), *obj_json);
   auto line = make_line(etype, *obj_json);
   window.push_back({rv, kind, etype, obj, prev, obj_json, line});
@@ -1129,6 +1153,7 @@ static std::map<std::string, std::string> split_query(const std::string& q) {
 
 static void handle_list(Conn* c, const std::string& kind,
                         const FieldSelector& sel) {
+  SerTimer st(SER_GET);
   std::string body = "{\"kind\":\"";
   body += (char)toupper(kind[0]);
   body += kind.substr(1);
@@ -1216,6 +1241,7 @@ static void do_create_one(Conn* c, const std::string& kind, JPtr body) {
     send_error(c, 409, err);
     return;
   }
+  SerTimer st(SER_POST);
   send_json(c, 201, jdumps(*body));
 }
 
@@ -1381,6 +1407,24 @@ static bool dispatch(Conn* c, const std::string& method,
       std::string m = "# TYPE apiserver_request_count counter\n"
                       "apiserver_request_count " +
                       std::to_string(g_requests) + "\n";
+      m += "# TYPE apiserver_serialize_seconds_total counter\n";
+      for (int i = 0; i < SER_NVERBS; i++) {
+        if (!g_ser_ops[i]) continue;
+        char buf[128];
+        snprintf(buf, sizeof buf,
+                 "apiserver_serialize_seconds_total{verb=\"%s\"} %.6f\n",
+                 kSerVerb[i], g_ser_seconds[i]);
+        m += buf;
+      }
+      m += "# TYPE apiserver_serialize_ops_total counter\n";
+      for (int i = 0; i < SER_NVERBS; i++) {
+        if (!g_ser_ops[i]) continue;
+        char buf[96];
+        snprintf(buf, sizeof buf,
+                 "apiserver_serialize_ops_total{verb=\"%s\"} %llu\n",
+                 kSerVerb[i], (unsigned long long)g_ser_ops[i]);
+        m += buf;
+      }
       send_response(c, 200, "text/plain", m);
       return true;
     }
@@ -1419,6 +1463,7 @@ static bool dispatch(Conn* c, const std::string& method,
     if (bkt != g_store.objects.end()) {
       auto it = bkt->second.find(key);
       if (it != bkt->second.end()) {
+        SerTimer st(SER_GET);
         send_json(c, 200, jdumps(*it->second));
         return true;
       }
@@ -1530,7 +1575,10 @@ static bool dispatch(Conn* c, const std::string& method,
       send_error(c, not_found ? 404 : 409, err);
       return true;
     }
-    send_json(c, 200, jdumps(*body));
+    {
+      SerTimer st(SER_PUT);
+      send_json(c, 200, jdumps(*body));
+    }
     return true;
   }
 

@@ -998,3 +998,119 @@ def test_defrag_kill_arc_must_land_and_recover():
 def test_defrag_clean_passes():
     art = dict(_soak(), defrag=_defrag())
     assert cb.check_defrag([("SOAK_r17.json", art)]) == []
+
+
+# -- kt-prof profile ratchet (ISSUE 18) --------------------------------------
+
+def _profile(unclassified=0.05, decode_us=40.0, handler_us=25.0,
+             serialize_us=60.0, enabled=True, wire=True):
+    p = {"wall_s": 12.0, "enabled": enabled, "samples": 220,
+         "sampler_self_cpu_s": 0.02}
+    if enabled:
+        p["cpu_seconds"] = {"solve_host": 8.0, "feature_build": 1.5,
+                            "other": 0.5}
+        p["cpu_fraction"] = {"solve_host": 0.8, "feature_build": 0.15,
+                             "other": 0.05}
+        p["unclassified_fraction"] = unclassified
+    if wire:
+        p["wire"] = {
+            "decode": {"seconds": 0.4, "events": 10000,
+                       "us_per_event": decode_us},
+            "handler": {"seconds": 0.25, "events": 10000,
+                        "us_per_event": handler_us},
+            "serialize": {"seconds": 0.6, "ops": 10000,
+                          "us_per_op": serialize_us}}
+    return p
+
+
+def _prof_art(profile=None, wire_profile=None, backend="cpu"):
+    d = _parsed(p50=6.0)
+    d["backend"] = backend
+    if profile is not None:
+        d["profile"] = profile
+    if wire_profile is not None:
+        d["wire"] = {"median_pods_per_second": 4000.0,
+                     "zero_bound_runs": 0, "profile": wire_profile}
+    return d
+
+
+def test_repo_artifacts_pass_the_profile_ratchet():
+    problems = cb.check_profile()
+    assert problems == [], problems
+
+
+def test_profile_unclassified_above_bar_fails():
+    art = _prof_art(profile=_profile(unclassified=0.35, wire=False))
+    problems = cb.check_profile([("BENCH_r16.json", art)])
+    assert len(problems) == 1 and "unclassified" in problems[0]
+    ok = _prof_art(profile=_profile(unclassified=0.19, wire=False))
+    assert cb.check_profile([("BENCH_r16.json", ok)]) == []
+
+
+def test_profile_stamped_disabled_fails():
+    art = _prof_art(profile=_profile(enabled=False, wire=False))
+    problems = cb.check_profile([("BENCH_r16.json", art)])
+    assert len(problems) == 1 and "KT_PROF=0" in problems[0]
+
+
+def test_profile_per_event_cost_regression_fails_and_noise_passes():
+    arts = [("BENCH_r15.json",
+             _prof_art(wire_profile=_profile(decode_us=40.0))),
+            ("BENCH_r16.json",
+             _prof_art(wire_profile=_profile(decode_us=60.0)))]
+    problems = cb.check_profile(arts)
+    assert len(problems) == 1 and "decode" in problems[0] \
+        and "regressed" in problems[0]
+    # Inside the 15% band, and improvements, pass.
+    arts[-1] = ("BENCH_r16.json",
+                _prof_art(wire_profile=_profile(decode_us=44.0)))
+    assert cb.check_profile(arts) == []
+    arts[-1] = ("BENCH_r16.json",
+                _prof_art(wire_profile=_profile(decode_us=20.0)))
+    assert cb.check_profile(arts) == []
+
+
+def test_profile_serialize_and_handler_costs_ratchet_too():
+    arts = [("BENCH_r15.json",
+             _prof_art(wire_profile=_profile())),
+            ("BENCH_r16.json",
+             _prof_art(wire_profile=_profile(serialize_us=90.0,
+                                             handler_us=40.0)))]
+    problems = cb.check_profile(arts)
+    assert any("serialize" in p for p in problems)
+    assert any("handler" in p for p in problems)
+
+
+def test_profile_ratchet_scans_back_past_other_backends():
+    arts = [("BENCH_r14.json",
+             _prof_art(wire_profile=_profile(decode_us=40.0))),
+            ("BENCH_r15.json",
+             _prof_art(wire_profile=_profile(decode_us=5.0),
+                       backend="tpu")),
+            ("BENCH_r16.json",
+             _prof_art(wire_profile=_profile(decode_us=60.0)))]
+    problems = cb.check_profile(arts)
+    assert len(problems) == 1 and "BENCH_r14" in problems[0]
+
+
+def test_profile_section_disappearing_fails():
+    arts = [("BENCH_r15.json",
+             _prof_art(profile=_profile(wire=False))),
+            ("BENCH_r16.json", _prof_art())]
+    problems = cb.check_profile(arts)
+    assert len(problems) == 1 and "disappeared" in problems[0]
+    # A wire profile only has to persist when the wire phase ran at all.
+    arts = [("BENCH_r15.json",
+             _prof_art(profile=_profile(wire=False),
+                       wire_profile=_profile())),
+            ("BENCH_r16.json",
+             _prof_art(profile=_profile(wire=False)))]
+    assert cb.check_profile(arts) == []
+
+
+def test_artifacts_predating_the_profile_section_ratchet_nothing():
+    arts = [("BENCH_r15.json", _prof_art()),
+            ("BENCH_r16.json",
+             _prof_art(profile=_profile(wire=False)))]
+    assert cb.check_profile(arts) == []
+    assert cb.check_profile([]) == []

@@ -21,6 +21,8 @@ COMMON = [
     ("/debug/traces", "application/json"),
     ("/debug/timeseries", "application/json"),
     ("/debug/dashboard", "text/html"),
+    ("/debug/profile", "application/json"),
+    ("/debug/profile?format=collapsed", "text/plain"),
 ]
 
 ROUTES = {
@@ -89,6 +91,40 @@ def test_route_answers_with_correct_content_type(daemons, daemon,
             f"{daemon}{route}: Content-Type {got!r}, wanted {ctype!r}"
         body = r.read()
         assert body, f"{daemon}{route}: empty body"
+
+
+def test_profile_is_speedscope_parseable_on_every_mux(daemons):
+    """/debug/profile's default body must be a loadable speedscope
+    document on all four daemons — schema URL, shared frame table, and
+    a sampled profile whose samples index into it."""
+    import json
+    for daemon, port in daemons.items():
+        url = f"http://127.0.0.1:{port}/debug/profile"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["$schema"].startswith("https://www.speedscope.app/"), \
+            daemon
+        prof = doc["profiles"][0]
+        assert prof["type"] == "sampled", daemon
+        assert len(prof["samples"]) == len(prof["weights"]), daemon
+        nframes = len(doc["shared"]["frames"])
+        assert all(i < nframes for s in prof["samples"] for i in s), daemon
+
+
+def test_profile_disabled_is_404_not_500(daemons, monkeypatch):
+    """KT_PROF=0 is a client-visible state, not a server fault: every
+    mux must answer 404 (with the reason) rather than 500."""
+    from kubernetes_tpu.utils import profiler
+    monkeypatch.setattr(profiler, "_ENABLED", False)
+    for daemon, port in daemons.items():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/debug/profile")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                status = r.status
+        except urllib.error.HTTPError as err:
+            status = err.code
+        assert status == 404, f"{daemon}: {status}"
 
 
 def test_unknown_route_is_404_not_500(daemons):

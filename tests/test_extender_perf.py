@@ -29,6 +29,17 @@ from kubernetes_tpu.perf import synth
 N_NODES = 5000
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Committed-artifact churn guard: the bytes as of module import, compared
+# again AFTER the perf test above ran (tests in a module run in
+# definition order) — an unarmed run must leave the committed file
+# byte-identical.
+_PERF_ART = os.path.join(REPO, "PERF_EXTENDER.json")
+try:
+    with open(_PERF_ART, "rb") as _f:
+        _PERF_ART_AT_IMPORT: bytes | None = _f.read()
+except OSError:
+    _PERF_ART_AT_IMPORT = None
+
 # Force the subprocess onto the virtual-CPU platform the same way
 # conftest.py does for this process (the axon plugin overrides
 # JAX_PLATFORMS at interpreter start, so env alone is not enough).
@@ -148,17 +159,25 @@ def test_filter_prioritize_p99_at_5k_nodes(extender_url):
     p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
     print(f"\nextender verb latency at {N_NODES} nodes: "
           f"p50 {p50*1e3:.1f} ms p99 {p99*1e3:.1f} ms")
-    # Committed perf artifact (VERDICT r2 item #2): the judged p99 number.
-    art = os.path.join(REPO, "PERF_EXTENDER.json")
-    try:
-        with open(art, "w") as f:
-            json.dump({"nodes": N_NODES, "samples": len(lat),
-                       "p50_ms": round(p50 * 1e3, 1),
-                       "p99_ms": round(p99 * 1e3, 1),
-                       "p50_bar_ms": 20.0, "bar_ms": 100.0}, f)
-            f.write("\n")
-    except OSError:
-        pass
+    # Committed perf artifact (VERDICT r2 item #2): the judged p99
+    # number.  The stamp is ARMED explicitly (BENCH_PERF_EXTENDER=1):
+    # restamping on every ordinary tier-1 run rewrote the committed
+    # artifact with whatever latency this box measured that minute —
+    # nothing consumes the file programmatically, so the only effect was
+    # a noise-diff in every commit touching unrelated code.  The
+    # latency BARS below still assert on every run; only the committed
+    # numbers refresh on demand.
+    if os.environ.get("BENCH_PERF_EXTENDER") == "1":
+        art = os.path.join(REPO, "PERF_EXTENDER.json")
+        try:
+            with open(art, "w") as f:
+                json.dump({"nodes": N_NODES, "samples": len(lat),
+                           "p50_ms": round(p50 * 1e3, 1),
+                           "p99_ms": round(p99 * 1e3, 1),
+                           "p50_bar_ms": 20.0, "bar_ms": 100.0}, f)
+                f.write("\n")
+        except OSError:
+            pass
     # Targets: p50 < 20 ms (the reference's own full-Schedule() trace
     # expectation, generic_scheduler.go:85) and p99 < 100 ms at 5k nodes
     # (vs the reference's 5 s extender timeout, extender.go:34-36).
@@ -167,6 +186,23 @@ def test_filter_prioritize_p99_at_5k_nodes(extender_url):
     if os.environ.get("KT_PERF_ASSERTS", "1") != "0":
         assert p99 < 0.100, f"p99 {p99*1e3:.1f} ms (p50 {p50*1e3:.1f} ms)"
         assert p50 < 0.020, f"p50 {p50*1e3:.1f} ms"
+
+
+def test_unarmed_run_leaves_committed_perf_artifact_untouched():
+    """The restamp-churn regression (PR 17 shipped a commit whose entire
+    diff was this file's numbers drifting with one box's latency): an
+    ordinary run — BENCH_PERF_EXTENDER unset — must leave the committed
+    PERF_EXTENDER.json byte-identical to what it was at module import,
+    i.e. the perf test above must not have rewritten it."""
+    if os.environ.get("BENCH_PERF_EXTENDER") == "1":
+        pytest.skip("stamp explicitly armed for this run")
+    try:
+        with open(_PERF_ART, "rb") as f:
+            now = f.read()
+    except OSError:
+        now = None
+    assert now == _PERF_ART_AT_IMPORT, \
+        "PERF_EXTENDER.json was rewritten by an unarmed test run"
 
 
 def test_node_change_invalidates_cached_tensors(extender_url):

@@ -92,6 +92,11 @@ class TestTimeSeriesRing:
         for series in ("scheduler_slo_", "scheduler_device_hbm_",
                        "stage_latency"):
             assert series in html
+        # kt-prof group: the CPU-attribution panel and its series.
+        assert "Control-plane CPU" in html
+        for series in ("cpu_fraction", "apiserver_serialize",
+                       "watch_decode"):
+            assert series in html
 
 
 # -- SLO burn-rate window math ----------------------------------------------

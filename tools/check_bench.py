@@ -898,6 +898,108 @@ def check_wire(artifacts: list[tuple[str, dict]] | None = None,
     return problems
 
 
+# Above this, the kt-prof classifier no longer covers the control
+# plane's hot paths and the profile section stops answering "where did
+# the CPU go" — the bar check_profile holds the committed artifacts to.
+UNCLASSIFIED_BAR = 0.20
+
+
+def _profile_rows(parsed: dict) -> list[tuple[str, dict]]:
+    """The artifact's kt-prof sections as (location, row) pairs: the
+    density profile at top level, the wire phase's under ``wire``."""
+    rows: list[tuple[str, dict]] = []
+    if parsed.get("profile"):
+        rows.append(("density", parsed["profile"]))
+    if (parsed.get("wire") or {}).get("profile"):
+        rows.append(("wire", parsed["wire"]["profile"]))
+    return rows
+
+
+def check_profile(artifacts: list[tuple[str, dict]] | None = None,
+                  tolerance: float = TOLERANCE,
+                  unclassified_bar: float = UNCLASSIFIED_BAR) -> list[str]:
+    """The kt-prof ratchet (ISSUE 18) over the newest BENCH artifact's
+    ``profile`` sections (harness.profile_section):
+
+    * a section stamped with the profiler disabled carries no CPU
+      attribution and fails outright — the bench must measure with
+      kt-prof on, or the component split silently stops existing;
+    * an unclassified CPU fraction above ``unclassified_bar`` fails: the
+      classifier no longer covers the hot paths, and "other" is exactly
+      the bucket a regression hides in;
+    * the per-event wire costs (watch-decode and handler-dispatch µs per
+      event, serialize µs per op) must not regress more than
+      ``tolerance`` vs the LAST same-backend artifact carrying the same
+      row (the check_wire scan-back — a backend change re-baselines, a
+      skipped phase must not retire the comparison);
+    * once a same-backend predecessor carries a profile section, the
+      newest artifact must too (a vanished section means the
+      attribution plane was dropped from the bench, the exact drift
+      this ratchet exists to catch).
+
+    Artifacts predating the section ratchet nothing."""
+    if artifacts is None:
+        artifacts = committed_artifacts()
+    problems: list[str] = []
+    if not artifacts:
+        return problems
+    new_name, new = artifacts[-1]
+    new_rows = dict(_profile_rows(new))
+    base = last_same_backend(artifacts, new)
+    if base is not None:
+        prev_name, prev = base
+        for loc in dict(_profile_rows(prev)):
+            if loc == "wire" and not new.get("wire"):
+                continue  # the wire phase itself was skipped this round
+            if loc not in new_rows:
+                problems.append(
+                    f"{new_name}: the {loc} profile section disappeared "
+                    f"({prev_name} carried one) — kt-prof attribution "
+                    f"was dropped from the bench")
+    for loc, row in new_rows.items():
+        if row.get("enabled") is False:
+            problems.append(
+                f"{new_name}: the {loc} profile was stamped with the "
+                f"profiler disabled (KT_PROF=0) — the artifact carries "
+                f"no CPU attribution")
+            continue
+        uf = row.get("unclassified_fraction")
+        if uf is not None and float(uf) > unclassified_bar:
+            problems.append(
+                f"{new_name}: {loc} profile unclassified CPU fraction "
+                f"{float(uf):.2f} above the {unclassified_bar:.0%} bar "
+                f"— the classifier no longer covers the control plane's "
+                f"hot paths")
+    for loc, row in new_rows.items():
+        for comp, per_key in (("decode", "us_per_event"),
+                              ("handler", "us_per_event"),
+                              ("serialize", "us_per_op")):
+            new_v = ((row.get("wire") or {}).get(comp) or {}).get(per_key)
+            if not new_v:
+                continue
+            hit = None
+            for name, parsed in reversed(artifacts[:-1]):
+                if parsed.get("backend") != new.get("backend"):
+                    continue
+                prev_row = dict(_profile_rows(parsed)).get(loc) or {}
+                pv = ((prev_row.get("wire") or {}).get(comp)
+                      or {}).get(per_key)
+                if pv:
+                    hit = (name, float(pv))
+                    break
+            if hit is None:
+                continue
+            prev_name, prev_v = hit
+            if float(new_v) > prev_v * (1.0 + tolerance):
+                problems.append(
+                    f"{loc} {comp} per-event cost regressed: {new_name} "
+                    f"{float(new_v):,.1f} {per_key} vs {prev_name} "
+                    f"{prev_v:,.1f} "
+                    f"(+{(float(new_v) / prev_v - 1) * 100:.0f}%, "
+                    f"tolerance {tolerance * 100:.0f}%)")
+    return problems
+
+
 def check_scatter_bytes(artifacts: list[tuple[str, dict]] | None = None,
                         tolerance: float = TOLERANCE) -> list[str]:
     """Scatter bytes-per-pod ratchet (ISSUE 15 dtype narrowing): the
@@ -1009,6 +1111,7 @@ def check(artifacts: list[tuple[str, dict]] | None = None,
     problems = check_device(artifacts, tolerance)
     problems += check_wire(artifacts, tolerance)
     problems += check_scatter_bytes(artifacts, tolerance)
+    problems += check_profile(artifacts, tolerance)
     if len(artifacts) < 2:
         return problems
     (prev_name, prev), (new_name, new) = artifacts[-2], artifacts[-1]
@@ -1087,6 +1190,12 @@ def main() -> int:
         print(f"bench ratchet OK: {new_name} p50 "
               f"{density_p50_s(new):.3f}s vs "
               f"{prev_name} {density_p50_s(prev):.3f}s")
+        frac = (new.get("profile") or {}).get("cpu_fraction") or {}
+        if frac:
+            top = max(frac, key=frac.get)
+            print(f"profile ratchet OK: {new_name} top component "
+                  f"{top} {frac[top]:.0%}, unclassified "
+                  f"{(new['profile']).get('unclassified_fraction')}")
     wl = committed_workloads_artifacts()
     if wl:
         print(f"workloads ratchet OK: {wl[-1][0]} quality "

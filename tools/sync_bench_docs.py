@@ -148,6 +148,25 @@ def _stage_cell(stages: dict) -> str:
                       for name, d in items)
 
 
+def _profile_cell(prof: dict) -> str:
+    """'solve_host 62% · serialize 21% · …; decode 38 µs/ev, …' — the
+    kt-prof component split plus per-event wire costs."""
+    frac = prof.get("cpu_fraction") or {}
+    top = sorted(frac.items(), key=lambda kv: -kv[1])[:4]
+    parts = []
+    if top:
+        parts.append(" · ".join(f"{c} {v:.0%}" for c, v in top))
+    wire = prof.get("wire") or {}
+    per = [f"{name} {wire[name][key]:.0f} µs/ev"
+           for name, key in (("decode", "us_per_event"),
+                             ("handler", "us_per_event"),
+                             ("serialize", "us_per_op"))
+           if name in wire]
+    if per:
+        parts.append(", ".join(per))
+    return "; ".join(parts)
+
+
 def render_arch(tag: str, parsed: dict) -> str:
     pods, nodes = _shape(parsed)
     pps = parsed["value"]
@@ -175,6 +194,16 @@ def render_arch(tag: str, parsed: dict) -> str:
     if wire and wire.get("stages"):
         rows.append(f"| ↳ wire stage breakdown (daemon side) | "
                     f"{_stage_cell(wire['stages'])} | — |")
+    # kt-prof CPU attribution rows (artifacts predating the profile
+    # section, or stamped with KT_PROF=0, omit them).
+    prof = parsed.get("profile")
+    if prof and prof.get("enabled"):
+        rows.append(f"| ↳ density CPU attribution (kt-prof) | "
+                    f"{_profile_cell(prof)} | — |")
+    wprof = (wire or {}).get("profile")
+    if wprof and wprof.get("enabled"):
+        rows.append(f"| ↳ wire CPU attribution (daemon side) | "
+                    f"{_profile_cell(wprof)} | — |")
     cold, warm = _cold_warm(parsed)
     if cold is not None and warm is not None:
         rows.append(
